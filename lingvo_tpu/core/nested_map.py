@@ -1,0 +1,247 @@
+"""NestedMap: a dot-accessible nested dict, registered as a JAX pytree.
+
+TPU-native re-design of the reference's universal batch/theta/state container
+(`lingvo/core/nested_map.py:81`). Unlike the reference (which carries its own
+Flatten/Pack machinery on top of TF), this NestedMap is a first-class JAX pytree
+node, so `jax.tree_util`, `jax.jit`, `jax.grad`, shardings etc. all traverse it
+natively.  Keys are flattened in sorted order, matching the reference's stable
+ordering guarantee.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Iterable
+
+import jax
+
+_NAME_SEPARATOR = "."
+_VALID_KEY_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Attributes of dict/NestedMap itself that must not be shadowed by keys.
+_RESERVED = frozenset(dir(dict)) | frozenset(
+    ("Flatten", "FlattenItems", "Pack", "Transform", "TransformWithKey",
+     "Filter", "FilterKeyVal", "Get", "GetItem", "Set", "Copy", "DeepCopy",
+     "IsCompatible", "VLog", "DebugString")
+)
+
+
+class NestedMap(dict):
+  """A dict with attribute access and stable-order pytree flattening."""
+
+  __slots__ = ()
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    for key in self.keys():
+      NestedMap.CheckKey(key)
+
+  # ---- attribute access ----------------------------------------------------
+
+  def __getattr__(self, name: str) -> Any:
+    try:
+      return self[name]
+    except KeyError as e:
+      raise AttributeError(
+          f"'NestedMap' has no attribute {name!r}; keys: {sorted(self.keys())}"
+      ) from e
+
+  def __setattr__(self, name: str, value: Any) -> None:
+    NestedMap.CheckKey(name)
+    self[name] = value
+
+  def __delattr__(self, name: str) -> None:
+    try:
+      del self[name]
+    except KeyError as e:
+      raise AttributeError(name) from e
+
+  def __setitem__(self, key: str, value: Any) -> None:
+    NestedMap.CheckKey(key)
+    super().__setitem__(key, value)
+
+  @staticmethod
+  def CheckKey(key: Any) -> None:
+    if not isinstance(key, str) or not _VALID_KEY_RE.match(key):
+      raise ValueError(f"Invalid NestedMap key {key!r}")
+    if key in _RESERVED:
+      raise ValueError(f"NestedMap key {key!r} shadows a reserved attribute")
+
+  # ---- copies --------------------------------------------------------------
+
+  def Copy(self) -> "NestedMap":
+    """Shallow copy (one level)."""
+    return NestedMap(self)
+
+  def DeepCopy(self) -> "NestedMap":
+    """Structural copy: containers are rebuilt, leaves are shared."""
+    return jax.tree_util.tree_map(lambda x: x, self)
+
+  def __deepcopy__(self, memo):
+    import copy as _copy
+    result = NestedMap()
+    memo[id(self)] = result
+    for k, v in self.items():
+      super(NestedMap, result).__setitem__(k, _copy.deepcopy(v, memo))
+    return result
+
+  # ---- dotted-path get/set -------------------------------------------------
+
+  def Get(self, path: str, default: Any = None) -> Any:
+    """Returns the value at dotted `path` ('a.b[0].c' style), or default."""
+    try:
+      return self.GetItem(path)
+    except (KeyError, IndexError, TypeError):
+      return default
+
+  def GetItem(self, path: str) -> Any:
+    """Returns the value at dotted `path`; raises on missing."""
+    current = self
+    for part in re.split(r"\.|(\[\d+\])", path):
+      if not part:
+        continue
+      if part.startswith("["):
+        current = current[int(part[1:-1])]
+      else:
+        current = current[part] if isinstance(current, dict) else getattr(
+            current, part)
+    return current
+
+  def Set(self, path: str, value: Any) -> None:
+    """Sets `path` to `value`, creating intermediate NestedMaps as needed."""
+    parts = [p for p in re.split(r"\.|(\[\d+\])", path) if p]
+    current = self
+    for i, part in enumerate(parts[:-1]):
+      nxt = parts[i + 1]
+      if part.startswith("["):
+        idx = int(part[1:-1])
+        while len(current) <= idx:
+          current.append(NestedMap() if not nxt.startswith("[") else [])
+        current = current[idx]
+      else:
+        if isinstance(current, dict):
+          if part not in current or current[part] is None:
+            current[part] = [] if nxt.startswith("[") else NestedMap()
+          current = current[part]
+        else:
+          current = getattr(current, part)
+    last = parts[-1]
+    if last.startswith("["):
+      idx = int(last[1:-1])
+      while len(current) <= idx:
+        current.append(None)
+      current[idx] = value
+    else:
+      current[last] = value
+
+  # ---- flatten / pack ------------------------------------------------------
+
+  def Flatten(self) -> list[Any]:
+    """Flattens leaves in sorted-key order (lists flattened in order)."""
+    return jax.tree_util.tree_leaves(self)
+
+  def FlattenItems(self) -> list[tuple[str, Any]]:
+    """Returns [(dotted_key, leaf)] in stable order."""
+    paths_and_leaves = jax.tree_util.tree_flatten_with_path(self)[0]
+    out = []
+    for path, leaf in paths_and_leaves:
+      parts = []
+      for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+          parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+          if parts:
+            parts[-1] += f"[{p.idx}]"
+          else:
+            parts.append(f"[{p.idx}]")
+        else:
+          parts.append(str(p))
+      out.append((_NAME_SEPARATOR.join(parts), leaf))
+    return out
+
+  def Pack(self, values: Iterable[Any]) -> "NestedMap":
+    """Packs flat `values` back into this map's structure."""
+    treedef = jax.tree_util.tree_structure(self)
+    return jax.tree_util.tree_unflatten(treedef, list(values))
+
+  # ---- transforms ----------------------------------------------------------
+
+  def Transform(self, fn: Callable[[Any], Any]) -> "NestedMap":
+    """Applies fn to every leaf; returns a new NestedMap."""
+    return jax.tree_util.tree_map(fn, self)
+
+  def TransformWithKey(self, fn: Callable[[str, Any], Any]) -> "NestedMap":
+    items = self.FlattenItems()
+    return self.Pack([fn(k, v) for k, v in items])
+
+  def Filter(self, fn: Callable[[Any], bool]) -> "NestedMap":
+    """Keeps only leaves where fn(value); prunes empty subtrees."""
+    return self.FilterKeyVal(lambda _, v: fn(v))
+
+  def FilterKeyVal(self, fn: Callable[[str, Any], bool]) -> "NestedMap":
+    """Keeps only leaves where fn(dotted_key, value); prunes empty subtrees."""
+
+    def _Recurse(node: Any, prefix: str) -> Any:
+      if isinstance(node, dict):
+        out = NestedMap()
+        for k in node:
+          key = f"{prefix}{_NAME_SEPARATOR}{k}" if prefix else k
+          sub = _Recurse(node[k], key)
+          if sub is not _PRUNE:
+            out[k] = sub
+        return out if out else _PRUNE
+      if isinstance(node, (list, tuple)):
+        if hasattr(node, "_fields"):  # namedtuple: all-or-nothing leaf
+          return node if fn(prefix, node) else _PRUNE
+        out_l = []
+        for i, v in enumerate(node):
+          sub = _Recurse(v, f"{prefix}[{i}]")
+          if sub is not _PRUNE:
+            out_l.append(sub)
+        if not out_l:
+          return _PRUNE
+        return type(node)(out_l) if isinstance(node, tuple) else out_l
+      return node if fn(prefix, node) else _PRUNE
+
+    result = _Recurse(self, "")
+    return NestedMap() if result is _PRUNE else result
+
+  def IsCompatible(self, other: "NestedMap") -> bool:
+    """True iff `other` has the same nested structure."""
+    return (jax.tree_util.tree_structure(self) ==
+            jax.tree_util.tree_structure(other))
+
+  def DebugString(self) -> str:
+    return "\n".join(f"{k}: {v!r}" for k, v in self.FlattenItems())
+
+
+class _Prune:
+  pass
+
+
+_PRUNE = _Prune()
+
+
+def _nested_map_flatten(nm: NestedMap):
+  keys = sorted(nm.keys())
+  return [nm[k] for k in keys], tuple(keys)
+
+
+def _nested_map_flatten_with_keys(nm: NestedMap):
+  keys = sorted(nm.keys())
+  return [(jax.tree_util.DictKey(k), nm[k]) for k in keys], tuple(keys)
+
+
+def _nested_map_unflatten(keys, values):
+  nm = NestedMap()
+  for k, v in zip(keys, values):
+    dict.__setitem__(nm, k, v)
+  return nm
+
+
+jax.tree_util.register_pytree_with_keys(
+    NestedMap,
+    _nested_map_flatten_with_keys,
+    _nested_map_unflatten,
+    flatten_func=_nested_map_flatten,
+)

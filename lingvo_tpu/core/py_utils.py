@@ -1,0 +1,334 @@
+"""Foundation utilities: weight specs/initializers, seeds, paddings, shapes.
+
+TPU-native replacement for the load-bearing parts of the reference's
+`lingvo/core/py_utils.py` (7k LoC): `WeightInit`/`WeightParams`
+(`py_utils.py:1085-1313`), deterministic name-derived seeds
+(`GenerateSeedFromName`, `py_utils.py:1555`), shape asserts
+(`py_utils.py:94-592`), and sequence-padding math. Everything TF-graph-specific
+(variable stores, sessions, collections, infeed) is intentionally absent — JAX
+pytrees + explicit PRNG keys replace it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core.nested_map import NestedMap
+
+# ---------------------------------------------------------------------------
+# Deterministic seeds.
+# ---------------------------------------------------------------------------
+
+
+def GenerateSeedFromName(name: str) -> int:
+  """Stable uint32 seed derived from a variable/layer path name.
+
+  Parity in spirit with the reference's md5-based scheme
+  (`py_utils.py:1555-1566`): the same layer path always gets the same init
+  stream, so goldens survive refactors that don't rename layers.
+  """
+  digest = hashlib.md5(name.encode("utf-8")).hexdigest()
+  return int(digest[:8], 16)
+
+
+def FoldInName(key: jax.Array, name: str) -> jax.Array:
+  """Folds a name-derived seed into a PRNG key."""
+  return jax.random.fold_in(key, GenerateSeedFromName(name))
+
+
+# ---------------------------------------------------------------------------
+# Weight specs & initializers.
+# ---------------------------------------------------------------------------
+
+
+from lingvo_tpu.core.hyperparams import RegisterSerializableType
+
+
+@RegisterSerializableType
+@dataclasses.dataclass(frozen=True)
+class WeightInit:
+  """An initializer spec: method name + scale.
+
+  Mirrors the reference's WeightInit method catalogue
+  (`py_utils.py:1085-1239`) but is a plain frozen dataclass evaluated with
+  `jax.random` at variable-creation time.
+  """
+
+  method: str = "xavier"
+  scale: float = 1.0
+
+  @classmethod
+  def Gaussian(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("gaussian", scale)
+
+  @classmethod
+  def Uniform(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("uniform", scale)
+
+  @classmethod
+  def UniformUnitScaling(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("uniform_unit_scaling", scale)
+
+  @classmethod
+  def Xavier(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("xavier", scale)
+
+  @classmethod
+  def XavierWithFixupParams(cls, scale: float = 1.0, depth: float = 1.0,
+                            layers_per_residual_block: float = 1.0) -> "WeightInit":
+    return cls("xavier", scale * (depth ** (-1.0 / (2 * layers_per_residual_block))))
+
+  @classmethod
+  def GaussianSqrtDim(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("gaussian_sqrt_dim", scale)
+
+  @classmethod
+  def GaussianSqrtFanIn(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("gaussian_sqrt_fanin", scale)
+
+  @classmethod
+  def GaussianSqrtFanOut(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("gaussian_sqrt_fanout", scale)
+
+  @classmethod
+  def UniformSqrtDim(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("uniform_sqrt_dim", scale)
+
+  @classmethod
+  def Constant(cls, scale: float = 0.0) -> "WeightInit":
+    return cls("constant", scale)
+
+  @classmethod
+  def TruncatedGaussian(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("truncated_gaussian", scale)
+
+  @classmethod
+  def TruncatedGaussianSqrtDim(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("truncated_gaussian_sqrt_dim", scale)
+
+  @classmethod
+  def TruncatedGaussianSqrtFanIn(cls, scale: float = 1.0) -> "WeightInit":
+    return cls("truncated_gaussian_sqrt_fanin", scale)
+
+
+@dataclasses.dataclass
+class WeightParams:
+  """Spec for one learnable weight.
+
+  `tensor_split_dims_mapping` names a mesh axis (or None) per tensor dim —
+  the TPU-native equivalent of the reference's per-var sharding annotations
+  (`base_layer.py:262-280` + `gshard_utils.GetVarSharding:430`), lowered here
+  to a `jax.sharding.PartitionSpec` by `parallel/mesh.py`.
+  """
+
+  shape: Sequence[int]
+  init: WeightInit = dataclasses.field(default_factory=WeightInit)
+  dtype: Any = jnp.float32
+  collections: Sequence[str] = ()
+  tensor_split_dims_mapping: Sequence[str | None] | None = None
+
+  def __post_init__(self):
+    self.shape = tuple(int(d) for d in self.shape)
+
+
+def InitWeight(key: jax.Array, wp: WeightParams) -> jax.Array:
+  """Materializes a weight from its spec with the given PRNG key."""
+  shape = tuple(wp.shape)
+  method, scale = wp.init.method, wp.init.scale
+  dtype = wp.dtype
+
+  def _dim0():
+    return max(1, shape[0]) if shape else 1
+
+  def _fans():
+    if len(shape) < 1:
+      return 1, 1
+    if len(shape) == 1:
+      return shape[0], shape[0]
+    receptive = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return shape[-2] * receptive, shape[-1] * receptive
+
+  if method == "constant":
+    return jnp.full(shape, scale, dtype)
+  if method == "gaussian":
+    return scale * jax.random.normal(key, shape, dtype)
+  if method == "uniform":
+    return jax.random.uniform(key, shape, dtype, -scale, scale)
+  if method == "uniform_unit_scaling":
+    return scale * math.sqrt(3.0 / _dim0()) * jax.random.uniform(
+        key, shape, dtype, -1.0, 1.0)
+  if method == "gaussian_sqrt_dim":
+    return (scale / math.sqrt(_dim0())) * jax.random.normal(key, shape, dtype)
+  if method == "uniform_sqrt_dim":
+    s = scale / math.sqrt(_dim0())
+    return jax.random.uniform(key, shape, dtype, -s, s)
+  if method == "gaussian_sqrt_fanin":
+    fan_in, _ = _fans()
+    return (scale / math.sqrt(fan_in)) * jax.random.normal(key, shape, dtype)
+  if method == "gaussian_sqrt_fanout":
+    _, fan_out = _fans()
+    return (scale / math.sqrt(fan_out)) * jax.random.normal(key, shape, dtype)
+  if method == "xavier":
+    fan_in, fan_out = _fans()
+    limit = scale * math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+  if method == "truncated_gaussian":
+    return scale * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+  if method == "truncated_gaussian_sqrt_dim":
+    return (scale / math.sqrt(_dim0())) * jax.random.truncated_normal(
+        key, -2.0, 2.0, shape, dtype)
+  if method == "truncated_gaussian_sqrt_fanin":
+    fan_in, _ = _fans()
+    return (scale / math.sqrt(fan_in)) * jax.random.truncated_normal(
+        key, -2.0, 2.0, shape, dtype)
+  raise ValueError(f"Unknown init method {method!r}")
+
+
+# ---------------------------------------------------------------------------
+# Shape checks (host-side; static shapes only, as XLA requires).
+# ---------------------------------------------------------------------------
+
+
+def HasShape(x: jax.Array, expected: Sequence[int], msg: str = "") -> jax.Array:
+  """Asserts x's static shape matches `expected` (-1 = any). Returns x."""
+  shape = tuple(x.shape)
+  if len(shape) != len(expected) or any(
+      e not in (-1, s) for s, e in zip(shape, expected)):
+    raise ValueError(f"Shape mismatch: got {shape}, want {tuple(expected)}. {msg}")
+  return x
+
+
+def HasRank(x: jax.Array, rank: int) -> jax.Array:
+  if x.ndim != rank:
+    raise ValueError(f"Rank mismatch: got {x.ndim}, want {rank}")
+  return x
+
+
+def GetShape(x: jax.Array, ndims: int | None = None) -> list[int]:
+  s = list(x.shape)
+  return s if ndims is None else s[:ndims]
+
+
+# ---------------------------------------------------------------------------
+# Padding / masking math (paddings are 1.0 at padded positions, like the ref).
+# ---------------------------------------------------------------------------
+
+
+def PaddingsFromLengths(lengths: jax.Array, maxlen: int) -> jax.Array:
+  """[b] lengths -> [b, maxlen] paddings (1.0 where padded)."""
+  pos = jnp.arange(maxlen)[None, :]
+  return (pos >= lengths[:, None]).astype(jnp.float32)
+
+def LengthsFromPaddings(paddings: jax.Array) -> jax.Array:
+  """[b, t] paddings -> [b] int32 lengths."""
+  return jnp.sum(1.0 - paddings, axis=1).astype(jnp.int32)
+
+
+def ApplyPadding(padding: jax.Array, x: jax.Array, pad_value: float = 0.0) -> jax.Array:
+  """Zeroes (or sets) padded positions; padding broadcast against x."""
+  while padding.ndim < x.ndim:
+    padding = padding[..., None]
+  if pad_value == 0.0:
+    return x * (1.0 - padding).astype(x.dtype)
+  return jnp.where(padding > 0.5, jnp.asarray(pad_value, x.dtype), x)
+
+
+def SequenceMask(paddings: jax.Array, dtype=jnp.float32) -> jax.Array:
+  return (1.0 - paddings).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Numeric hygiene.
+# ---------------------------------------------------------------------------
+
+
+_ENABLE_CHECK_NUMERICS = False
+
+
+def EnableCheckNumerics(enable: bool = True) -> None:
+  """Globally enables CheckNumerics (call before tracing; debug builds only)."""
+  global _ENABLE_CHECK_NUMERICS
+  _ENABLE_CHECK_NUMERICS = enable
+
+
+def CheckNumerics(x: jax.Array, msg: str = "") -> jax.Array:
+  """NaN/Inf check (active only after EnableCheckNumerics; identity otherwise).
+
+  Ref semantics: `py_utils.CheckNumerics` gated by --enable_check_numerics
+  (`py_utils_flags.py`). Uses a host callback so it works under jit; keep it
+  out of the steady-state hot path.
+  """
+  if not _ENABLE_CHECK_NUMERICS:
+    return x
+
+  def _check(v, _msg=msg):
+    if not np.all(np.isfinite(v)):
+      raise FloatingPointError(f"Non-finite values detected: {_msg}")
+
+  jax.debug.callback(_check, x)
+  return x
+
+
+def IsFinite(tree: Any) -> jax.Array:
+  """True iff every leaf of the pytree is finite."""
+  leaves = jax.tree_util.tree_leaves(tree)
+  if not leaves:
+    return jnp.asarray(True)
+  finite = [jnp.all(jnp.isfinite(l)) for l in leaves if hasattr(l, "dtype")
+            and jnp.issubdtype(l.dtype, jnp.inexact)]
+  if not finite:
+    return jnp.asarray(True)
+  return jnp.stack(finite).all()
+
+
+def GlobalNorm(tree: Any) -> jax.Array:
+  leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+  if not leaves:
+    return jnp.asarray(0.0)
+  return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+# ---------------------------------------------------------------------------
+# Misc.
+# ---------------------------------------------------------------------------
+
+
+def MaybeBfloat16(x: jax.Array, fprop_dtype) -> jax.Array:
+  """Casts float inputs to the layer's fprop dtype (bf16 activations policy)."""
+  if fprop_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+    return x.astype(fprop_dtype)
+  return x
+
+
+def Transform(fn, *trees):
+  return jax.tree_util.tree_map(fn, *trees)
+
+
+def Flatten(tree):
+  return jax.tree_util.tree_leaves(tree)
+
+
+def Pack(template, values):
+  return jax.tree_util.tree_unflatten(
+      jax.tree_util.tree_structure(template), list(values))
+
+
+def CountParams(theta: Any) -> int:
+  return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(theta)
+             if hasattr(l, "shape"))
+
+
+__all__ = [
+    "NestedMap", "WeightInit", "WeightParams", "InitWeight",
+    "GenerateSeedFromName", "FoldInName", "HasShape", "HasRank", "GetShape",
+    "PaddingsFromLengths", "LengthsFromPaddings", "ApplyPadding",
+    "SequenceMask", "CheckNumerics", "IsFinite", "GlobalNorm",
+    "MaybeBfloat16", "Transform", "Flatten", "Pack", "CountParams",
+]

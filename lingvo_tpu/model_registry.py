@@ -1,0 +1,94 @@
+"""Global model registry: name -> ModelParams class.
+
+Re-designs `lingvo/model_registry.py:70-389`: experiment classes register
+themselves under `<task_dir>.<module>.<ClassName>` and the trainer looks them
+up by name, applying a dataset method to produce the final Params tree.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Type
+
+from lingvo_tpu.core import base_model_params
+
+_MODEL_REGISTRY: dict[str, Type[base_model_params._BaseModelParams]] = {}
+
+# Module prefixes probed by _MaybeImportFor: `lm.foo.Bar` ->
+# `lingvo_tpu.models.lm.params.foo`.
+_TASK_ROOT = "lingvo_tpu.models"
+
+
+def _RegisterModel(cls, task_hint: str | None = None):
+  module = cls.__module__
+  # e.g. lingvo_tpu.models.lm.params.one_billion_wds -> lm.one_billion_wds
+  parts = module.split(".")
+  if "models" in parts:
+    idx = parts.index("models")
+    task = parts[idx + 1] if len(parts) > idx + 1 else (task_hint or "misc")
+    leaf = parts[-1] if parts[-1] != "params" else task
+  else:
+    task, leaf = (task_hint or "misc"), parts[-1]
+  key = f"{task}.{leaf}.{cls.__name__}"
+  _MODEL_REGISTRY[key] = cls
+  cls._registry_key = key
+  return cls
+
+
+def RegisterSingleTaskModel(cls):
+  """Class decorator registering a SingleTaskModelParams subclass."""
+  if not issubclass(cls, base_model_params.SingleTaskModelParams):
+    raise TypeError(f"{cls} must subclass SingleTaskModelParams")
+  return _RegisterModel(cls)
+
+
+def RegisterMultiTaskModel(cls):
+  if not issubclass(cls, base_model_params.MultiTaskModelParams):
+    raise TypeError(f"{cls} must subclass MultiTaskModelParams")
+  return _RegisterModel(cls)
+
+
+def _MaybeImportFor(name: str) -> None:
+  parts = name.split(".")
+  if len(parts) < 3:
+    return
+  task, module = parts[0], parts[1]
+  for candidate in (f"{_TASK_ROOT}.{task}.params.{module}",
+                    f"{_TASK_ROOT}.{task}.{module}"):
+    try:
+      importlib.import_module(candidate)
+      return
+    except ModuleNotFoundError as e:
+      # Only swallow "the candidate module itself doesn't exist"; a missing
+      # dependency *inside* an experiment module is a real error.
+      if e.name and (candidate == e.name or candidate.startswith(e.name + ".")):
+        continue
+      raise
+
+
+def GetClass(name: str) -> Type[base_model_params._BaseModelParams]:
+  if name not in _MODEL_REGISTRY:
+    _MaybeImportFor(name)
+  if name not in _MODEL_REGISTRY:
+    known = "\n  ".join(sorted(_MODEL_REGISTRY))
+    raise LookupError(f"Model {name!r} not registered. Known:\n  {known}")
+  return _MODEL_REGISTRY[name]
+
+
+def GetParams(name: str, dataset_name: str):
+  """Returns the full model Params for `name` with `dataset_name` applied.
+
+  Mirrors `model_registry.GetParams` (`model_registry.py:383`): instantiates
+  the ModelParams class, fetches the dataset method's input params, and
+  attaches them to the model params.
+  """
+  cls = GetClass(name)
+  inst = cls()
+  model_params = inst.Model()
+  input_params = inst.GetDatasetParams(dataset_name)
+  model_params.input = input_params
+  return model_params
+
+
+def GetRegisteredModels():
+  return dict(_MODEL_REGISTRY)

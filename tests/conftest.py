@@ -1,0 +1,14 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's multi-virtual-device-in-one-process testing strategy
+(SURVEY.md §4) but with real SPMD on fake devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,301 @@
+"""Tests for NestedMap, Params, py_utils, BaseLayer, registry.
+
+Mirrors the coverage intent of the reference's `hyperparams_test.py`,
+`nested_map` tests and `base_layer_test.py` (serialize/parse round-trip,
+copy/freeze semantics, deterministic seeds).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import base_layer, hyperparams, py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TestNestedMap:
+
+  def test_attr_access(self):
+    m = NestedMap(a=1)
+    m.b = NestedMap(c=2)
+    assert m.a == 1 and m.b.c == 2
+    del m.a
+    assert "a" not in m
+
+  def test_reserved_key_rejected(self):
+    with pytest.raises(ValueError):
+      NestedMap(Flatten=1)
+    with pytest.raises(ValueError):
+      NestedMap(items=1)
+
+  def test_pytree_roundtrip(self):
+    m = NestedMap(b=jnp.ones(2), a=NestedMap(x=jnp.zeros(3)), c=[1, 2])
+    leaves, treedef = jax.tree_util.tree_flatten(m)
+    m2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(m2, NestedMap) and isinstance(m2.a, NestedMap)
+    assert m.IsCompatible(m2)
+
+  def test_flatten_sorted_order(self):
+    m = NestedMap(b=2, a=1, c=3)
+    assert m.Flatten() == [1, 2, 3]
+    assert [k for k, _ in m.FlattenItems()] == ["a", "b", "c"]
+
+  def test_pack(self):
+    m = NestedMap(a=1, b=NestedMap(c=2, d=[3, 4]))
+    packed = m.Pack([10, 20, 30, 40])
+    assert packed.a == 10 and packed.b.c == 20 and packed.b.d == [30, 40]
+
+  def test_transform_filter(self):
+    m = NestedMap(a=1, b=NestedMap(c=2, d=3))
+    doubled = m.Transform(lambda x: x * 2)
+    assert doubled.b.d == 6
+    kept = m.FilterKeyVal(lambda k, v: v > 1)
+    assert "a" not in kept and kept.b.c == 2
+
+  def test_get_set_dotted(self):
+    m = NestedMap()
+    m.Set("a.b.c", 5)
+    assert m.Get("a.b.c") == 5
+    assert m.Get("a.b.missing", 42) == 42
+
+  def test_jit_through(self):
+    m = NestedMap(x=jnp.ones(3), y=jnp.full(3, 2.0))
+
+    @jax.jit
+    def f(nm):
+      return NestedMap(z=nm.x + nm.y)
+
+    np.testing.assert_allclose(f(m).z, 3.0)
+
+
+class TestParams:
+
+  def _MakeParams(self):
+    p = hyperparams.Params()
+    p.Define("alpha", 1.0, "A float.")
+    p.Define("name", "foo", "A string.")
+    sub = hyperparams.Params()
+    sub.Define("beta", [1, 2], "A list.")
+    p.Define("sub", sub, "Nested.")
+    return p
+
+  def test_define_get_set(self):
+    p = self._MakeParams()
+    assert p.alpha == 1.0
+    p.alpha = 2.0
+    p.Set(sub__beta=[3])
+    assert p.alpha == 2.0 and p.sub.beta == [3]
+
+  def test_unknown_param_raises(self):
+    p = self._MakeParams()
+    with pytest.raises(AttributeError):
+      p.gamma = 1
+    with pytest.raises(AttributeError):
+      _ = p.gamma
+    with pytest.raises(AttributeError):
+      p.Define("alpha", 2, "dup")
+
+  def test_copy_is_deep(self):
+    p = self._MakeParams()
+    q = p.Copy()
+    q.sub.beta.append(99)
+    assert p.sub.beta == [1, 2]
+    assert p == self._MakeParams()
+    assert q != p
+
+  def test_freeze(self):
+    p = self._MakeParams().Freeze()
+    with pytest.raises(TypeError):
+      p.alpha = 3
+    with pytest.raises(TypeError):
+      p.sub.beta = []
+
+  def test_text_roundtrip(self):
+    p = self._MakeParams()
+    p.alpha = 3.5
+    p.sub.beta = [7, 8]
+    text = p.ToText()
+    q = self._MakeParams().FromText(text)
+    assert q.alpha == 3.5 and q.sub.beta == [7, 8] and q.name == "foo"
+    assert q == p
+
+  def test_instantiable(self):
+
+    class Thing:
+
+      @classmethod
+      def Params(cls):
+        p = hyperparams.InstantiableParams(cls)
+        p.Define("x", 5, "")
+        return p
+
+      def __init__(self, p):
+        self.x = p.x
+
+    p = Thing.Params()
+    p.x = 9
+    assert p.Instantiate().x == 9
+    assert "cls : type/" in p.ToText()
+    q = p.Copy()
+    assert q.cls is Thing and q.x == 9
+
+
+class TestPyUtils:
+
+  def test_seed_stability(self):
+    s1 = py_utils.GenerateSeedFromName("model/layer/w")
+    s2 = py_utils.GenerateSeedFromName("model/layer/w")
+    s3 = py_utils.GenerateSeedFromName("model/layer/b")
+    assert s1 == s2 and s1 != s3
+
+  def test_init_methods(self):
+    key = jax.random.PRNGKey(0)
+    for method in ("gaussian", "uniform", "xavier", "constant",
+                   "gaussian_sqrt_dim", "uniform_sqrt_dim",
+                   "truncated_gaussian", "gaussian_sqrt_fanin",
+                   "truncated_gaussian_sqrt_fanin", "uniform_unit_scaling"):
+      wp = py_utils.WeightParams(
+          shape=(4, 8), init=py_utils.WeightInit(method, 0.5))
+      w = py_utils.InitWeight(key, wp)
+      assert w.shape == (4, 8)
+      assert bool(jnp.all(jnp.isfinite(w)))
+    const = py_utils.InitWeight(
+        key, py_utils.WeightParams((3,), py_utils.WeightInit.Constant(2.0)))
+    np.testing.assert_allclose(const, 2.0)
+
+  def test_paddings(self):
+    lengths = jnp.array([2, 4])
+    pad = py_utils.PaddingsFromLengths(lengths, 4)
+    np.testing.assert_allclose(pad, [[0, 0, 1, 1], [0, 0, 0, 0]])
+    np.testing.assert_array_equal(py_utils.LengthsFromPaddings(pad), [2, 4])
+    x = jnp.ones((2, 4, 3))
+    masked = py_utils.ApplyPadding(pad, x)
+    assert float(masked[0, 3, 0]) == 0.0 and float(masked[1, 3, 0]) == 1.0
+
+  def test_has_shape(self):
+    x = jnp.zeros((2, 3))
+    py_utils.HasShape(x, (2, 3))
+    py_utils.HasShape(x, (-1, 3))
+    with pytest.raises(ValueError):
+      py_utils.HasShape(x, (3, 2))
+
+  def test_global_norm_finite(self):
+    tree = NestedMap(a=jnp.ones(4), b=NestedMap(c=2 * jnp.ones(3)))
+    np.testing.assert_allclose(py_utils.GlobalNorm(tree), np.sqrt(4 + 12))
+    assert bool(py_utils.IsFinite(tree))
+    tree.a = jnp.array([1.0, np.nan, 1.0, 1.0])
+    assert not bool(py_utils.IsFinite(tree))
+
+
+class _Linear(base_layer.BaseLayer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "")
+    p.Define("output_dim", 0, "")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateVariable(
+        "w",
+        py_utils.WeightParams(
+            shape=(p.input_dim, p.output_dim), init=p.params_init,
+            dtype=p.dtype))
+    self.CreateVariable(
+        "b",
+        py_utils.WeightParams(
+            shape=(p.output_dim,), init=py_utils.WeightInit.Constant(0.0),
+            dtype=p.dtype))
+
+  def FProp(self, theta, x):
+    theta = self.CastTheta(theta)
+    return jnp.dot(self.ToFPropDtype(x), theta.w) + theta.b
+
+
+class _MLP(base_layer.BaseLayer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("dims", [], "")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    layers = []
+    for i in range(len(p.dims) - 1):
+      layers.append(_Linear.Params().Set(
+          input_dim=p.dims[i], output_dim=p.dims[i + 1]))
+    self.CreateChildren("fc", layers)
+
+  def FProp(self, theta, x):
+    for i, layer in enumerate(self.fc):
+      x = layer.FProp(theta.fc[i], x)
+      x = jax.nn.relu(x)
+    return x
+
+
+class TestBaseLayer:
+
+  def test_variable_creation_and_fprop(self):
+    p = _MLP.Params().Set(name="mlp", dims=[4, 8, 2])
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(jax.random.PRNGKey(0))
+    assert theta.fc[0].w.shape == (4, 8)
+    assert theta.fc[1].w.shape == (8, 2)
+    out = layer.FProp(theta, jnp.ones((3, 4)))
+    assert out.shape == (3, 2)
+
+  def test_deterministic_init(self):
+    p = _MLP.Params().Set(name="mlp", dims=[4, 8, 2])
+    l1, l2 = p.Instantiate(), p.Instantiate()
+    t1 = l1.InstantiateVariables(jax.random.PRNGKey(7))
+    t2 = l2.InstantiateVariables(jax.random.PRNGKey(7))
+    for a, b in zip(t1.Flatten(), t2.Flatten()):
+      np.testing.assert_array_equal(a, b)
+    t3 = l1.InstantiateVariables(jax.random.PRNGKey(8))
+    assert not np.allclose(t1.fc[0].w, t3.fc[0].w)
+
+  def test_fprop_dtype_propagation(self):
+    p = _MLP.Params().Set(name="mlp", dims=[4, 4], fprop_dtype=jnp.bfloat16)
+    layer = p.Instantiate()
+    assert layer.fc[0].p.fprop_dtype == jnp.bfloat16
+    theta = layer.InstantiateVariables(jax.random.PRNGKey(0))
+    out = layer.fc[0].FProp(theta.fc[0], jnp.ones((2, 4)))
+    assert out.dtype == jnp.bfloat16
+
+  def test_params_frozen_after_init(self):
+    p = _Linear.Params().Set(name="lin", input_dim=2, output_dim=2)
+    layer = p.Instantiate()
+    with pytest.raises(TypeError):
+      layer.p.input_dim = 5
+
+  def test_variable_specs_tree(self):
+    p = _MLP.Params().Set(name="mlp", dims=[4, 8, 2])
+    specs = p.Instantiate().VariableSpecs()
+    assert specs.fc[0].w.shape == (4, 8)
+
+
+class TestRegistry:
+
+  def test_register_and_lookup(self):
+    from lingvo_tpu import model_registry
+    from lingvo_tpu.core import base_model_params
+
+    class FakeParams(base_model_params.SingleTaskModelParams):
+
+      def Train(self):
+        p = hyperparams.Params()
+        p.Define("batch", 8, "")
+        return p
+
+    registered = model_registry._RegisterModel(FakeParams, task_hint="test")
+    key = registered._registry_key
+    assert model_registry.GetClass(key) is FakeParams
+    with pytest.raises(LookupError):
+      model_registry.GetClass("no.such.Model")
