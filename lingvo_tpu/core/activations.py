@@ -1,0 +1,33 @@
+"""Activation registry: name -> fn (ref: lingvo/core/activations.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_ACTIVATIONS = {
+    "NONE": lambda x: x,
+    "RELU": jax.nn.relu,
+    "RELU6": jax.nn.relu6,
+    "RELU_SQUARED": lambda x: jnp.square(jax.nn.relu(x)),
+    "LEAKY_RELU": jax.nn.leaky_relu,
+    "SIGMOID": jax.nn.sigmoid,
+    "TANH": jnp.tanh,
+    "GELU": lambda x: jax.nn.gelu(x, approximate=False),
+    "GELU_APPROXIMATE": lambda x: jax.nn.gelu(x, approximate=True),
+    "GELU_RAW": lambda x: jax.nn.gelu(x, approximate=False),
+    "SWISH": jax.nn.silu,
+    "SILU": jax.nn.silu,
+    "SOFTPLUS": jax.nn.softplus,
+    "EXP": jnp.exp,
+}
+
+
+def GetFn(name: str):
+  if name not in _ACTIVATIONS:
+    raise ValueError(f"Unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}")
+  return _ACTIVATIONS[name]
+
+
+def Register(name: str, fn) -> None:
+  _ACTIVATIONS[name.upper()] = fn

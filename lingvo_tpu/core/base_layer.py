@@ -72,6 +72,7 @@ class BaseLayer:
     self._params.Freeze()
     self._children: dict[str, Any] = {}
     self._variable_specs: dict[str, WeightParams] = {}
+    self._path: str | None = None
     self._CreateChildrenHook()
 
   def _NameIsRequired(self) -> bool:
@@ -97,6 +98,29 @@ class BaseLayer:
   @property
   def fprop_dtype(self):
     return self.p.fprop_dtype if self.p.fprop_dtype is not None else self.p.dtype
+
+  @property
+  def path(self) -> str:
+    """Full slash path from the root layer; unique per layer instance.
+
+    Assigned by the root's InstantiateVariables (or FinalizePaths). Used for
+    deterministic per-layer PRNG folds and forward-state update keys, so two
+    sibling layers never share a trace-time identity.
+    """
+    return self._path if self._path is not None else self.p.name
+
+  def FinalizePaths(self, root_path: str | None = None) -> None:
+    """Assigns full paths to this layer tree (idempotent from the root)."""
+    self._AssignPaths(root_path or self.p.name)
+
+  def _AssignPaths(self, path: str) -> None:
+    self._path = path
+    for cname, child in self._children.items():
+      if isinstance(child, list):
+        for i, c in enumerate(child):
+          c._AssignPaths(f"{path}/{cname}_{i}")
+      else:
+        child._AssignPaths(f"{path}/{cname}")
 
   def __getattr__(self, name: str) -> Any:
     # Children are accessible as attributes (self.fc, self.atten, ...).
@@ -175,12 +199,13 @@ class BaseLayer:
           out[cname] = sub
     return out
 
-  def InstantiateVariables(self, key: jax.Array, path: str = "") -> NestedMap:
+  def InstantiateVariables(self, key: jax.Array) -> NestedMap:
     """Materializes theta: a NestedMap of arrays mirroring the layer tree."""
-    path = path or self.p.name
+    if self._path is None:
+      self.FinalizePaths()
     theta = NestedMap()
     for name, wp in self._variable_specs.items():
-      var_path = f"{path}/{name}"
+      var_path = f"{self.path}/{name}"
       if self.p.random_seed is not None:
         vkey = jax.random.fold_in(
             jax.random.PRNGKey(self.p.random_seed),
@@ -190,19 +215,29 @@ class BaseLayer:
       theta[name] = py_utils.InitWeight(vkey, wp)
     for cname, child in self._children.items():
       if isinstance(child, list):
-        subs = [
-            c.InstantiateVariables(key, f"{path}/{cname}_{i}")
-            for i, c in enumerate(child)
-        ]
+        subs = [c.InstantiateVariables(key) for c in child]
         if any(len(s) for s in subs):
           theta[cname] = subs
       else:
-        sub = child.InstantiateVariables(key, f"{path}/{cname}")
+        sub = child.InstantiateVariables(key)
         if len(sub):
           theta[cname] = sub
     return theta
 
   # ---- fprop ---------------------------------------------------------------
+
+  def ChildTheta(self, theta: NestedMap, name: str):
+    """theta subtree for child `name`; empty map(s) if it has no variables.
+
+    Children without variables are pruned from theta by InstantiateVariables,
+    so FProps must fetch child theta through this accessor.
+    """
+    if name in theta:
+      return theta[name]
+    child = self._children[name]
+    if isinstance(child, list):
+      return [NestedMap() for _ in child]
+    return NestedMap()
 
   def FProp(self, theta: NestedMap, *args, **kwargs):
     raise NotImplementedError(f"{type(self).__name__}.FProp")

@@ -1,0 +1,367 @@
+"""Optimizers as pure update rules over theta pytrees.
+
+Capability parity with the reference's `lingvo/core/optimizer.py` (SGD:336,
+Momentum:346, RMSProp:368, Adagrad:390, Adam:436, Accumulator:507,
+CompositeOptimizer:199, XLAShardingAdafactor:905-1275) — but each optimizer is
+a pure `(state, grads, params, lr) -> (new_params, new_state)` function, so it
+jits and shards under GSPMD with no special casing. The Adafactor here keeps
+the reference's factored-second-moment math (row/col accumulators, update
+clipping, decay schedule) and its state inherits each weight's mesh sharding
+on the corresponding dims — the TPU-native equivalent of the reference's
+per-var sharded slots.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def _TreeMap(fn, *trees):
+  return jax.tree_util.tree_map(fn, *trees)
+
+
+class BaseOptimizer(base_layer.BaseLayer):
+  """Interface: InitState(params) -> state; Update(...) -> (params, state)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("add_summary_in_apply", True, "Emit lr summary (via learner).")
+    return p
+
+  def _NameIsRequired(self):
+    return False
+
+  def InitState(self, params: NestedMap) -> NestedMap:
+    return NestedMap()
+
+  def Update(self, state: NestedMap, grads: NestedMap, params: NestedMap,
+             lr, step) -> tuple[NestedMap, NestedMap]:
+    raise NotImplementedError
+
+
+class SGD(BaseOptimizer):
+
+  def Update(self, state, grads, params, lr, step):
+    new_params = _TreeMap(lambda p, g: p - lr * g.astype(p.dtype), params,
+                          grads)
+    return new_params, state
+
+
+class Momentum(BaseOptimizer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("momentum", 0.9, "Momentum coefficient.")
+    p.Define("use_nesterov", False, "Nesterov variant.")
+    return p
+
+  def InitState(self, params):
+    return NestedMap(m=_TreeMap(jnp.zeros_like, params))
+
+  def Update(self, state, grads, params, lr, step):
+    p = self.p
+    new_m = _TreeMap(lambda m, g: p.momentum * m + g, state.m, grads)
+    if p.use_nesterov:
+      upd = _TreeMap(lambda m, g: p.momentum * m + g, new_m, grads)
+    else:
+      upd = new_m
+    new_params = _TreeMap(lambda w, u: w - lr * u.astype(w.dtype), params, upd)
+    return new_params, NestedMap(m=new_m)
+
+
+class RMSProp(BaseOptimizer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("decay", 0.9, "Decay of the moving second moment.")
+    p.Define("momentum", 0.0, "Optional momentum.")
+    p.Define("epsilon", 1.0, "Stability term (ref default 1.0).")
+    return p
+
+  def InitState(self, params):
+    return NestedMap(
+        ms=_TreeMap(jnp.ones_like, params),
+        mom=_TreeMap(jnp.zeros_like, params))
+
+  def Update(self, state, grads, params, lr, step):
+    p = self.p
+    new_ms = _TreeMap(
+        lambda ms, g: p.decay * ms + (1 - p.decay) * jnp.square(g), state.ms,
+        grads)
+    new_mom = _TreeMap(
+        lambda mom, ms, g: p.momentum * mom + lr * g * jax.lax.rsqrt(
+            ms + p.epsilon), state.mom, new_ms, grads)
+    new_params = _TreeMap(lambda w, m: w - m.astype(w.dtype), params, new_mom)
+    return new_params, NestedMap(ms=new_ms, mom=new_mom)
+
+
+class Adagrad(BaseOptimizer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("initial_accumulator_value", 0.1, "Initial accumulator.")
+    return p
+
+  def InitState(self, params):
+    return NestedMap(acc=_TreeMap(
+        lambda x: jnp.full_like(x, self.p.initial_accumulator_value), params))
+
+  def Update(self, state, grads, params, lr, step):
+    new_acc = _TreeMap(lambda a, g: a + jnp.square(g), state.acc, grads)
+    new_params = _TreeMap(
+        lambda w, g, a: w - (lr * g * jax.lax.rsqrt(a + 1e-30)).astype(w.dtype),
+        params, grads, new_acc)
+    return new_params, NestedMap(acc=new_acc)
+
+
+class Adam(BaseOptimizer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("beta1", 0.9, "First-moment decay.")
+    p.Define("beta2", 0.999, "Second-moment decay.")
+    p.Define("epsilon", 1e-6, "Stability term (ref default 1e-6).")
+    return p
+
+  def InitState(self, params):
+    return NestedMap(
+        m=_TreeMap(jnp.zeros_like, params),
+        v=_TreeMap(jnp.zeros_like, params))
+
+  def Update(self, state, grads, params, lr, step):
+    p = self.p
+    t = (jnp.asarray(step, jnp.float32) + 1.0)
+    new_m = _TreeMap(lambda m, g: p.beta1 * m + (1 - p.beta1) * g, state.m,
+                     grads)
+    new_v = _TreeMap(lambda v, g: p.beta2 * v + (1 - p.beta2) * jnp.square(g),
+                     state.v, grads)
+    correction = jnp.sqrt(1.0 - p.beta2**t) / (1.0 - p.beta1**t)
+    new_params = _TreeMap(
+        lambda w, m, v: w - (lr * correction * m /
+                             (jnp.sqrt(v) + p.epsilon)).astype(w.dtype),
+        params, new_m, new_v)
+    return new_params, NestedMap(m=new_m, v=new_v)
+
+
+class AdamW(Adam):
+  """Adam with decoupled weight decay."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("weight_decay", 0.0, "Decoupled weight decay rate.")
+    return p
+
+  def Update(self, state, grads, params, lr, step):
+    new_params, new_state = super().Update(state, grads, params, lr, step)
+    wd = self.p.weight_decay
+    if wd:
+      new_params = _TreeMap(lambda nw, w: nw - lr * wd * w, new_params, params)
+    return new_params, new_state
+
+
+class Adafactor(BaseOptimizer):
+  """Sharding-aware Adafactor (ref `XLAShardingAdafactor`, optimizer.py:905).
+
+  Factored second moments for rank>=2 weights (row accumulator over the last
+  dim, col accumulator over the second-to-last), update RMS clipping, and the
+  pow-decay schedule. State tensors are reduced forms of the weight, so under
+  GSPMD they shard wherever the weight shards — no extra annotation needed for
+  the factored slots.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("beta1", 0.0, "If >0 keep a first moment (uses more memory).")
+    p.Define("decay_adam", 0.99, "Second-moment decay asymptote.")
+    p.Define("decay_pow", 0.8, "decay = 1 - (step+1)^-decay_pow if >0.")
+    p.Define("epsilon1", 1e-30, "Grad^2 regularizer.")
+    p.Define("epsilon2", 1e-3, "RMS-of-param floor for update scale.")
+    p.Define("multiply_by_parameter_scale", True,
+             "Scale updates by RMS(param) (Adafactor's LR-free mode).")
+    p.Define("clipping_threshold", 1.0, "Update RMS clip.")
+    p.Define("factored", True, "Use factored second moments for rank>=2.")
+    p.Define("min_dim_size_to_factor", 128,
+             "Only factor when both factored dims are at least this size.")
+    return p
+
+  def _ShouldFactor(self, shape):
+    p = self.p
+    return (p.factored and len(shape) >= 2 and
+            shape[-1] >= p.min_dim_size_to_factor and
+            shape[-2] >= p.min_dim_size_to_factor)
+
+  def InitState(self, params):
+    p = self.p
+
+    def _Slot(w):
+      slot = NestedMap()
+      if self._ShouldFactor(w.shape):
+        slot.vr = jnp.zeros(w.shape[:-1], jnp.float32)   # reduce last dim
+        slot.vc = jnp.zeros(w.shape[:-2] + w.shape[-1:], jnp.float32)
+      else:
+        slot.v = jnp.zeros(w.shape, jnp.float32)
+      if p.beta1 > 0:
+        slot.m = jnp.zeros(w.shape, jnp.float32)
+      return slot
+
+    return NestedMap(slots=jax.tree_util.tree_map(_Slot, params))
+
+  def Update(self, state, grads, params, lr, step):
+    p = self.p
+    t = jnp.asarray(step, jnp.float32) + 1.0
+    if p.decay_pow > 0:
+      decay = 1.0 - t**(-p.decay_pow)
+    else:
+      decay = p.decay_adam
+    decay = jnp.minimum(decay, p.decay_adam)
+
+    def _Upd(w, g, slot):
+      g32 = g.astype(jnp.float32)
+      gsq = jnp.square(g32) + p.epsilon1
+      new_slot = NestedMap()
+      if self._ShouldFactor(w.shape):
+        vr = decay * slot.vr + (1 - decay) * jnp.mean(gsq, axis=-1)
+        vc = decay * slot.vc + (1 - decay) * jnp.mean(gsq, axis=-2)
+        new_slot.vr, new_slot.vc = vr, vc
+        # u = g / sqrt(vhat); vhat = vr*vc / mean_row(vr)
+        row_mean = jnp.mean(vr, axis=-1, keepdims=True)
+        r = jax.lax.rsqrt(vr / row_mean)[..., None]
+        c = jax.lax.rsqrt(vc)[..., None, :]
+        u = g32 * r * c
+      else:
+        v = decay * slot.v + (1 - decay) * gsq
+        new_slot.v = v
+        u = g32 * jax.lax.rsqrt(v)
+      if p.clipping_threshold > 0:
+        u_rms = jnp.sqrt(jnp.mean(jnp.square(u)) + 1e-30)
+        u = u / jnp.maximum(1.0, u_rms / p.clipping_threshold)
+      scale = lr
+      if p.multiply_by_parameter_scale:
+        param_rms = jnp.sqrt(jnp.mean(jnp.square(w.astype(jnp.float32))))
+        scale = lr * jnp.maximum(param_rms, p.epsilon2)
+      if p.beta1 > 0:
+        m = p.beta1 * slot.m + (1 - p.beta1) * u
+        new_slot.m = m
+        u = m
+      new_w = w - (scale * u).astype(w.dtype)
+      return new_w, new_slot
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_leaves(grads)
+    flat_s = treedef.flatten_up_to(state.slots)
+    out = [_Upd(w, g, s) for w, g, s in zip(flat_p, flat_g, flat_s)]
+    new_params = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_slots = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return new_params, NestedMap(slots=new_slots)
+
+
+class Accumulator(BaseOptimizer):
+  """Gradient accumulation wrapper (ref optimizer.Accumulator:507)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("optimizer_tpl", Adam.Params(), "Inner optimizer.")
+    p.Define("accum_steps", 1, "Number of micro-steps per real update.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("opt", self.p.optimizer_tpl)
+
+  def InitState(self, params):
+    return NestedMap(
+        inner=self.opt.InitState(params),
+        accum=_TreeMap(jnp.zeros_like, params),
+        count=jnp.zeros((), jnp.int32))
+
+  def Update(self, state, grads, params, lr, step):
+    p = self.p
+    accum = _TreeMap(lambda a, g: a + g, state.accum, grads)
+    count = state.count + 1
+    do_apply = count >= p.accum_steps
+
+    mean_grads = _TreeMap(lambda a: a / p.accum_steps, accum)
+    applied_params, applied_inner = self.opt.Update(state.inner, mean_grads,
+                                                    params, lr, step)
+    new_params = _TreeMap(
+        lambda ap, w: jnp.where(do_apply, ap, w), applied_params, params)
+    new_inner = _TreeMap(
+        lambda ni, oi: jnp.where(do_apply, ni, oi), applied_inner, state.inner)
+    new_accum = _TreeMap(
+        lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), accum)
+    new_count = jnp.where(do_apply, 0, count)
+    return new_params, NestedMap(
+        inner=new_inner, accum=new_accum, count=new_count)
+
+
+class CompositeOptimizer(BaseOptimizer):
+  """Regex -> sub-optimizer routing (ref optimizer.CompositeOptimizer:199).
+
+  Routing is resolved at trace time from theta paths (static), so the compiled
+  program contains exactly one update rule per variable.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("optimizer_map", [],
+             "List of (regex, optimizer Params, lr multiplier). First match "
+             "wins; a '.*' default entry is required.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    subs = [tpl for _, tpl, _ in self.p.optimizer_map]
+    self.CreateChildren("subs", subs)
+
+  def _RouteIndex(self, path: str) -> int:
+    import re
+    for i, (regex, _, _) in enumerate(self.p.optimizer_map):
+      if re.match(regex, path):
+        return i
+    raise ValueError(f"No optimizer_map entry matches {path!r}")
+
+  def InitState(self, params):
+    # Each sub-optimizer gets full-tree state; unused slots are pruned by
+    # masking grads to the routed subset at Update time. Simpler and correct,
+    # at the cost of memory for non-routed slots only when state is nonzero.
+    items = params.FlattenItems() if isinstance(params, NestedMap) else []
+    routes = {k: self._RouteIndex(k) for k, _ in items}
+    self._routes = routes
+    return NestedMap(
+        subs=[opt.InitState(params) for opt in self.subs])
+
+  def Update(self, state, grads, params, lr, step):
+    if not hasattr(self, "_routes"):
+      self._routes = {
+          k: self._RouteIndex(k) for k, _ in params.FlattenItems()
+      }
+    new_params = params
+    new_states = []
+    for i, opt in enumerate(self.subs):
+      mult = self.p.optimizer_map[i][2]
+      masked = params.TransformWithKey(
+          lambda k, v, i=i: grads.GetItem(k)
+          if self._routes.get(k) == i else jnp.zeros_like(v))
+      upd_params, upd_state = opt.Update(state.subs[i], masked, new_params,
+                                         lr * mult, step)
+      new_params = new_params.TransformWithKey(
+          lambda k, v, i=i: upd_params.GetItem(k)
+          if self._routes.get(k) == i else v)
+      new_states.append(upd_state)
+    return new_params, NestedMap(subs=new_states)

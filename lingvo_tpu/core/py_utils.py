@@ -296,6 +296,134 @@ def GlobalNorm(tree: Any) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Trace-time contexts: step seeds, eval mode, forward state updates.
+#
+# These are thread-local stacks entered INSIDE a traced function, so the values
+# they carry are tracers — randomness stays a function of the step key (parity
+# with the reference's deterministic step seeds, `py_utils.GenerateStepSeedPair`)
+# and state updates stay functional (the JAX answer to the reference's
+# assign-op batch-norm moving averages).
+# ---------------------------------------------------------------------------
+
+import contextlib
+import threading
+
+_TLS = threading.local()
+
+
+def _Stack(name: str) -> list:
+  if not hasattr(_TLS, name):
+    setattr(_TLS, name, [])
+  return getattr(_TLS, name)
+
+
+@contextlib.contextmanager
+def StepSeedContext(key: jax.Array):
+  """Makes a per-step PRNG key available to stochastic layers during FProp."""
+  stack = _Stack("step_seed")
+  stack.append(key)
+  try:
+    yield
+  finally:
+    stack.pop()
+
+
+def HasStepSeed() -> bool:
+  return bool(_Stack("step_seed"))
+
+
+def StepSeed(name: str, extra: jax.Array | None = None) -> jax.Array:
+  """Derives a layer-unique key from the current step seed context.
+
+  `extra` (e.g. a scan loop index) is folded in for layers whose FProp is
+  traced once but executed many times.
+  """
+  stack = _Stack("step_seed")
+  if not stack:
+    raise RuntimeError(
+        "No StepSeedContext active; wrap the train FProp in "
+        "py_utils.StepSeedContext(step_key)")
+  key = jax.random.fold_in(stack[-1], GenerateSeedFromName(name))
+  if extra is not None:
+    key = jax.random.fold_in(key, extra)
+  return key
+
+
+@contextlib.contextmanager
+def EvalContext(do_eval: bool = True):
+  """Marks FProp as eval-mode (disables dropout & stat updates)."""
+  stack = _Stack("do_eval")
+  stack.append(do_eval)
+  try:
+    yield
+  finally:
+    stack.pop()
+
+
+def DoEval() -> bool:
+  stack = _Stack("do_eval")
+  return stack[-1] if stack else False
+
+
+@contextlib.contextmanager
+def ForwardStateContext():
+  """Collects state updates emitted during FProp (BN moving stats etc.).
+
+  Yields a plain dict {full_slash_path: value}; keys are the emitting layer's
+  unique `layer.path` plus the state name, so sibling layers never collide.
+
+  Usage (inside the traced train step):
+    with py_utils.ForwardStateContext() as updates:
+      loss = task.FProp(theta, batch)
+    new_theta = py_utils.ApplyForwardStateUpdates(theta, updates, root_layer)
+  """
+  stack = _Stack("fwd_state")
+  collected: dict[str, Any] = {}
+  stack.append(collected)
+  try:
+    yield collected
+  finally:
+    stack.pop()
+
+
+def AddForwardStateUpdate(path: str, value: Any) -> None:
+  """Records a functional state update under slash `path` (no-op outside
+  context)."""
+  stack = _Stack("fwd_state")
+  if stack:
+    stack[-1][path] = value
+
+
+def ApplyForwardStateUpdates(theta: NestedMap, updates: dict,
+                             root_layer) -> NestedMap:
+  """Merges collected forward-state updates back into a theta pytree.
+
+  Update keys are full layer paths ('<root>/<child>/.../<var>'); the leading
+  root-layer name is stripped to produce theta-relative dotted keys.
+  """
+  if not updates:
+    return theta
+  root = root_layer.path if hasattr(root_layer, "path") else str(root_layer)
+  new_theta = theta.DeepCopy()
+  for path, value in updates.items():
+    rel = path[len(root) + 1:] if path.startswith(root + "/") else path
+    parts = []
+    node: Any = new_theta
+    for seg in rel.split("/"):
+      # Child-list segments 'name_3' correspond to theta path 'name[3]'.
+      if isinstance(node, dict) and seg not in node and "_" in seg:
+        base, _, idx = seg.rpartition("_")
+        if idx.isdigit() and base in node and isinstance(node[base], list):
+          parts.append(f"{base}[{idx}]")
+          node = node[base][int(idx)]
+          continue
+      parts.append(seg)
+      node = node[seg] if isinstance(node, dict) and seg in node else None
+    new_theta.Set(".".join(parts), value)
+  return new_theta
+
+
+# ---------------------------------------------------------------------------
 # Misc.
 # ---------------------------------------------------------------------------
 

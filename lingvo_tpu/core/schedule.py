@@ -1,0 +1,166 @@
+"""Learning-rate schedules (ref: lingvo/core/schedule.py, 998 LoC).
+
+Each schedule is a Params-configured layer-like object whose `Value(step)` is
+a pure jnp function of the global step — directly usable inside jit.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+
+
+class BaseSchedule(base_layer.BaseLayer):
+
+  def _NameIsRequired(self):
+    return False
+
+  def Value(self, step):
+    raise NotImplementedError
+
+  def FProp(self, theta, step):
+    return self.Value(step)
+
+
+class Constant(BaseSchedule):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("value", 1.0, "The constant value.")
+    return p
+
+  def Value(self, step):
+    return jnp.asarray(self.p.value, jnp.float32)
+
+
+class PiecewiseConstant(BaseSchedule):
+  """Piecewise constant by step boundaries (`schedule.py` PiecewiseConstant)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("boundaries", [], "Step boundaries (ascending).")
+    p.Define("values", [], "len(boundaries)+1 values.")
+    return p
+
+  def Value(self, step):
+    p = self.p
+    assert len(p.values) == len(p.boundaries) + 1
+    step = jnp.asarray(step, jnp.int32)
+    index = jnp.sum(
+        (step >= jnp.asarray(p.boundaries, jnp.int32)).astype(jnp.int32)
+    ) if p.boundaries else 0
+    return jnp.asarray(jnp.array(p.values, jnp.float32)[index], jnp.float32)
+
+
+class Polynomial(BaseSchedule):
+  """Polynomial interpolation between (x0,y0) and (x1,y1)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("power", 1, "Polynomial power.")
+    p.Define("start", (0, 0.0), "(step, value) start point.")
+    p.Define("limit", (1, 1.0), "(step, value) end point.")
+    p.Define("origin", "start", "'start' or 'limit': where f(x)=x^p anchors.")
+    return p
+
+  def Value(self, step):
+    p = self.p
+    x = jnp.asarray(step, jnp.float32)
+    x0, y0 = p.start
+    x1, y1 = p.limit
+    ratio = jnp.clip((x - x0) / max(1.0, (x1 - x0)), 0.0, 1.0)
+    if p.origin == "start":
+      f = ratio**p.power
+    else:
+      f = 1.0 - (1.0 - ratio)**p.power
+    return jnp.asarray(y0 + f * (y1 - y0), jnp.float32)
+
+
+class LinearRampupExponentialDecay(BaseSchedule):
+  """Warmup then exponential decay (`schedule.py` LinearRampupExponentialDecayScaledByNumSplitSchedule, un-split)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("warmup", 100, "Steps of linear warmup to max.")
+    p.Define("decay_start", 1000, "Step to start decay.")
+    p.Define("decay_end", 10000, "Step decay reaches min.")
+    p.Define("max", 1.0, "Peak multiplier.")
+    p.Define("min", 0.01, "Final multiplier.")
+    return p
+
+  def Value(self, step):
+    p = self.p
+    x = jnp.asarray(step, jnp.float32)
+    warm = x / max(1.0, p.warmup) * p.max
+    ratio = jnp.clip((x - p.decay_start) / max(1.0, p.decay_end - p.decay_start),
+                     0.0, 1.0)
+    decayed = p.max * (p.min / p.max)**ratio
+    val = jnp.where(x < p.warmup, warm, jnp.where(x < p.decay_start,
+                                                  p.max, decayed))
+    return jnp.maximum(val, 0.0)
+
+
+class TransformerSchedule(BaseSchedule):
+  """warmup_steps^-1.5 ramp then rsqrt decay (`schedule.py` TransformerSchedule)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("warmup_steps", 4000, "Warmup steps.")
+    p.Define("model_dim", 512, "Model dim; scales by model_dim^-0.5.")
+    p.Define("worker_replicas", 1, "Data-parallel replicas (kept for parity).")
+    p.Define("decay_end", None, "If set, freeze value after this step.")
+    return p
+
+  def Value(self, step):
+    p = self.p
+    x = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+    if p.decay_end is not None:
+      x = jnp.minimum(x, float(p.decay_end))
+    return (p.model_dim**-0.5) * jnp.minimum(
+        (x + 1) * p.warmup_steps**-1.5, (x + 1)**-0.5)
+
+
+class LinearRampupCosineDecay(BaseSchedule):
+  """Linear warmup then cosine decay to min_ratio (modern LM default)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("warmup_steps", 1000, "Warmup steps.")
+    p.Define("total_steps", 100000, "Steps at which decay completes.")
+    p.Define("min_ratio", 0.1, "Final value as a fraction of peak.")
+    p.Define("max", 1.0, "Peak value.")
+    return p
+
+  def Value(self, step):
+    p = self.p
+    x = jnp.asarray(step, jnp.float32)
+    warm = x / max(1.0, p.warmup_steps)
+    ratio = jnp.clip((x - p.warmup_steps) /
+                     max(1.0, p.total_steps - p.warmup_steps), 0.0, 1.0)
+    cos = p.min_ratio + (1 - p.min_ratio) * 0.5 * (1 + jnp.cos(math.pi * ratio))
+    return p.max * jnp.where(x < p.warmup_steps, warm, cos)
+
+
+class ExponentialDecay(BaseSchedule):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("start_step", 0, "Decay start.")
+    p.Define("half_life_steps", 1000, "Steps per halving.")
+    p.Define("min", 0.0, "Floor.")
+    return p
+
+  def Value(self, step):
+    p = self.p
+    x = jnp.maximum(jnp.asarray(step, jnp.float32) - p.start_step, 0.0)
+    return jnp.maximum(0.5**(x / p.half_life_steps), p.min)

@@ -1,0 +1,372 @@
+"""Tests for core layers: shapes, numerics, dropout determinism, BN state.
+
+Coverage model follows the reference's layers_test.py / bn_layers_test.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import layers, py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(1234)
+
+
+def _init(p):
+  layer = p.Instantiate()
+  return layer, layer.InstantiateVariables(KEY)
+
+
+class TestProjection:
+
+  def test_shapes_and_activation(self):
+    p = layers.ProjectionLayer.Params().Set(
+        name="proj", input_dim=6, output_dim=3, activation="RELU")
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (4, 5, 6))
+    out = layer.FProp(theta, x)
+    assert out.shape == (4, 5, 3)
+    assert float(out.min()) >= 0.0  # relu
+
+  def test_padding_zeroes_output(self):
+    p = layers.ProjectionLayer.Params().Set(
+        name="proj", input_dim=4, output_dim=4, bias_init=5.0)
+    layer, theta = _init(p)
+    x = jnp.ones((2, 3, 4))
+    paddings = jnp.array([[0.0, 0.0, 1.0], [0.0, 1.0, 1.0]])
+    out = layer.FProp(theta, x, paddings)
+    np.testing.assert_allclose(out[0, 2], 0.0)
+    np.testing.assert_allclose(out[1, 1:], 0.0)
+    assert abs(float(out[0, 0, 0])) > 0
+
+  def test_weight_norm(self):
+    p = layers.ProjectionLayer.Params().Set(
+        name="proj", input_dim=4, output_dim=4, weight_norm=True)
+    layer, theta = _init(p)
+    # at init g=0 => effective w has unit column norms
+    w = theta.w
+    eff = (1.0 + theta.g) / jnp.linalg.norm(w, axis=0) * w
+    np.testing.assert_allclose(jnp.linalg.norm(eff, axis=0), 1.0, rtol=1e-5)
+    out = layer.FProp(theta, jnp.ones((2, 4)))
+    assert out.shape == (2, 4)
+
+  def test_feedforward_net(self):
+    p = layers.FeedForwardNet.Params().Set(
+        name="ffn", input_dim=8, hidden_layer_dims=[16, 4],
+        activation=["RELU", "NONE"])
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jnp.ones((2, 8)))
+    assert out.shape == (2, 4)
+
+
+class TestDropout:
+
+  def test_eval_identity(self):
+    p = layers.DeterministicDropoutLayer.Params().Set(keep_prob=0.5)
+    layer, theta = _init(p)
+    x = jnp.ones((4, 4))
+    # no step-seed context -> identity
+    np.testing.assert_array_equal(layer.FProp(theta, x), x)
+
+  def test_train_deterministic(self):
+    p = layers.DeterministicDropoutLayer.Params().Set(
+        name="drop", keep_prob=0.5)
+    layer, theta = _init(p)
+    x = jnp.ones((1000,))
+
+    def run(seed):
+      with py_utils.StepSeedContext(jax.random.PRNGKey(seed)):
+        return layer.FProp(theta, x)
+
+    a, b, c = run(1), run(1), run(2)
+    np.testing.assert_array_equal(a, b)  # same step seed -> same mask
+    assert not np.array_equal(a, c)
+    # unbiased scaling: mean stays ~1
+    assert abs(float(a.mean()) - 1.0) < 0.1
+    # dropped values are exactly 0, kept are 2.0
+    assert set(np.unique(np.asarray(a))) <= {0.0, 2.0}
+
+  def test_sibling_dropout_masks_differ(self):
+    # Regression: two FFNs must not share dropout masks (path-derived seeds).
+    from lingvo_tpu.core import base_layer
+
+    class TwoFFN(base_layer.BaseLayer):
+
+      def __init__(self, params):
+        super().__init__(params)
+        fp = layers.FeedForwardNet.Params().Set(
+            input_dim=32, hidden_layer_dims=[32], dropout_prob=0.5)
+        self.CreateChild("f1", fp.Copy())
+        self.CreateChild("f2", fp.Copy())
+
+      def FProp(self, theta, x):
+        return self.f1.FProp(theta.f1, x), self.f2.FProp(theta.f2, x)
+
+    layer = TwoFFN.Params().Set(name="m").Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    # same weights for both FFNs so differences come only from masks
+    theta.f2 = theta.f1
+    with py_utils.StepSeedContext(jax.random.PRNGKey(0)):
+      o1, o2 = layer.FProp(theta, jnp.ones((8, 32)))
+    assert not np.array_equal(np.asarray(o1), np.asarray(o2))
+
+  def test_eval_context_disables(self):
+    p = layers.DeterministicDropoutLayer.Params().Set(name="d", keep_prob=0.5)
+    layer, theta = _init(p)
+    x = jnp.ones((10,))
+    with py_utils.StepSeedContext(jax.random.PRNGKey(0)):
+      with py_utils.EvalContext():
+        np.testing.assert_array_equal(layer.FProp(theta, x), x)
+
+
+class TestNorms:
+
+  def test_layernorm_normalizes(self):
+    p = layers.LayerNorm.Params().Set(name="ln", input_dim=16)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (4, 16)) * 5 + 3
+    out = layer.FProp(theta, x)
+    np.testing.assert_allclose(np.mean(np.asarray(out), -1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.std(np.asarray(out), -1), 1.0, atol=1e-2)
+
+  def test_rmsnorm(self):
+    p = layers.RmsNorm.Params().Set(name="rms", input_dim=8)
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jax.random.normal(KEY, (2, 8)) * 10)
+    rms = np.sqrt(np.mean(np.square(np.asarray(out)), -1))
+    np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+  def test_batchnorm_train_vs_eval(self):
+    p = layers.BatchNormLayer.Params().Set(name="bn", dim=4, decay=0.5)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (32, 4)) * 3 + 7
+    with py_utils.ForwardStateContext() as updates:
+      out = layer.FProp(theta, x)
+    # train mode: output normalized by batch stats
+    np.testing.assert_allclose(np.mean(np.asarray(out), 0), 0.0, atol=1e-4)
+    # moving stats updated functionally under the layer's unique path
+    assert "bn/moving_mean" in updates
+    mm = updates["bn/moving_mean"]
+    np.testing.assert_allclose(
+        mm, 0.5 * np.zeros(4) + 0.5 * np.mean(np.asarray(x), 0), rtol=1e-5)
+    # eval mode uses (stale) moving stats -> different output
+    with py_utils.EvalContext():
+      out_eval = layer.FProp(theta, x)
+    assert not np.allclose(out, out_eval)
+
+  def test_batchnorm_respects_paddings(self):
+    p = layers.BatchNormLayer.Params().Set(name="bn", dim=2)
+    layer, theta = _init(p)
+    x = jnp.stack([jnp.ones((4, 2)), 100 * jnp.ones((4, 2))], axis=0)
+    paddings = jnp.array([[0.0] * 4, [1.0] * 4])  # 2nd seq fully padded
+    with py_utils.ForwardStateContext() as updates:
+      layer.FProp(theta, x, paddings)
+    # mean must come only from the unpadded sequence (all ones)
+    np.testing.assert_allclose(
+        updates["bn/moving_mean"], (1 - p.decay) * 1.0, rtol=1e-4)
+
+  def test_batchnorm_rank4_padded_count(self):
+    # Regression: count must cover all reduced dims, not just masked ones.
+    p = layers.BatchNormLayer.Params().Set(name="bn", dim=2, decay=0.0)
+    layer, theta = _init(p)
+    x = 5.0 * jnp.ones((2, 4, 3, 2))  # [b, t, w, c]
+    paddings = jnp.zeros((2, 4))
+    with py_utils.ForwardStateContext() as updates:
+      layer.FProp(theta, x, paddings)
+    np.testing.assert_allclose(updates["bn/moving_mean"], 5.0, rtol=1e-5)
+
+  def test_sibling_bn_updates_do_not_collide(self):
+    from lingvo_tpu.core import base_layer
+
+    class TwoConv(base_layer.BaseLayer):
+
+      def __init__(self, params):
+        super().__init__(params)
+        cp = layers.Conv2DLayer.Params().Set(filter_shape=(3, 3, 2, 2))
+        self.CreateChild("c1", cp.Copy())
+        self.CreateChild("c2", cp.Copy())
+
+      def FProp(self, theta, x):
+        return self.c2.FProp(theta.c2, self.c1.FProp(theta.c1, x))
+
+    layer = TwoConv.Params().Set(name="m").Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    with py_utils.ForwardStateContext() as updates:
+      layer.FProp(theta, jnp.ones((1, 4, 4, 2)))
+    keys = sorted(updates)
+    assert "m/c1/bn/moving_mean" in keys and "m/c2/bn/moving_mean" in keys
+    # merge routes each update to its own theta slot
+    new_theta = py_utils.ApplyForwardStateUpdates(theta, updates, layer)
+    assert not np.allclose(new_theta.c1.bn.moving_variance,
+                           theta.c1.bn.moving_variance)
+    np.testing.assert_allclose(new_theta.c1.bn.moving_mean,
+                               updates["m/c1/bn/moving_mean"])
+
+  def test_groupnorm(self):
+    p = layers.GroupNormLayer.Params().Set(name="gn", dim=8, num_groups=2)
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jax.random.normal(KEY, (2, 5, 8)))
+    assert out.shape == (2, 5, 8)
+
+
+class TestConv:
+
+  def test_conv2d_shapes(self):
+    p = layers.Conv2DLayer.Params().Set(
+        name="conv", filter_shape=(3, 3, 1, 8), filter_stride=(2, 2),
+        batch_norm=False, has_bias=True)
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jnp.ones((2, 28, 28, 1)))
+    assert out.shape == (2, 14, 14, 8)
+
+  def test_conv2d_with_paddings(self):
+    p = layers.Conv2DLayer.Params().Set(
+        name="conv", filter_shape=(3, 3, 4, 8), filter_stride=(2, 1),
+        batch_norm=False)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (2, 10, 6, 4))
+    paddings = py_utils.PaddingsFromLengths(jnp.array([10, 4]), 10)
+    out, out_pad = layer.FProp(theta, x, paddings)
+    assert out.shape == (2, 5, 6, 8)
+    assert out_pad.shape == (2, 5)
+    np.testing.assert_allclose(out[1, 3:], 0.0)  # padded region zeroed
+
+  def test_causal_conv_no_future_leak(self):
+    p = layers.Conv2DLayer.Params().Set(
+        name="conv", filter_shape=(3, 1, 2, 2), causal_convolution=True,
+        batch_norm=False)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (1, 8, 1, 2))
+    out1 = layer.FProp(theta, x)
+    x2 = x.at[:, 5:].set(99.0)  # perturb the future
+    out2 = layer.FProp(theta, x2)
+    np.testing.assert_allclose(out1[:, :5], out2[:, :5], rtol=1e-5)
+
+  def test_depthwise_causal_no_future_leak(self):
+    # Regression: depthwise causal conv must left-pad like the base class.
+    p = layers.DepthwiseConv2DLayer.Params().Set(
+        name="dw", filter_shape=(3, 1, 2, 1), causal_convolution=True,
+        batch_norm=False)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (1, 8, 1, 2))
+    out1 = layer.FProp(theta, x)
+    out2 = layer.FProp(theta, x.at[:, 5:].set(99.0))
+    np.testing.assert_allclose(out1[:, :5], out2[:, :5], rtol=1e-5)
+
+  def test_maxpool_padded_frames_lose(self):
+    # Regression: zeroed padded frames must not beat negative activations.
+    p = layers.MaxPoolLayer.Params().Set(
+        name="mp", window_shape=(2, 1), window_stride=(2, 1))
+    layer, theta = _init(p)
+    x = -jnp.ones((1, 4, 1, 1))
+    paddings = jnp.array([[0.0, 0.0, 0.0, 1.0]])
+    out, out_pad = layer.FProp(theta, x, paddings)
+    # window [t2, t3]: t3 is padded; max of valid = -1, then re-zeroed by
+    # output paddings only if the output frame itself is padded (it isn't).
+    assert float(out[0, 1, 0, 0]) == -1.0
+
+  def test_depthwise(self):
+    p = layers.DepthwiseConv2DLayer.Params().Set(
+        name="dw", filter_shape=(3, 1, 4, 2), batch_norm=False)
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jnp.ones((2, 6, 1, 4)))
+    assert out.shape == (2, 6, 1, 8)
+
+  def test_maxpool(self):
+    p = layers.MaxPoolLayer.Params().Set(name="mp")
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jnp.ones((2, 8, 8, 3)))
+    assert out.shape == (2, 4, 4, 3)
+
+
+class TestEmbeddingSoftmax:
+
+  def test_embedding_gather_vs_matmul(self):
+    pg = layers.SimpleEmbeddingLayer.Params().Set(
+        name="emb", vocab_size=11, embedding_dim=6)
+    pm = pg.Copy().Set(use_matmul=True)
+    lg, tg = _init(pg)
+    lm = pm.Instantiate()
+    ids = jnp.array([[1, 2], [10, 0]])
+    np.testing.assert_allclose(
+        lg.EmbLookup(tg, ids), lm.EmbLookup(tg, ids), rtol=1e-5)
+
+  def test_positional_embedding(self):
+    p = layers.PositionalEmbeddingLayer.Params().Set(embedding_dim=8)
+    layer, theta = _init(p)
+    out = layer.FProp(theta, seq_length=5)
+    assert out.shape == (5, 8)
+    np.testing.assert_allclose(out[0, :4], 0.0, atol=1e-6)  # sin(0)=0
+    np.testing.assert_allclose(out[0, 4:], 1.0, atol=1e-6)  # cos(0)=1
+
+  def test_rotary_preserves_norm_and_relative(self):
+    p = layers.RotaryPositionalEmbeddingLayer.Params().Set(embedding_dim=8)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (2, 6, 2, 8))
+    out = layer.FProp(theta, x)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(x, axis=-1), rtol=1e-4)
+    # relative property: <R(q,i), R(k,j)> depends only on i-j
+    q = jax.random.normal(KEY, (1, 10, 1, 8))
+    k = jax.random.normal(jax.random.PRNGKey(5), (1, 10, 1, 8))
+    rq, rk = layer.FProp(theta, q), layer.FProp(theta, k)
+    dot_03 = float(jnp.sum(rq[0, 0, 0] * rk[0, 3, 0]))
+    q2 = jnp.roll(q, 2, axis=1)
+    k2 = jnp.roll(k, 2, axis=1)
+    rq2, rk2 = layer.FProp(theta, q2), layer.FProp(theta, k2)
+    dot_25 = float(jnp.sum(rq2[0, 2, 0] * rk2[0, 5, 0]))
+    assert abs(dot_03 - dot_25) < 1e-3
+
+  def test_rotary_partial_rotation(self):
+    p = layers.RotaryPositionalEmbeddingLayer.Params().Set(embedding_dim=4)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (1, 6, 2, 8))
+    out = layer.FProp(theta, x)
+    assert out.shape == x.shape
+    # unrotated tail passes through untouched
+    np.testing.assert_array_equal(out[..., 4:], x[..., 4:])
+    assert not np.allclose(out[0, 1:, :, :4], x[0, 1:, :, :4])
+
+  def test_softmax_xent(self):
+    p = layers.SimpleFullSoftmax.Params().Set(
+        name="sm", input_dim=8, num_classes=5)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (4, 8))
+    ids = jnp.array([0, 1, 2, 3])
+    out = layer.FProp(theta, x, class_ids=ids)
+    assert out.logits.shape == (4, 5)
+    assert out.per_example_xent.shape == (4,)
+    # xent >= 0 and matches manual computation
+    manual = -np.take_along_axis(
+        np.asarray(out.log_probs), np.asarray(ids)[:, None], 1)[:, 0]
+    np.testing.assert_allclose(out.per_example_xent, manual, rtol=1e-5)
+
+  def test_label_smoothing_increases_xent_on_confident(self):
+    p = layers.SimpleFullSoftmax.Params().Set(
+        name="sm", input_dim=4, num_classes=4)
+    layer, theta = _init(p)
+    x = jnp.ones((2, 4))
+    ids = jnp.array([1, 2])
+    plain = layer.FProp(theta, x, class_ids=ids)
+    smooth = layer.FProp(theta, x, class_ids=ids, label_smoothing=0.1)
+    assert smooth.per_example_xent.shape == plain.per_example_xent.shape
+
+  def test_shared_embedding_softmax(self):
+    p = layers.SharedEmbeddingSoftmaxLayer.Params().Set(
+        name="shared", vocab_size=12, embedding_dim=6)
+    layer, theta = _init(p)
+    ids = jnp.array([[0, 3]])
+    emb = layer.EmbLookup(theta, ids)
+    assert emb.shape == (1, 2, 6)
+    out = layer.FProp(theta, emb, class_ids=ids)
+    assert out.logits.shape == (1, 2, 12)
+
+  def test_bf16_fprop_dtype(self):
+    p = layers.SimpleFullSoftmax.Params().Set(
+        name="sm", input_dim=8, num_classes=5, fprop_dtype=jnp.bfloat16)
+    layer, theta = _init(p)
+    out = layer.FProp(theta, jnp.ones((2, 8)), class_ids=jnp.array([0, 1]))
+    assert out.logits.dtype == jnp.bfloat16
+    assert out.per_example_xent.dtype == jnp.float32  # xent always f32

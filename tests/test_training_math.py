@@ -1,0 +1,219 @@
+"""Tests for schedules, optimizers, learner (ref optimizer_test/learner_test)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TestSchedules:
+
+  def _v(self, p, step):
+    return float(p.Instantiate().Value(step))
+
+  def test_constant(self):
+    assert self._v(sched_lib.Constant.Params().Set(value=0.5), 100) == 0.5
+
+  def test_piecewise(self):
+    p = sched_lib.PiecewiseConstant.Params().Set(
+        boundaries=[10, 20], values=[1.0, 0.1, 0.01])
+    assert self._v(p, 0) == 1.0
+    assert self._v(p, 10) == pytest.approx(0.1)
+    assert self._v(p, 25) == pytest.approx(0.01)
+
+  def test_transformer_schedule(self):
+    p = sched_lib.TransformerSchedule.Params().Set(
+        warmup_steps=100, model_dim=64)
+    peak_region = self._v(p, 99)
+    late = self._v(p, 10000)
+    early = self._v(p, 0)
+    assert early < peak_region and late < peak_region
+    # rsqrt decay after warmup
+    assert self._v(p, 400) == pytest.approx(64**-0.5 * 401**-0.5, rel=1e-3)
+
+  def test_cosine(self):
+    p = sched_lib.LinearRampupCosineDecay.Params().Set(
+        warmup_steps=10, total_steps=100, min_ratio=0.1, max=2.0)
+    assert self._v(p, 0) == 0.0
+    assert self._v(p, 10) == pytest.approx(2.0, rel=1e-3)
+    assert self._v(p, 100) == pytest.approx(0.2, rel=1e-3)
+
+  def test_linear_rampup_exp_decay(self):
+    p = sched_lib.LinearRampupExponentialDecay.Params().Set(
+        warmup=10, decay_start=20, decay_end=30, max=1.0, min=0.1)
+    assert self._v(p, 5) == pytest.approx(0.5)
+    assert self._v(p, 15) == 1.0
+    assert self._v(p, 30) == pytest.approx(0.1, rel=1e-4)
+
+
+def _quadratic_problem(opt_params, steps=60, lr=0.1):
+  """Minimize ||w - target||^2 with the given optimizer; returns final dist."""
+  target = jnp.array([1.0, -2.0, 3.0])
+  params = NestedMap(w=jnp.zeros(3))
+  opt = opt_params.Instantiate()
+  state = opt.InitState(params)
+
+  def loss_fn(p):
+    return jnp.sum(jnp.square(p.w - target))
+
+  @jax.jit
+  def step_fn(params, state, i):
+    grads = jax.grad(loss_fn)(params)
+    return opt.Update(state, grads, params, lr, i)
+
+  for i in range(steps):
+    params, state = step_fn(params, state, i)
+  return float(jnp.linalg.norm(params.w - target))
+
+
+class TestOptimizers:
+
+  def test_sgd_converges(self):
+    assert _quadratic_problem(opt_lib.SGD.Params()) < 1e-3
+
+  def test_momentum_converges(self):
+    assert _quadratic_problem(
+        opt_lib.Momentum.Params(), steps=200, lr=0.02) < 1e-2
+
+  def test_adam_converges(self):
+    assert _quadratic_problem(opt_lib.Adam.Params(), steps=300, lr=0.1) < 1e-2
+
+  def test_adagrad_converges(self):
+    assert _quadratic_problem(
+        opt_lib.Adagrad.Params(), steps=400, lr=1.0) < 1e-2
+
+  def test_rmsprop_converges(self):
+    assert _quadratic_problem(
+        opt_lib.RMSProp.Params().Set(epsilon=1e-8), steps=300, lr=0.05) < 0.05
+
+  def test_adamw_decays_weights(self):
+    params = NestedMap(w=jnp.ones(4) * 10)
+    opt = opt_lib.AdamW.Params().Set(weight_decay=0.1).Instantiate()
+    state = opt.InitState(params)
+    zero_g = NestedMap(w=jnp.zeros(4))
+    new_params, _ = opt.Update(state, zero_g, params, 0.1, 0)
+    assert float(new_params.w[0]) < 10.0  # decay applied with zero grads
+
+  def test_adafactor_factored_state_shapes(self):
+    params = NestedMap(
+        big=jnp.zeros((256, 512)), small=jnp.zeros((4, 4)), vec=jnp.zeros(300))
+    opt = opt_lib.Adafactor.Params().Instantiate()
+    state = opt.InitState(params)
+    assert state.slots.big.vr.shape == (256,)
+    assert state.slots.big.vc.shape == (512,)
+    assert "v" in state.slots.small and state.slots.small.v.shape == (4, 4)
+    assert state.slots.vec.v.shape == (300,)
+
+  def test_adafactor_converges(self):
+    p = opt_lib.Adafactor.Params().Set(
+        multiply_by_parameter_scale=False, factored=False)
+    assert _quadratic_problem(p, steps=400, lr=0.05) < 0.05
+
+  def test_accumulator_applies_every_n(self):
+    params = NestedMap(w=jnp.zeros(2))
+    opt = opt_lib.Accumulator.Params().Set(
+        optimizer_tpl=opt_lib.SGD.Params(), accum_steps=3).Instantiate()
+    state = opt.InitState(params)
+    g = NestedMap(w=jnp.ones(2) * 3.0)
+    for i in range(2):
+      params, state = opt.Update(state, g, params, 0.1, i)
+      np.testing.assert_allclose(params.w, 0.0)  # no update yet
+    params, state = opt.Update(state, g, params, 0.1, 2)
+    np.testing.assert_allclose(params.w, -0.3)  # mean grad 3.0 * lr 0.1
+    assert int(state.count) == 0
+
+  def test_composite_routes_by_regex(self):
+    params = NestedMap(
+        emb=NestedMap(w=jnp.ones(3)), body=NestedMap(w=jnp.ones(3)))
+    p = opt_lib.CompositeOptimizer.Params().Set(optimizer_map=[
+        (r"emb\.", opt_lib.SGD.Params(), 10.0),
+        (r".*", opt_lib.SGD.Params(), 1.0),
+    ])
+    opt = p.Instantiate()
+    state = opt.InitState(params)
+    g = params.Transform(jnp.ones_like)
+    new_params, _ = opt.Update(state, g, params, 0.01, 0)
+    np.testing.assert_allclose(new_params.emb.w, 1.0 - 0.1)  # 10x lr
+    np.testing.assert_allclose(new_params.body.w, 1.0 - 0.01)
+
+
+class TestLearner:
+
+  def _learner(self, **kw):
+    p = learner_lib.Learner.Params().Set(
+        name="learner", learning_rate=0.1,
+        optimizer=opt_lib.SGD.Params(), **kw)
+    return p.Instantiate()
+
+  def test_basic_apply(self):
+    lrn = self._learner()
+    theta = NestedMap(w=jnp.ones(3))
+    grads = NestedMap(w=jnp.ones(3))
+    state = lrn.InitState(theta)
+    new_theta, _, stats = lrn.Apply(theta, grads, 0, state)
+    np.testing.assert_allclose(new_theta.w, 0.9)
+    assert float(stats.grad_norm) == pytest.approx(np.sqrt(3), rel=1e-5)
+    assert float(stats.skipped_step) == 0.0
+
+  def test_nan_skip(self):
+    lrn = self._learner()
+    theta = NestedMap(w=jnp.ones(3))
+    grads = NestedMap(w=jnp.array([1.0, np.nan, 1.0]))
+    state = lrn.InitState(theta)
+    new_theta, _, stats = lrn.Apply(theta, grads, 0, state)
+    np.testing.assert_allclose(new_theta.w, 1.0)  # unchanged
+    assert float(stats.skipped_step) == 1.0
+
+  def test_global_norm_clip(self):
+    lrn = self._learner(clip_gradient_norm_to_value=1.0)
+    theta = NestedMap(w=jnp.zeros(4))
+    grads = NestedMap(w=jnp.ones(4) * 10)  # norm 20
+    state = lrn.InitState(theta)
+    new_theta, _, stats = lrn.Apply(theta, grads, 0, state)
+    # grads scaled to norm 1 -> each element 0.5; step = lr * 0.5
+    np.testing.assert_allclose(new_theta.w, -0.1 * 0.5, rtol=1e-5)
+
+  def test_clip_to_zero_rejects_outlier(self):
+    lrn = self._learner(grad_norm_to_clip_to_zero=5.0)
+    theta = NestedMap(w=jnp.ones(2))
+    state = lrn.InitState(theta)
+    ok_theta, _, _ = lrn.Apply(theta, NestedMap(w=jnp.ones(2)), 0, state)
+    assert not np.allclose(ok_theta.w, 1.0)
+    big_theta, _, stats = lrn.Apply(theta, NestedMap(w=jnp.ones(2) * 100), 0,
+                                    state)
+    np.testing.assert_allclose(big_theta.w, 1.0)
+    assert float(stats.skipped_step) == 1.0
+
+  def test_trainable_filter(self):
+    from lingvo_tpu.core.py_utils import WeightParams
+    lrn = self._learner(bprop_variable_exclusion=r"frozen")
+    assert lrn.TrainableFilter("model.body.w")
+    assert not lrn.TrainableFilter("model.frozen.w")
+    wp = WeightParams((2,), collections=("non_trainable",))
+    assert not lrn.TrainableFilter("model.bn.moving_mean", wp)
+
+  def test_lr_schedule_composition(self):
+    import lingvo_tpu.core.schedule as sched
+    lrn = self._learner(
+        lr_schedule=sched.PiecewiseConstant.Params().Set(
+            boundaries=[10], values=[1.0, 0.5]))
+    assert float(lrn.LearningRate(0)) == pytest.approx(0.1)
+    assert float(lrn.LearningRate(20)) == pytest.approx(0.05)
+
+  def test_jit_apply(self):
+    lrn = self._learner()
+    theta = NestedMap(w=jnp.ones(3))
+    state = lrn.InitState(theta)
+
+    @jax.jit
+    def step(theta, state, grads, i):
+      return lrn.Apply(theta, grads, i, state)
+
+    new_theta, new_state, stats = step(theta, state,
+                                       NestedMap(w=jnp.ones(3)), 0)
+    np.testing.assert_allclose(new_theta.w, 0.9)
