@@ -1,0 +1,304 @@
+"""BaseTask / BaseModel: the trainable unit and its container.
+
+Re-designs `lingvo/core/base_model.py` (`BaseTask:116`, `BaseModel:1138`)
+TPU-natively. A task still splits into `ComputePredictions` / `ComputeLoss`
+(ref `:465,:486`) returning a `metrics` NestedMap of (value, weight) pairs —
+but FProp is pure, BProp is replaced by a pure `TrainStep(state, batch)`
+built with `jax.value_and_grad` + the Learner, and EMA is a functional state
+field rather than assign ops (ref `ExecutorEma`, `base_model.py:69`).
+
+The train state is the single pytree that programs/checkpointers handle:
+  TrainState = NestedMap(step, theta, opt_states, ema_theta?)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import hyperparams
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class BaseTask(base_layer.BaseLayer):
+  """A trainable task: model graph + loss + (optional) decode logic."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input", None, "Input generator params for this task.")
+    tp = hyperparams.Params()
+    tp.Define("learner", learner_lib.Learner.Params(),
+              "Learner (or list of Learners, e.g. GAN).")
+    tp.Define("ema_decay", 0.0, "If >0, keep an EMA copy of theta.")
+    tp.Define("ema_decay_moving_vars", True,
+              "Whether EMA also covers non-trainable vars.")
+    tp.Define("start_up_delay_steps", 0, "Kept for parity; unused on TPU.")
+    tp.Define("max_steps", 4_000_000, "Training halts after this step.")
+    tp.Define("tpu_steps_per_loop", 100, "Device steps per host loop.")
+    tp.Define("save_interval_steps", 1000, "Checkpoint every N steps.")
+    tp.Define("save_max_to_keep", 10, "Checkpoints kept by GC.")
+    tp.Define("summary_interval_steps", 100, "Summary cadence.")
+    p.Define("train", tp, "Training hyperparams.")
+    ep = hyperparams.Params()
+    ep.Define("samples_per_summary", 1000, "Max eval examples per run.")
+    ep.Define("decoder_samples_per_summary", 0, "Decode sample count.")
+    p.Define("eval", ep, "Eval hyperparams.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    lp = p.train.learner
+    if isinstance(lp, (list, tuple)):
+      self.CreateChildren("learners", list(lp))
+    else:
+      self.CreateChildren("learners", [lp])
+    if p.input is not None:
+      self._input_params = p.input
+    else:
+      self._input_params = None
+
+  # ---- subclass points (ref base_model.py:465-486) -------------------------
+
+  def ComputePredictions(self, theta: NestedMap,
+                         input_batch: NestedMap) -> NestedMap:
+    raise NotImplementedError
+
+  def ComputeLoss(self, theta: NestedMap, predictions: NestedMap,
+                  input_batch: NestedMap) -> tuple[NestedMap, NestedMap]:
+    """Returns (metrics NestedMap of (value, weight), per_example NestedMap).
+
+    metrics must contain the learner's loss_name entry ('loss' by default).
+    """
+    raise NotImplementedError
+
+  def FProp(self, theta: NestedMap,
+            input_batch: NestedMap) -> tuple[NestedMap, NestedMap]:
+    predictions = self.ComputePredictions(theta, input_batch)
+    return self.ComputeLoss(theta, predictions, input_batch)
+
+  # ---- decode/inference hooks (ref base_model.py:918-1000) -----------------
+
+  def Decode(self, theta: NestedMap, input_batch: NestedMap) -> NestedMap:
+    """Returns per-example decode output tensors (device side)."""
+    raise NotImplementedError(f"{type(self).__name__}.Decode")
+
+  def CreateDecoderMetrics(self) -> dict:
+    """Host-side metric objects keyed by name."""
+    return {}
+
+  def PostProcessDecodeOut(self, decode_out: NestedMap,
+                           decoder_metrics: dict) -> None:
+    """Consumes one batch of (host) decode output into decoder_metrics."""
+
+  def DecodeFinalize(self, decoder_metrics: dict) -> dict[str, float]:
+    return {k: m.value for k, m in decoder_metrics.items()}
+
+  def Inference(self) -> dict:
+    """Returns {subgraph_name: (fn, example_inputs)} for export."""
+    raise NotImplementedError(f"{type(self).__name__}.Inference")
+
+  # ---- train state ---------------------------------------------------------
+
+  def CreateTrainState(self, key: jax.Array) -> NestedMap:
+    """Initializes theta + optimizer state + step counter (+ EMA)."""
+    theta = self.InstantiateVariables(key)
+    state = NestedMap(
+        step=jnp.zeros((), jnp.int32),
+        theta=theta,
+        opt_states=[lrn.InitState(self._TrainableSubset(theta, lrn))
+                    for lrn in self.learners],
+    )
+    if self.p.train.ema_decay > 0:
+      state.ema_theta = jax.tree_util.tree_map(lambda x: x, theta)
+    return state
+
+  def _VarPathsAndSpecs(self):
+    specs = self.VariableSpecs()
+    return specs.FlattenItems()
+
+  def _TrainableSubset(self, theta: NestedMap,
+                       lrn: learner_lib.Learner) -> NestedMap:
+    """Filters theta to this learner's trainable vars (structure-pruning)."""
+    specs = self.VariableSpecs()
+    flat_specs = dict(specs.FlattenItems())
+    return theta.FilterKeyVal(
+        lambda k, v: lrn.TrainableFilter(k, flat_specs.get(k)))
+
+  def _MergeSubset(self, theta: NestedMap, subset: NestedMap) -> NestedMap:
+    """Writes subset leaves back into a copy of theta."""
+    new_theta = theta.DeepCopy()
+    for k, v in subset.FlattenItems():
+      new_theta.Set(k, v)
+    return new_theta
+
+  def TrainStep(self, state: NestedMap, input_batch: NestedMap,
+                base_step_key: jax.Array | None = None
+                ) -> tuple[NestedMap, NestedMap]:
+    """One pure training step: returns (new_state, metrics+stats).
+
+    Jit/pjit this (or wrap in lax.scan over batches for steps_per_loop).
+    """
+    p = self.p
+    step_key = jax.random.fold_in(
+        base_step_key if base_step_key is not None else jax.random.PRNGKey(0),
+        state.step)
+
+    theta = state.theta
+    new_opt_states = []
+    all_stats = NestedMap()
+    metrics = per_example = None
+    fwd_updates: dict = {}
+    for i, lrn in enumerate(self.learners):
+
+      def _Loss(trainable, frozen_rest, lrn=lrn):
+        full_theta = self._MergeSubset(frozen_rest, trainable)
+        with py_utils.StepSeedContext(step_key):
+          with py_utils.ForwardStateContext() as fwd:
+            metrics_, per_example_ = self.FProp(full_theta, input_batch)
+        loss_val, _ = metrics_[lrn.p.loss_name]
+        reg = lrn.RegularizationLoss(trainable)
+        # fwd updates are tracers from this trace: they MUST exit via aux.
+        return jnp.asarray(loss_val, jnp.float32) + reg, (metrics_,
+                                                          per_example_, fwd)
+
+      trainable = self._TrainableSubset(theta, lrn)
+      (_, (metrics, per_example, fwd_updates)), grads = jax.value_and_grad(
+          _Loss, has_aux=True)(trainable, theta)
+      new_trainable, new_opt_state, stats = lrn.Apply(
+          trainable, grads, state.step, state.opt_states[i])
+      theta = self._MergeSubset(theta, new_trainable)
+      new_opt_states.append(new_opt_state)
+      prefix = f"{lrn.p.name}_" if len(self.learners) > 1 else ""
+      for k, v in stats.FlattenItems():
+        all_stats[f"{prefix}{k}"] = v
+
+    # Functional forward-state updates (BN moving stats).
+    if fwd_updates:
+      theta = py_utils.ApplyForwardStateUpdates(theta, fwd_updates, self)
+
+    new_state = NestedMap(
+        step=state.step + 1, theta=theta, opt_states=new_opt_states)
+    if "ema_theta" in state:
+      decay = jnp.minimum(
+          p.train.ema_decay,
+          (1.0 + state.step.astype(jnp.float32)) /
+          (10.0 + state.step.astype(jnp.float32)))
+      if p.train.ema_decay_moving_vars:
+        ema_mask = None
+      else:
+        # Static per-leaf mask: non_trainable vars (BN moving stats) track
+        # theta directly instead of being EMA-smoothed.
+        specs = dict(self.VariableSpecs().FlattenItems())
+        ema_mask = theta.TransformWithKey(
+            lambda k, v: "non_trainable" not in tuple(
+                getattr(specs.get(k), "collections", ()) or ()))
+      if ema_mask is None:
+        new_state.ema_theta = jax.tree_util.tree_map(
+            lambda e, t: e * decay + t.astype(e.dtype) * (1.0 - decay),
+            state.ema_theta, theta)
+      else:
+        new_state.ema_theta = jax.tree_util.tree_map(
+            lambda e, t, m: (e * decay + t.astype(e.dtype) *
+                             (1.0 - decay)) if m else t,
+            state.ema_theta, theta, ema_mask)
+    out_metrics = metrics.Copy() if metrics is not None else NestedMap()
+    out_metrics_stats = NestedMap(metrics=out_metrics, stats=all_stats,
+                                  per_example=per_example or NestedMap())
+    return new_state, out_metrics_stats
+
+  def EvalStep(self, theta: NestedMap,
+               input_batch: NestedMap) -> tuple[NestedMap, NestedMap]:
+    """One pure eval step (eval-mode FProp)."""
+    with py_utils.EvalContext():
+      return self.FProp(theta, input_batch)
+
+  # ---- input ---------------------------------------------------------------
+
+  def CreateInputGenerator(self):
+    if self._input_params is None:
+      raise ValueError(f"Task {self.p.name} has no input params")
+    return self._input_params.Instantiate()
+
+
+class BaseModel(base_layer.BaseLayer):
+  """Container of one or more tasks (ref base_model.py:1138)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("model", None, "Unused; parity slot.")
+    return p
+
+  def GetTask(self, task_name: str | None = None) -> BaseTask:
+    raise NotImplementedError
+
+  @property
+  def tasks(self) -> list[BaseTask]:
+    raise NotImplementedError
+
+
+class SingleTaskModel(BaseModel):
+  """Model with exactly one task (ref base_model.py:1379)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("task", None, "The task params.")
+    p.Define("input", None, "Input params (attached by registry).")
+    return p
+
+  def __init__(self, params):
+    if params.task is not None and params.input is not None:
+      if params.task.input is None:
+        params = params.Copy()
+        params.task.input = params.input
+    super().__init__(params)
+    self.CreateChild("_task", self.p.task)
+
+  def GetTask(self, task_name: str | None = None) -> BaseTask:
+    return self._task
+
+  @property
+  def tasks(self):
+    return [self._task]
+
+
+class MultiTaskModel(BaseModel):
+  """Model with several named tasks sampled by a schedule
+  (ref base_model.py:1480)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("task_params", None,
+             "Params with one sub-Params per task name.")
+    p.Define("task_probs", None,
+             "Params with one float per task name (sampling weights).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self._task_names = sorted(k for k, _ in p.task_params.IterParams())
+    for name, task_p in p.task_params.IterParams():
+      self.CreateChild(f"task_{name}", task_p)
+
+  @property
+  def task_names(self):
+    return list(self._task_names)
+
+  def GetTask(self, task_name: str | None = None) -> BaseTask:
+    if task_name is None:
+      task_name = self._task_names[0]
+    return self._children[f"task_{task_name}"]
+
+  @property
+  def tasks(self):
+    return [self.GetTask(n) for n in self._task_names]

@@ -1,0 +1,120 @@
+"""Checkpointing: save cadence policy + orbax-backed storage.
+
+Re-designs `lingvo/core/checkpointer.py` + `saver.py`: same policy surface —
+save-by-steps/secs (`ShouldSave:281`), restore-or-init (`Restore:354`),
+max_to_keep GC with keep_every_n (`saver.py:297`), saved-value sanity checks
+(`saver.py:64-95`), async saving (`saver.py:335`) — implemented over
+`orbax.checkpoint` which already speaks sharded jax.Array natively (the
+TPU-native replacement for the reference's graph-mode sharded Saver).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class Checkpointer:
+
+  def __init__(self,
+               train_dir: str,
+               save_interval_steps: int = 1000,
+               save_interval_seconds: int | None = None,
+               max_to_keep: int = 10,
+               keep_every_n_steps: int | None = None,
+               async_save: bool = True,
+               sanity_checks: bool = True):
+    import orbax.checkpoint as ocp
+    self._train_dir = os.path.abspath(train_dir)
+    os.makedirs(self._train_dir, exist_ok=True)
+    self._save_interval_steps = save_interval_steps
+    self._save_interval_seconds = save_interval_seconds
+    self._sanity_checks = sanity_checks
+    self._last_save_time = time.time()
+    self._last_save_step = -1
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        keep_period=keep_every_n_steps,
+        enable_async_checkpointing=async_save,
+    )
+    self._mgr = ocp.CheckpointManager(self._train_dir, options=options)
+
+  @property
+  def train_dir(self) -> str:
+    return self._train_dir
+
+  def ShouldSave(self, step: int) -> bool:
+    """Save cadence by steps or wallclock (ref checkpointer.py:281-312)."""
+    if step == self._last_save_step:
+      return False
+    if self._save_interval_seconds is not None:
+      return time.time() - self._last_save_time >= self._save_interval_seconds
+    return step % max(1, self._save_interval_steps) == 0
+
+  def _SanityCheck(self, state: NestedMap) -> None:
+    """All saved floats must be finite (ref saver.py IsFinite checks).
+
+    Fast path: one device-side all-finite reduce -> one scalar transfer.
+    Only on failure do we walk leaves host-side to name the offender.
+    """
+    if bool(py_utils.IsFinite(state)):
+      return
+    for path, leaf in state.FlattenItems():
+      arr = np.asarray(leaf)
+      if np.issubdtype(arr.dtype, np.floating) and not np.all(
+          np.isfinite(arr)):
+        raise ValueError(
+            f"Checkpoint sanity check failed: non-finite values in {path}")
+    raise ValueError("Checkpoint sanity check failed: non-finite values")
+
+  def Save(self, step: int, state: NestedMap, force: bool = False) -> bool:
+    """Saves if the policy says so (or force). Returns True if saved."""
+    if not force and not self.ShouldSave(step):
+      return False
+    if self._sanity_checks:
+      self._SanityCheck(state)
+    import orbax.checkpoint as ocp
+    self._mgr.save(step, args=ocp.args.StandardSave(dict(state)))
+    self._last_save_time = time.time()
+    self._last_save_step = step
+    return True
+
+  def LatestStep(self) -> int | None:
+    return self._mgr.latest_step()
+
+  def Restore(self, state_template: NestedMap,
+              step: int | None = None) -> tuple[NestedMap, int]:
+    """Restore-or-init: returns (state, start_step).
+
+    If no checkpoint exists, returns the template unchanged with step 0
+    (ref Restore:354 'restore or init' semantics).
+    """
+    import orbax.checkpoint as ocp
+    target = step if step is not None else self._mgr.latest_step()
+    if target is None:
+      return state_template, 0
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        if not isinstance(x, jax.Array) else
+        jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        dict(state_template))
+    restored = self._mgr.restore(
+        target, args=ocp.args.StandardRestore(abstract))
+    state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(state_template),
+        jax.tree_util.tree_leaves(restored))
+    return state, int(target)
+
+  def WaitUntilFinished(self) -> None:
+    self._mgr.wait_until_finished()
+
+  def Close(self) -> None:
+    self._mgr.wait_until_finished()
+    self._mgr.close()

@@ -450,7 +450,9 @@ class Conv2DLayer(base_layer.BaseLayer):
     out = self._Conv(x, th.w)
     out_paddings = None
     if paddings is not None:
-      out_paddings = _StridedPaddings(paddings, p.filter_stride[0])
+      # Derive from ACTUAL output length (VALID is shorter than t/stride).
+      out_paddings = _StridedPaddings(paddings, p.filter_stride[0],
+                                      out.shape[1])
     if p.has_bias:
       out = out + th.b
     if p.batch_norm:
@@ -463,10 +465,14 @@ class Conv2DLayer(base_layer.BaseLayer):
     return out
 
 
-def _StridedPaddings(paddings, stride):
-  if stride == 1:
-    return paddings
-  return paddings[:, ::stride]
+def _StridedPaddings(paddings, stride, out_len=None):
+  """Paddings for a strided (conv/pool) output: window-start positions,
+  trimmed to the op's actual output length (VALID < SAME)."""
+  out = paddings if stride == 1 else paddings[:, ::stride]
+  if out_len is not None:
+    assert out.shape[1] >= out_len, (out.shape, out_len)
+    out = out[:, :out_len]
+  return out
 
 
 class DepthwiseConv2DLayer(Conv2DLayer):
@@ -508,7 +514,8 @@ class MaxPoolLayer(base_layer.BaseLayer):
         (1,) + tuple(p.window_shape) + (1,),
         (1,) + tuple(p.window_stride) + (1,), p.padding)
     if paddings is not None:
-      out_paddings = _StridedPaddings(paddings, p.window_stride[0])
+      out_paddings = _StridedPaddings(paddings, p.window_stride[0],
+                                      out.shape[1])
       out = py_utils.ApplyPadding(out_paddings, out)
       return out, out_paddings
     return out

@@ -1,0 +1,242 @@
+"""Metrics: on-device weighted accumulators + host-side rich metrics.
+
+Re-designs `lingvo/core/metrics.py`: the on-device pattern is the reference's
+`TpuEvalMetrics` (`metrics.py:258`) — fixed-shape (value, weight) pairs
+accumulated across the device loop; under data parallelism GSPMD inserts the
+cross-replica sums the reference did by hand (`metrics.py:351`). Host-side
+metrics (Average, F1, BLEU-style corpus metrics) consume outfed per-example
+tensors.
+
+Convention (same as the reference): a task's FProp returns
+`metrics = NestedMap(name=(value, weight), ...)` where `value` is the
+weighted mean over examples and `weight` the example count/token count.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def AccumulateMetrics(acc: NestedMap | None, metrics: NestedMap) -> NestedMap:
+  """Folds one step's (value, weight) metrics into a weighted accumulator.
+
+  Accumulator entry per metric: [weighted_value_sum, weight_sum] (f32[2]),
+  fixed-shape so it lives inside jit/scan (ref TpuEvalMetrics packing).
+  """
+  out = NestedMap()
+  for k in metrics.keys():
+    v, w = metrics[k]
+    pair = jnp.stack([jnp.asarray(v, jnp.float32) * jnp.asarray(w, jnp.float32),
+                      jnp.asarray(w, jnp.float32)])
+    out[k] = pair if acc is None else acc[k] + pair
+  return out
+
+
+def FinalizeMetrics(acc: NestedMap) -> dict[str, float]:
+  """Converts accumulators to {name: weighted mean} floats (host side)."""
+  out = {}
+  for k in sorted(acc.keys()):
+    pair = np.asarray(acc[k])
+    out[k] = float(pair[0] / max(pair[1], 1e-8))
+  return out
+
+
+def _MetricKeys(metrics: NestedMap):
+  return [k for k in metrics.keys()]
+
+
+class BaseMetric:
+
+  @property
+  def value(self) -> float:
+    raise NotImplementedError
+
+  def Summary(self, name: str) -> dict[str, float]:
+    return {name: self.value}
+
+
+class AverageMetric(BaseMetric):
+  """Weighted average (`metrics.py:79`)."""
+
+  def __init__(self):
+    self._total = 0.0
+    self._weight = 0.0
+
+  def Update(self, value: float, weight: float = 1.0):
+    self._total += value * weight
+    self._weight += weight
+
+  @property
+  def total_value(self):
+    return self._total
+
+  @property
+  def total_weight(self):
+    return self._weight
+
+  @property
+  def value(self) -> float:
+    return self._total / self._weight if self._weight > 0 else 0.0
+
+
+class UniqueAverageMetric(AverageMetric):
+  """Average that de-dups by key (`metrics.py` UniqueAverageMetric)."""
+
+  def __init__(self):
+    super().__init__()
+    self._seen = set()
+
+  def Update(self, key: str, value: float, weight: float = 1.0):  # type: ignore[override]
+    if key in self._seen:
+      return
+    self._seen.add(key)
+    super().Update(value, weight)
+
+
+class F1Metric(BaseMetric):
+  """F1 from TP/FP/FN counts (`metrics.py` F1Metric)."""
+
+  def __init__(self):
+    self._tp = self._fp = self._fn = 0.0
+
+  def UpdateTruePositive(self, count: float = 1.0):
+    self._tp += count
+
+  def UpdateFalsePositive(self, count: float = 1.0):
+    self._fp += count
+
+  def UpdateFalseNegative(self, count: float = 1.0):
+    self._fn += count
+
+  @property
+  def value(self) -> float:
+    precision = self._tp / max(self._tp + self._fp, 1e-8)
+    recall = self._tp / max(self._tp + self._fn, 1e-8)
+    if precision + recall == 0:
+      return 0.0
+    return 2 * precision * recall / (precision + recall)
+
+
+class MCCMetric(BaseMetric):
+  """Matthews correlation coefficient (`metrics.py` MCCMetric)."""
+
+  def __init__(self):
+    self._tp = self._fp = self._tn = self._fn = 0.0
+
+  def UpdateTruePositive(self, count=1.0):
+    self._tp += count
+
+  def UpdateFalsePositive(self, count=1.0):
+    self._fp += count
+
+  def UpdateTrueNegative(self, count=1.0):
+    self._tn += count
+
+  def UpdateFalseNegative(self, count=1.0):
+    self._fn += count
+
+  @property
+  def value(self) -> float:
+    num = self._tp * self._tn - self._fp * self._fn
+    den = math.sqrt((self._tp + self._fp) * (self._tp + self._fn) *
+                    (self._tn + self._fp) * (self._tn + self._fn))
+    return num / den if den else 0.0
+
+
+class CorpusBleuMetric(BaseMetric):
+  """Corpus BLEU over (ref, hyp) token streams (`metrics.py:240`,
+  `scorers.py`)."""
+
+  def __init__(self, max_order: int = 4):
+    self._max_order = max_order
+    self._matches = [0] * max_order
+    self._possible = [0] * max_order
+    self._ref_len = 0
+    self._hyp_len = 0
+
+  @staticmethod
+  def _Ngrams(tokens, order):
+    from collections import Counter
+    return Counter(
+        tuple(tokens[i:i + order]) for i in range(len(tokens) - order + 1))
+
+  def Update(self, ref: str | list, hyp: str | list):
+    ref_toks = ref.split() if isinstance(ref, str) else list(ref)
+    hyp_toks = hyp.split() if isinstance(hyp, str) else list(hyp)
+    self._ref_len += len(ref_toks)
+    self._hyp_len += len(hyp_toks)
+    for order in range(1, self._max_order + 1):
+      ref_ngrams = self._Ngrams(ref_toks, order)
+      hyp_ngrams = self._Ngrams(hyp_toks, order)
+      overlap = sum((ref_ngrams & hyp_ngrams).values())
+      self._matches[order - 1] += overlap
+      self._possible[order - 1] += max(len(hyp_toks) - order + 1, 0)
+
+  @property
+  def value(self) -> float:
+    precisions = []
+    for m, p in zip(self._matches, self._possible):
+      if p == 0:
+        return 0.0
+      if m == 0:
+        return 0.0
+      precisions.append(m / p)
+    log_avg = sum(math.log(p) for p in precisions) / self._max_order
+    bp = 1.0
+    if self._hyp_len < self._ref_len and self._hyp_len > 0:
+      bp = math.exp(1.0 - self._ref_len / self._hyp_len)
+    return bp * math.exp(log_avg)
+
+
+class AUCMetric(BaseMetric):
+  """Streaming ROC-AUC via rank statistic (`metrics.py:461`)."""
+
+  def __init__(self):
+    self._pos_scores: list[float] = []
+    self._neg_scores: list[float] = []
+
+  def Update(self, label: int, prob: float):
+    (self._pos_scores if label else self._neg_scores).append(prob)
+
+  @property
+  def value(self) -> float:
+    pos, neg = self._pos_scores, self._neg_scores
+    if not pos or not neg:
+      return 0.0
+    scores = sorted((s, 1) for s in pos) + sorted((s, 0) for s in neg)
+    scores.sort(key=lambda x: x[0])
+    rank_sum = 0.0
+    for rank, (_, label) in enumerate(scores, start=1):
+      if label:
+        rank_sum += rank
+    n_pos, n_neg = len(pos), len(neg)
+    return (rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+class CorrelationMetric(BaseMetric):
+  """Pearson correlation (`metrics.py:652`)."""
+
+  def __init__(self):
+    self._xs: list[float] = []
+    self._ys: list[float] = []
+
+  def Update(self, x: float, y: float):
+    self._xs.append(x)
+    self._ys.append(y)
+
+  @property
+  def value(self) -> float:
+    if len(self._xs) < 2:
+      return 0.0
+    x = np.asarray(self._xs)
+    y = np.asarray(self._ys)
+    denom = x.std() * y.std()
+    if denom == 0:
+      return 0.0
+    return float(((x - x.mean()) * (y - y.mean())).mean() / denom)

@@ -193,12 +193,19 @@ class NestedMap(dict):
       if isinstance(node, (list, tuple)):
         if hasattr(node, "_fields"):  # namedtuple: all-or-nothing leaf
           return node if fn(prefix, node) else _PRUNE
+        # Preserve arity: pruned elements become None placeholders so indices
+        # in the filtered tree still correspond to the original tree (needed
+        # for trainable-subset <-> full-theta merges).
         out_l = []
+        any_kept = False
         for i, v in enumerate(node):
           sub = _Recurse(v, f"{prefix}[{i}]")
-          if sub is not _PRUNE:
+          if sub is _PRUNE:
+            out_l.append(None)
+          else:
+            any_kept = True
             out_l.append(sub)
-        if not out_l:
+        if not any_kept:
           return _PRUNE
         return type(node)(out_l) if isinstance(node, tuple) else out_l
       return node if fn(prefix, node) else _PRUNE

@@ -1,0 +1,20 @@
+"""Imports every params module so the registry is fully populated.
+
+Ref: `lingvo/model_imports.py` — here a static import list (cheap; the
+dynamic per-prefix import in model_registry handles the common CLI path).
+"""
+
+from lingvo_tpu.models.image.params import mnist  # noqa: F401
+
+try:
+  from lingvo_tpu.models.lm.params import synthetic_packed_input  # noqa: F401
+except ImportError:
+  pass
+try:
+  from lingvo_tpu.models.lm.params import one_billion_wds  # noqa: F401
+except ImportError:
+  pass
+try:
+  from lingvo_tpu.models.mt.params import wmt14_en_de  # noqa: F401
+except ImportError:
+  pass

@@ -1,0 +1,96 @@
+"""Image classifier task (ref: lingvo/tasks/image/classifier.py).
+
+`ModelV2`-style: conv tower + FC + softmax over [b, h, w, c] images with
+integer labels. The canonical config is LeNet5 on MNIST
+(ref `tasks/image/params/mnist.py:46`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import layers
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class BaseClassifier(base_model.BaseTask):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("softmax", layers.SimpleFullSoftmax.Params(), "Softmax tpl.")
+    p.Define("dropout_prob", 0.0, "Dropout before softmax.")
+    return p
+
+  def _AddAccuracyMetrics(self, metrics, logits, labels, weight):
+    acc1 = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    top5 = jax.lax.top_k(logits, min(5, logits.shape[-1]))[1]
+    acc5 = jnp.mean(
+        jnp.any(top5 == labels[:, None], axis=-1).astype(jnp.float32))
+    metrics.accuracy = (acc1, weight)
+    metrics.acc5 = (acc5, weight)
+    return metrics
+
+
+class ModelV2(BaseClassifier):
+  """Conv tower classifier (ref classifier.py ModelV2)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("extract", None, "Conv feature extractor params list.")
+    p.Define("label_smoothing", 0.0, "Label smoothing.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChildren("extract", list(p.extract or []))
+    self.CreateChild("softmax", p.softmax)
+    if p.dropout_prob > 0:
+      self.CreateChild("dropout",
+                       layers.DeterministicDropoutLayer.Params().Set(
+                           keep_prob=1.0 - p.dropout_prob))
+
+  def ComputePredictions(self, theta, input_batch):
+    p = self.p
+    x = input_batch.image
+    for i, layer in enumerate(self.extract):
+      out = layer.FProp(theta.extract[i], x)
+      x = out[0] if isinstance(out, tuple) else out
+    x = x.reshape(x.shape[0], -1)
+    if p.dropout_prob > 0:
+      x = self.dropout.FProp(self.ChildTheta(theta, "dropout"), x)
+    xent = self.softmax.FProp(
+        theta.softmax, x, class_ids=input_batch.label,
+        label_smoothing=p.label_smoothing)
+    return NestedMap(logits=xent.logits, per_example_xent=xent.per_example_xent)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    batch = predictions.per_example_xent.shape[0]
+    loss = jnp.mean(predictions.per_example_xent)
+    metrics = NestedMap(
+        loss=(loss, float(batch)),
+        log_pplx=(loss, float(batch)))
+    self._AddAccuracyMetrics(metrics, predictions.logits, input_batch.label,
+                             float(batch))
+    per_example = NestedMap(xent=predictions.per_example_xent)
+    return metrics, per_example
+
+  def Decode(self, theta, input_batch):
+    preds = self.ComputePredictions(theta, input_batch)
+    return NestedMap(
+        predicted=jnp.argmax(preds.logits, -1),
+        label=input_batch.label)
+
+  def CreateDecoderMetrics(self):
+    from lingvo_tpu.core import metrics as metrics_lib
+    return {"accuracy": metrics_lib.AverageMetric()}
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    import numpy as np
+    correct = (decode_out.predicted == decode_out.label).astype("float32")
+    decoder_metrics["accuracy"].Update(float(correct.mean()),
+                                       len(decode_out.label))

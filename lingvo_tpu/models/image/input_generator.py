@@ -1,0 +1,72 @@
+"""MNIST-style input: real-file loader + synthetic fallback.
+
+Ref `lingvo/tasks/image/input_generator.py` + `BaseTinyDatasetInput`
+(`base_input_generator.py:1706`): the reference reads a ckpt of MNIST arrays
+prepared by `keras2ckpt.py`. Here: `MnistFileInput` loads an .npz with the
+same contents; `SyntheticMnistInput` procedurally generates a learnable
+10-class digit-like dataset (class prototypes + noise) for hermetic tests and
+benchmarks with no data egress.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def _MakeSyntheticMnist(n: int, seed: int = 0, proto_seed: int = 0):
+  """10 fixed prototypes (28x28) + noise; labels recoverable => learnable.
+
+  Prototypes depend only on proto_seed so train/test splits share the same
+  class structure; `seed` drives the per-split sampling noise.
+  """
+  protos = np.random.RandomState(proto_seed).rand(10, 28, 28, 1).astype(
+      np.float32)
+  rng = np.random.RandomState(seed + 1000003)
+  labels = rng.randint(0, 10, n).astype(np.int32)
+  images = protos[labels] + 0.3 * rng.randn(n, 28, 28, 1).astype(np.float32)
+  return images.astype(np.float32), labels
+
+
+class SyntheticMnistInput(base_input_generator.InMemoryInputGenerator):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.batch_size = 128
+    p.num_samples = 5000
+    p.Define("data_seed", 0, "Prototype/noise seed.")
+    return p
+
+  def __init__(self, params):
+    params = params.Copy()
+    images, labels = _MakeSyntheticMnist(params.num_samples, params.data_seed)
+    params.data = NestedMap(image=images, label=labels)
+    super().__init__(params)
+
+
+class MnistFileInput(base_input_generator.InMemoryInputGenerator):
+  """Loads an npz with arrays image [N,28,28,1] float32 and label [N] int32."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.batch_size = 128
+    p.Define("ckpt", "", "Path to .npz file.")
+    p.Define("split", "train", "train|test arrays prefix in the npz.")
+    return p
+
+  def __init__(self, params):
+    params = params.Copy()
+    blob = np.load(params.ckpt)
+    images = blob[f"{params.split}_images"].astype(np.float32)
+    if images.ndim == 3:
+      images = images[..., None]
+    if images.max() > 1.5:
+      images = images / 255.0
+    labels = blob[f"{params.split}_labels"].astype(np.int32)
+    params.data = NestedMap(image=images, label=labels)
+    params.num_samples = len(labels)
+    super().__init__(params)

@@ -1,0 +1,54 @@
+"""MNIST experiment configs (ref: lingvo/tasks/image/params/mnist.py:46)."""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import layers
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.models.image import classifier
+from lingvo_tpu.models.image import input_generator
+
+
+@model_registry.RegisterSingleTaskModel
+class LeNet5(base_model_params.SingleTaskModelParams):
+  """LeNet-5 on (synthetic) MNIST; target: loss <0.3, acc >= 0.94."""
+
+  BATCH_SIZE = 128
+
+  def Train(self):
+    return input_generator.SyntheticMnistInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_samples=50000, data_seed=0)
+
+  def Test(self):
+    return input_generator.SyntheticMnistInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_samples=5000, data_seed=1,
+        shuffle=False, repeat=False, require_sequential_order=True)
+
+  def Task(self):
+    p = classifier.ModelV2.Params()
+    p.name = "lenet5"
+    # Conv tower: 5x5x20 -> pool -> 5x5x50 -> pool (classic LeNet5 shapes).
+    p.extract = [
+        layers.Conv2DLayer.Params().Set(
+            filter_shape=(5, 5, 1, 20), filter_stride=(1, 1),
+            activation="RELU", batch_norm=False, has_bias=True),
+        layers.MaxPoolLayer.Params().Set(
+            window_shape=(2, 2), window_stride=(2, 2)),
+        layers.Conv2DLayer.Params().Set(
+            filter_shape=(5, 5, 20, 50), filter_stride=(1, 1),
+            activation="RELU", batch_norm=False, has_bias=True),
+        layers.MaxPoolLayer.Params().Set(
+            window_shape=(2, 2), window_stride=(2, 2)),
+    ]
+    p.softmax = layers.SimpleFullSoftmax.Params().Set(
+        input_dim=7 * 7 * 50, num_classes=10)
+    p.dropout_prob = 0.2
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3, optimizer=opt_lib.Adam.Params(),
+        clip_gradient_norm_to_value=1.0)
+    p.train.tpu_steps_per_loop = 20
+    p.train.max_steps = 400
+    p.train.save_interval_steps = 200
+    return p

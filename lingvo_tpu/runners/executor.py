@@ -1,0 +1,105 @@
+"""ExecutorTpu: the training driver loop.
+
+Re-designs `lingvo/executor.py` (`ExecutorTpu:161`): owns the train state,
+checkpointer, and program schedule; the main loop interleaves
+checkpoint-save/restore with program-schedule runs and exports metrics. TPU
+system init / device assignment collapses to jax device discovery; program
+compilation is jit's AOT lower+compile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+import jax
+
+from lingvo_tpu.core import checkpointer as checkpointer_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class ExecutorTpu:
+
+  def __init__(self, model_params, logdir: str, schedule=None, task=None,
+               init_seed: int = 1234, precompile: bool = False):
+    """model_params: SingleTaskModel-style params (task + input attached).
+
+    If `task` is given (e.g. the instance shared with the program schedule),
+    it is used directly instead of instantiating a duplicate model.
+    """
+    self._logdir = logdir
+    os.makedirs(logdir, exist_ok=True)
+    if task is not None:
+      self._task = task
+    else:
+      self._model = model_params.Instantiate()
+      self._task = self._model.GetTask()
+    self._task.FinalizePaths()
+    # Serialize the full experiment config for reproducibility
+    # (ref executor.py:233-237 trainer_params.txt).
+    with open(os.path.join(logdir, "trainer_params.txt"), "w") as f:
+      f.write(model_params.ToText())
+    self._WriteModelAnalysis()
+
+    tp = self._task.p.train
+    self._checkpointer = checkpointer_lib.Checkpointer(
+        os.path.join(logdir, "train"),
+        save_interval_steps=tp.save_interval_steps,
+        max_to_keep=tp.save_max_to_keep)
+    self._schedule = schedule
+    self._init_seed = init_seed
+    self._precompile = precompile
+    self._max_steps = tp.max_steps
+
+  @property
+  def task(self):
+    return self._task
+
+  @property
+  def checkpointer(self):
+    return self._checkpointer
+
+  def _WriteModelAnalysis(self):
+    """Param-count report (ref summary_utils.ModelAnalysis:432)."""
+    lines = []
+    total = 0
+    for path, wp in self._task.VariableSpecs().FlattenItems():
+      import numpy as np
+      n = int(np.prod(wp.shape)) if wp.shape else 1
+      total += n
+      lines.append(f"{path:<60} {str(tuple(wp.shape)):<20} {n}")
+    lines.append(f"{'TOTAL':<60} {'':<20} {total}")
+    with open(os.path.join(self._logdir, "model_analysis.txt"), "w") as f:
+      f.write("\n".join(lines) + "\n")
+
+  def Start(self) -> NestedMap:
+    """Runs the main loop until max_steps; returns the final state."""
+    state = self._task.CreateTrainState(jax.random.PRNGKey(self._init_seed))
+    state, start_step = self._checkpointer.Restore(state)
+    if self._precompile and self._schedule is not None:
+      for prog in self._schedule.programs:
+        prog.Compile(state)
+
+    step = start_step
+    while step < self._max_steps:
+      if self._checkpointer.ShouldSave(step):
+        self._checkpointer.Save(step, state)
+      state, results = self._schedule.Run(state)
+      step = int(jax.device_get(state.step))
+      self._ExportMetrics(step, results)
+    self._checkpointer.Save(step, state, force=True)
+    self._checkpointer.Close()
+    return state
+
+  def _ExportMetrics(self, step: int, results: dict[str, Any]):
+    path = os.path.join(self._logdir, "metrics.jsonl")
+    with open(path, "a") as f:
+      f.write(json.dumps({"step": step, **results}, default=float) + "\n")
+    summary = {k: v.get("loss", v.get("steps_per_second"))
+               for k, v in results.items() if isinstance(v, dict)}
+    print(f"[executor] step={step} " +
+          " ".join(f"{k}={v:.4g}" for k, v in summary.items()
+                   if v is not None), flush=True)

@@ -1,0 +1,309 @@
+"""Programs: jit-compiled train/eval/decode units + their schedule.
+
+Re-designs `lingvo/core/program.py` (2.9k LoC). The reference builds TF graphs
+with on-device `steps_per_loop` repeats, infeed/outfeed queues and
+`tpu.split_compile_and_shard`; here each program owns a jit'd step function
+(optionally pjit over a mesh), a host loop that feeds device_put batches, and
+weighted metric accumulators (ref `TpuEvalMetrics`). `SimpleProgramSchedule`
+(ref `program.py:2329`) time-slices train/eval/decode on the same chips.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import hyperparams
+from lingvo_tpu.core import metrics as metrics_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class BaseProgram:
+  """Shared program machinery (ref BaseProgram, program.py:75)."""
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", "", "Program name (logdir subdir).")
+    p.Define("task", None, "Task params.")
+    p.Define("logdir", "", "Run log directory.")
+    p.Define("steps_per_loop", 100, "Steps per Run() invocation.")
+    p.Define("dataset_name", "Train", "Which dataset this program consumes.")
+    p.Define("mesh", None, "Optional jax Mesh for sharded execution.")
+    p.Define("input_sharding", None, "PartitionSpec for input batches.")
+    p.Define("state_sharding_fn", None,
+             "fn(state_template)->sharding pytree (pjit).")
+    return p
+
+  def __init__(self, params, task=None, input_generator=None):
+    self.p = params.Copy()
+    self._task = task if task is not None else params.task.Instantiate()
+    self._input = input_generator
+    self._program_dir = os.path.join(self.p.logdir,
+                                     self.p.name or type(self).__name__)
+    os.makedirs(self._program_dir, exist_ok=True)
+    self._step_fn = None
+
+  @property
+  def task(self):
+    return self._task
+
+  @property
+  def input_generator(self):
+    if self._input is None:
+      ip = self.p.task.input
+      if ip is None:
+        raise ValueError(f"Program {self.p.name}: no input params")
+      self._input = ip.Instantiate()
+    return self._input
+
+  def _PutBatch(self, batch: NestedMap) -> NestedMap:
+    """Host batch -> device array(s), honoring the input sharding."""
+    if self.p.mesh is not None and self.p.input_sharding is not None:
+      sharding = jax.sharding.NamedSharding(self.p.mesh,
+                                            self.p.input_sharding)
+      return batch.Transform(
+          lambda x: jax.device_put(jnp.asarray(x), sharding))
+    return batch.Transform(jnp.asarray)
+
+  def Compile(self, state: NestedMap) -> None:
+    """Ahead-of-time compile with a real batch (ref Compile:355)."""
+    batch = self._PutBatch(self.input_generator.GetPreprocessedInputBatch())
+    fn = self._GetStepFn()
+    if hasattr(fn, "lower"):
+      fn.lower(state, batch).compile()
+
+  def _GetStepFn(self):
+    raise NotImplementedError
+
+  def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    raise NotImplementedError
+
+  def SaveProgramState(self) -> dict:
+    return {}
+
+  def LoadProgramState(self, blob: dict) -> None:
+    pass
+
+  def WriteSummaries(self, step: int, values: dict[str, float]) -> None:
+    path = os.path.join(self._program_dir, "summaries.jsonl")
+    with open(path, "a") as f:
+      f.write(json.dumps({"step": step, **values}) + "\n")
+
+
+class TrainProgram(BaseProgram):
+  """steps_per_loop training steps per Run (ref TrainProgram:441).
+
+  The jit'd unit is a single TrainStep; the host loop feeds batches and
+  donates the state buffers so theta/opt-state update in place on device.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.name = "train"
+    p.Define("base_step_seed", 1234, "Base PRNG seed for step seeds.")
+    return p
+
+  def _GetStepFn(self, state: NestedMap | None = None):
+    if self._step_fn is None:
+      key = jax.random.PRNGKey(self.p.base_step_seed)
+      state_shardings = None
+      if (self.p.mesh is not None and self.p.state_sharding_fn is not None and
+          state is not None):
+        state_shardings = self.p.state_sharding_fn(state)
+
+      def _Step(state, batch):
+        if state_shardings is not None:
+          state = jax.lax.with_sharding_constraint(state, state_shardings)
+        new_state, out = self._task.TrainStep(state, batch, key)
+        if state_shardings is not None:
+          new_state = jax.lax.with_sharding_constraint(new_state,
+                                                       state_shardings)
+        return new_state, out
+
+      self._step_fn = jax.jit(_Step, donate_argnums=(0,))
+    return self._step_fn
+
+  def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    p = self.p
+    fn = self._GetStepFn(state)
+    acc = None
+    stats_acc = None
+    t0 = time.time()
+    for _ in range(p.steps_per_loop):
+      batch = self._PutBatch(self.input_generator.GetPreprocessedInputBatch())
+      state, out = fn(state, batch)
+      acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
+      stats_pairs = NestedMap(
+          {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+      stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
+    # One host sync per loop (ref: one session.run per steps_per_loop).
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    wall = time.time() - t0
+    result = metrics_lib.FinalizeMetrics(acc) if acc else {}
+    if stats_acc:
+      result.update(metrics_lib.FinalizeMetrics(stats_acc))
+    result["steps_per_second"] = p.steps_per_loop / wall
+    result["examples_per_second"] = (
+        p.steps_per_loop * self.input_generator.GlobalBatchSize() / wall)
+    step = int(jax.device_get(state.step))
+    self.WriteSummaries(step, result)
+    return state, result
+
+
+class EvalProgram(BaseProgram):
+  """Whole-dataset eval with fixed-shape metric accumulation
+  (ref EvalProgram:995)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.name = "eval"
+    p.dataset_name = "Test"
+    p.Define("use_ema", True, "Eval with EMA weights when available.")
+    return p
+
+  def _GetStepFn(self):
+    if self._step_fn is None:
+
+      def _Step(theta, batch):
+        metrics, _ = self._task.EvalStep(theta, batch)
+        return metrics
+
+      self._step_fn = jax.jit(_Step)
+    return self._step_fn
+
+  def _EvalTheta(self, state: NestedMap) -> NestedMap:
+    if self.p.use_ema and "ema_theta" in state:
+      return state.ema_theta
+    return state.theta
+
+  def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    fn = self._GetStepFn()
+    theta = self._EvalTheta(state)
+    acc = None
+    gen = self.input_generator
+    batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
+               else _TakeN(gen, self.p.steps_per_loop))
+    n = 0
+    for batch in batches:
+      out = fn(theta, self._PutBatch(batch))
+      acc = metrics_lib.AccumulateMetrics(acc, out)
+      n += 1
+      if n >= self.p.steps_per_loop:
+        break
+    result = metrics_lib.FinalizeMetrics(acc) if acc else {}
+    step = int(jax.device_get(state.step))
+    self.WriteSummaries(step, result)
+    return state, result
+
+
+class DecodeProgram(BaseProgram):
+  """Device decode + host postprocess into decoder metrics
+  (ref DecodeProgram:1229)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.name = "decode"
+    p.dataset_name = "Test"
+    p.Define("use_ema", True, "Decode with EMA weights when available.")
+    return p
+
+  def _GetStepFn(self):
+    if self._step_fn is None:
+
+      def _Step(theta, batch):
+        with py_utils.EvalContext():
+          return self._task.Decode(theta, batch)
+
+      self._step_fn = jax.jit(_Step)
+    return self._step_fn
+
+  def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    fn = self._GetStepFn()
+    theta = (state.ema_theta
+             if self.p.use_ema and "ema_theta" in state else state.theta)
+    dec_metrics = self._task.CreateDecoderMetrics()
+    gen = self.input_generator
+    batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
+               else _TakeN(gen, self.p.steps_per_loop))
+    n = 0
+    for batch in batches:
+      out = fn(theta, self._PutBatch(batch))
+      host_out = jax.tree_util.tree_map(np.asarray, out)
+      self._task.PostProcessDecodeOut(host_out, dec_metrics)
+      n += 1
+      if n >= self.p.steps_per_loop:
+        break
+    result = self._task.DecodeFinalize(dec_metrics)
+    step = int(jax.device_get(state.step))
+    self.WriteSummaries(step, result)
+    return state, result
+
+
+def _TakeN(gen, n):
+  it = iter(gen)
+  for _ in range(n):
+    try:
+      yield next(it)
+    except StopIteration:
+      return
+
+
+class SimpleProgramSchedule:
+  """Train K loops, then run eval/decode programs
+  (ref SimpleProgramSchedule:2329)."""
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", "schedule", "Name.")
+    p.Define("train_program", None, "TrainProgram params (or None).")
+    p.Define("eval_programs", [], "List of eval/decode program params.")
+    p.Define("train_executions_per_eval", 1,
+             "Train Run() calls between eval rounds.")
+    return p
+
+  def __init__(self, params, task=None, input_generators=None):
+    self.p = params.Copy()
+    input_generators = input_generators or {}
+    self.train_program = None
+    if self.p.train_program is not None:
+      self.train_program = self.p.train_program.cls(
+          self.p.train_program, task=task,
+          input_generator=input_generators.get(
+              self.p.train_program.dataset_name))
+    self.eval_programs = [
+        ep.cls(ep, task=task,
+               input_generator=input_generators.get(ep.dataset_name))
+        for ep in self.p.eval_programs
+    ]
+
+  @property
+  def programs(self):
+    out = []
+    if self.train_program:
+      out.append(self.train_program)
+    return out + list(self.eval_programs)
+
+  def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, Any]]:
+    results: dict[str, Any] = {}
+    if self.train_program is not None:
+      for _ in range(self.p.train_executions_per_eval):
+        state, train_result = self.train_program.Run(state)
+      results["train"] = train_result
+    for ep in self.eval_programs:
+      state, r = ep.Run(state)
+      results[ep.p.name] = r
+    return state, results

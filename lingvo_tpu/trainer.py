@@ -1,0 +1,127 @@
+"""Trainer CLI: the operator entry point.
+
+Re-designs `lingvo/trainer.py`: `--model` selects a registered experiment,
+`--mode` picks train/eval/decode/inspect, `--logdir` receives config +
+analysis + summaries. The runner/job-thread machinery of the reference
+collapses into the executor (single-program SPMD: every chip runs the same
+program; multi-host launches run this same binary per host).
+
+Usage:
+  python -m lingvo_tpu.trainer --model=image.mnist.LeNet5 \
+      --logdir=/tmp/mnist --mode=train
+  python -m lingvo_tpu.trainer --model=... --mode=inspect_model
+  python -m lingvo_tpu.trainer --list_models
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from lingvo_tpu import model_registry
+
+
+def _BuildSchedule(model_params, args):
+  from lingvo_tpu.runners import program as program_lib
+  task_p = model_params.task
+  if task_p.input is None and model_params.input is not None:
+    task_p.input = model_params.input
+  cls = model_registry.GetClass(args.model)
+  inst = cls()
+  # Experiment-provided schedule takes precedence (ref GetProgramSchedule).
+  ps = inst.ProgramSchedule()
+  input_generators = {}
+  train_p = program_lib.TrainProgram.Params().Set(
+      task=task_p, logdir=args.logdir,
+      steps_per_loop=task_p.train.tpu_steps_per_loop)
+  eval_programs = []
+  for ds in ("Test", "Dev"):
+    try:
+      ds_params = inst.GetDatasetParams(ds)
+    except Exception:
+      continue
+    ep = program_lib.EvalProgram.Params().Set(
+        task=task_p, logdir=args.logdir, dataset_name=ds,
+        name=f"eval_{ds.lower()}")
+    input_generators[ds] = ds_params.Instantiate()
+    eval_programs.append(ep)
+  if ps is None:
+    ps = program_lib.SimpleProgramSchedule.Params().Set(
+        train_program=train_p, eval_programs=eval_programs,
+        train_executions_per_eval=args.train_executions_per_eval)
+  task = None  # schedule instantiates from params
+  sched_cls = ps.cls
+  # Single task instance shared by all programs.
+  task = task_p.Instantiate()
+  task.FinalizePaths()
+  return sched_cls(ps, task=task, input_generators=input_generators), task
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument("--model", default="", help="Registered model name.")
+  parser.add_argument("--logdir", default="/tmp/lingvo_tpu",
+                      help="Output directory.")
+  parser.add_argument("--mode", default="train",
+                      choices=["train", "eval", "decode", "inspect_model",
+                               "inspect_params"],
+                      help="What to run.")
+  parser.add_argument("--job", default="executor_tpu", help="Parity flag.")
+  parser.add_argument("--max_steps", type=int, default=None,
+                      help="Override task max_steps.")
+  parser.add_argument("--train_executions_per_eval", type=int, default=1)
+  parser.add_argument("--list_models", action="store_true")
+  args = parser.parse_args(argv)
+
+  if args.list_models:
+    import lingvo_tpu.models.all_params  # noqa: F401  (populate registry)
+    for name in sorted(model_registry.GetRegisteredModels()):
+      print(name)
+    return 0
+
+  if not args.model:
+    parser.error("--model is required")
+
+  model_params = model_registry.GetParams(args.model, "Train")
+  if args.max_steps is not None:
+    model_params.task.train.max_steps = args.max_steps
+
+  if args.mode == "inspect_params":
+    print(model_params.ToText())
+    return 0
+
+  if args.mode == "inspect_model":
+    task = model_params.task.Instantiate()
+    task.FinalizePaths()
+    import numpy as np
+    total = 0
+    for path, wp in task.VariableSpecs().FlattenItems():
+      n = int(np.prod(wp.shape)) if wp.shape else 1
+      total += n
+      print(f"{path:<60} {str(tuple(wp.shape)):<20} {n}")
+    print(f"{'TOTAL':<60} {'':<20} {total}")
+    return 0
+
+  from lingvo_tpu.runners import executor as executor_lib
+  schedule, task = _BuildSchedule(model_params, args)
+  execu = executor_lib.ExecutorTpu(model_params, args.logdir,
+                                   schedule=schedule, task=task)
+  if args.mode == "train":
+    execu.Start()
+    return 0
+  if args.mode in ("eval", "decode"):
+    import jax
+    state = task.CreateTrainState(jax.random.PRNGKey(1234))
+    state, step = execu.checkpointer.Restore(state)
+    progs = [pr for pr in schedule.programs
+             if (args.mode == "eval" and "eval" in pr.p.name) or
+             (args.mode == "decode" and "decode" in pr.p.name)]
+    for prog in progs:
+      _, results = prog.Run(state)
+      print(f"[{prog.p.name}] step={step} {results}")
+    return 0
+  return 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())

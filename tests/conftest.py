@@ -6,9 +6,25 @@ Mirrors the reference's multi-virtual-device-in-one-process testing strategy
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
   os.environ["XLA_FLAGS"] = (
       flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# Tests are CPU-only: drop any non-cpu PJRT plugin factories (e.g. a tunneled
+# TPU plugin injected via sitecustomize) so backend init can't block on a
+# remote handshake.
+try:
+  import jax  # noqa: E402  (may already be imported by sitecustomize)
+  from jax._src import xla_bridge  # noqa: E402
+
+  # sitecustomize may have imported jax with JAX_PLATFORMS=axon already
+  # baked into the config: force it back to cpu.
+  jax.config.update("jax_platforms", "cpu")
+  for _name in list(getattr(xla_bridge, "_backend_factories", {})):
+    if _name not in ("cpu", "interpreter"):
+      xla_bridge._backend_factories.pop(_name, None)
+except Exception:
+  pass

@@ -281,6 +281,34 @@ class TestBaseLayer:
     assert specs.fc[0].w.shape == (4, 8)
 
 
+class TestInputGenerators:
+
+  def test_in_memory_repeat_false_yields_tail(self):
+    from lingvo_tpu.core import base_input_generator as big
+    data = NestedMap(x=np.arange(10, dtype=np.float32))
+    p = big.InMemoryInputGenerator.Params().Set(
+        name="in", data=data, batch_size=4, shuffle=False, repeat=False,
+        require_sequential_order=True)
+    gen = p.Instantiate()
+    batches = list(gen)
+    # 10 examples, bs 4 -> 3 batches; last one wrap-padded to static shape.
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0].x, [0, 1, 2, 3])
+    np.testing.assert_array_equal(batches[2].x, [8, 9, 0, 1])
+
+  def test_in_memory_repeat_loops_and_reshuffles(self):
+    from lingvo_tpu.core import base_input_generator as big
+    data = NestedMap(x=np.arange(8, dtype=np.float32))
+    p = big.InMemoryInputGenerator.Params().Set(
+        name="in", data=data, batch_size=8, seed=3)
+    gen = p.Instantiate()
+    it = iter(gen)
+    first = next(it).x.copy()
+    second = next(it).x.copy()
+    assert sorted(first) == sorted(second) == list(range(8))
+    assert not np.array_equal(first, second)  # reshuffled
+
+
 class TestRegistry:
 
   def test_register_and_lookup(self):
@@ -296,6 +324,10 @@ class TestRegistry:
 
     registered = model_registry._RegisterModel(FakeParams, task_hint="test")
     key = registered._registry_key
-    assert model_registry.GetClass(key) is FakeParams
-    with pytest.raises(LookupError):
-      model_registry.GetClass("no.such.Model")
+    try:
+      assert model_registry.GetClass(key) is FakeParams
+      with pytest.raises(LookupError):
+        model_registry.GetClass("no.such.Model")
+    finally:
+      # Don't pollute the process-global registry for other tests.
+      model_registry._MODEL_REGISTRY.pop(key, None)

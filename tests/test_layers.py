@@ -233,6 +233,17 @@ class TestConv:
     assert out_pad.shape == (2, 5)
     np.testing.assert_allclose(out[1, 3:], 0.0)  # padded region zeroed
 
+  def test_conv2d_valid_padding_with_paddings(self):
+    # Regression: VALID conv output is shorter than ceil(t/stride).
+    p = layers.Conv2DLayer.Params().Set(
+        name="conv", filter_shape=(3, 3, 2, 4), filter_stride=(2, 1),
+        padding="VALID", batch_norm=False)
+    layer, theta = _init(p)
+    x = jax.random.normal(KEY, (2, 10, 6, 2))
+    paddings = py_utils.PaddingsFromLengths(jnp.array([10, 6]), 10)
+    out, out_pad = layer.FProp(theta, x, paddings)
+    assert out.shape[1] == out_pad.shape[1] == 4
+
   def test_causal_conv_no_future_leak(self):
     p = layers.Conv2DLayer.Params().Set(
         name="conv", filter_shape=(3, 1, 2, 2), causal_convolution=True,
