@@ -165,8 +165,15 @@ class InMemoryInputGenerator(BaseInputGenerator):
       self._rng.shuffle(self._order)
 
   def EpochBatches(self) -> Iterator[NestedMap]:
-    """Yields one epoch of full batches in order (eval use)."""
+    """Yields one epoch in order; final partial batch wrap-padded so every
+    example is evaluated with static shapes (eval use)."""
     p = self.p
-    for start in range(0, self._n - p.batch_size + 1, p.batch_size):
-      idx = np.arange(start, start + p.batch_size)
+    for start in range(0, self._n, p.batch_size):
+      end = start + p.batch_size
+      if end <= self._n:
+        idx = np.arange(start, end)
+      else:
+        idx = np.concatenate(
+            [np.arange(start, self._n),
+             np.arange(0, end - self._n)])
       yield p.data.Transform(lambda a: a[idx])

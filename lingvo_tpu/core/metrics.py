@@ -209,12 +209,20 @@ class AUCMetric(BaseMetric):
     pos, neg = self._pos_scores, self._neg_scores
     if not pos or not neg:
       return 0.0
-    scores = sorted((s, 1) for s in pos) + sorted((s, 0) for s in neg)
+    scores = [(s, 1) for s in pos] + [(s, 0) for s in neg]
     scores.sort(key=lambda x: x[0])
+    # Average ranks over ties (Mann-Whitney U): a constant-score classifier
+    # must get AUC 0.5, not 0.
     rank_sum = 0.0
-    for rank, (_, label) in enumerate(scores, start=1):
-      if label:
-        rank_sum += rank
+    i = 0
+    n = len(scores)
+    while i < n:
+      j = i
+      while j < n and scores[j][0] == scores[i][0]:
+        j += 1
+      avg_rank = (i + 1 + j) / 2.0  # ranks i+1..j averaged
+      rank_sum += avg_rank * sum(label for _, label in scores[i:j])
+      i = j
     n_pos, n_neg = len(pos), len(neg)
     return (rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
 

@@ -336,7 +336,8 @@ def StepSeed(name: str, extra: jax.Array | None = None) -> jax.Array:
   """Derives a layer-unique key from the current step seed context.
 
   `extra` (e.g. a scan loop index) is folded in for layers whose FProp is
-  traced once but executed many times.
+  traced once but executed many times; any active StepSeedSalt values (scan
+  indices from enclosing repeat layers) are folded in automatically.
   """
   stack = _Stack("step_seed")
   if not stack:
@@ -344,9 +345,22 @@ def StepSeed(name: str, extra: jax.Array | None = None) -> jax.Array:
         "No StepSeedContext active; wrap the train FProp in "
         "py_utils.StepSeedContext(step_key)")
   key = jax.random.fold_in(stack[-1], GenerateSeedFromName(name))
+  for salt in _Stack("seed_salt"):
+    key = jax.random.fold_in(key, salt)
   if extra is not None:
     key = jax.random.fold_in(key, extra)
   return key
+
+
+@contextlib.contextmanager
+def StepSeedSalt(salt: jax.Array):
+  """Folds `salt` (e.g. a lax.scan index) into all StepSeed draws inside."""
+  stack = _Stack("seed_salt")
+  stack.append(salt)
+  try:
+    yield
+  finally:
+    stack.pop()
 
 
 @contextlib.contextmanager

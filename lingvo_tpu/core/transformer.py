@@ -1,0 +1,341 @@
+"""Transformer layers and stacks.
+
+Re-designs the transformer composition layer of the reference
+(`batch_major_attention.py`: `TransformerAttentionLayer:5226`,
+`TransformerLayer:6265`, `StackedTransformerLayers:7116`,
+`RepeatedTransformerLayer:6976`).
+
+The repeated stack is the TPU-native star: N identical layers become ONE
+layer with weights stacked on a leading axis, executed with `lax.scan` —
+constant compile time in depth, and under GSPMD the stacked weight's leading
+axis can also serve as the pipeline stage axis (ref
+`gshard_layers.LayerwiseShardablePipelinedLayer:180`; see parallel/pipeline).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import attention as attention_lib
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TransformerFeedForwardLayer(base_layer.BaseLayer):
+  """Pre-LN FFN with residual (ref TransformerFeedForwardLayer)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("hidden_dim", 0, "Inner dim.")
+    p.Define("activation", "RELU", "Inner activation.")
+    p.Define("use_gated_activation", False, "GLU-style gating (e.g. SwiGLU).")
+    p.Define("residual_dropout_prob", 0.0, "Dropout on the residual add.")
+    p.Define("relu_dropout_prob", 0.0, "Dropout after the inner activation.")
+    p.Define("norm_tpl", layers_lib.LayerNorm.Params(), "Norm template.")
+    p.Define("add_skip_connection", True, "Residual connection.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim > 0 and p.hidden_dim > 0
+    self.CreateChild("ln", p.norm_tpl.Copy().Set(input_dim=p.input_dim))
+    wsdm_in = p.weight_split_dims_mapping  # (None, 'model') typical
+    wsdm_out = tuple(reversed(wsdm_in)) if wsdm_in else None
+    self.CreateChild(
+        "ffn_in",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.input_dim, output_dim=p.hidden_dim,
+            activation="NONE", weight_split_dims_mapping=wsdm_in))
+    if p.use_gated_activation:
+      self.CreateChild(
+          "ffn_gate",
+          layers_lib.ProjectionLayer.Params().Set(
+              input_dim=p.input_dim, output_dim=p.hidden_dim,
+              activation="NONE", weight_split_dims_mapping=wsdm_in))
+    self.CreateChild(
+        "ffn_out",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.hidden_dim, output_dim=p.input_dim,
+            activation="NONE", weight_split_dims_mapping=wsdm_out))
+    self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    from lingvo_tpu.core import activations
+    x = self.ln.FProp(theta.ln, inputs)
+    h = self.ffn_in.FProp(theta.ffn_in, x)
+    act = activations.GetFn(p.activation)
+    if p.use_gated_activation:
+      h = act(h) * self.ffn_gate.FProp(theta.ffn_gate, x)
+    else:
+      h = act(h)
+    if p.relu_dropout_prob > 0:
+      h = self.dropout.FProp(
+          self.ChildTheta(theta, "dropout"), h,
+          keep_prob=1.0 - p.relu_dropout_prob, name_suffix="relu")
+    out = self.ffn_out.FProp(theta.ffn_out, h)
+    if p.residual_dropout_prob > 0:
+      out = self.dropout.FProp(
+          self.ChildTheta(theta, "dropout"), out,
+          keep_prob=1.0 - p.residual_dropout_prob, name_suffix="res")
+    if paddings is not None:
+      out = py_utils.ApplyPadding(paddings, out)
+    if p.add_skip_connection:
+      out = inputs + out
+    return out
+
+
+class TransformerAttentionLayer(base_layer.BaseLayer):
+  """Pre-LN attention block with residual (ref `:5226`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("atten_tpl", attention_lib.MultiHeadedAttention.Params(),
+             "Attention template.")
+    p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
+    p.Define("norm_tpl", layers_lib.LayerNorm.Params(), "Norm template.")
+    p.Define("is_masked", False, "Causal self-attention.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild("ln", p.norm_tpl.Copy().Set(input_dim=p.input_dim))
+    atten_p = p.atten_tpl.Copy().Set(
+        input_dim=p.input_dim,
+        hidden_dim=p.atten_tpl.hidden_dim or p.input_dim,
+        num_heads=p.num_heads)
+    self.CreateChild("atten", atten_p)
+    self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
+
+  def FProp(self, theta, query_vec, source_vecs=None, paddings=None,
+            atten_mask=None, segment_ids=None):
+    """Self-attention when source_vecs is None; else cross-attention."""
+    p = self.p
+    x = self.ln.FProp(theta.ln, query_vec)
+    if source_vecs is None:
+      mask = atten_mask
+      if p.is_masked:
+        cm = attention_lib.CausalMask(x.shape[1], jnp.float32)
+        mask = cm if mask is None else mask + cm
+      out, probs = self.atten.FProp(
+          theta.atten, x, paddings=paddings, atten_mask=mask,
+          segment_ids=segment_ids)
+    else:
+      out, probs = self.atten.FProp(
+          theta.atten, x, key_vec=source_vecs, value_vec=source_vecs,
+          paddings=paddings, atten_mask=atten_mask)
+    if p.residual_dropout_prob > 0:
+      out = self.dropout.FProp(
+          self.ChildTheta(theta, "dropout"), out,
+          keep_prob=1.0 - p.residual_dropout_prob)
+    return query_vec + out, probs
+
+  def InitStates(self, theta, batch_size, max_len):
+    return self.atten.InitStates(theta.atten, batch_size, max_len)
+
+  def ExtendStep(self, theta, query_vec, cached_states):
+    x = self.ln.FProp(theta.ln, query_vec)
+    out, new_states = self.atten.ExtendStep(theta.atten, x, cached_states)
+    return query_vec + out, new_states
+
+
+class TransformerLayer(base_layer.BaseLayer):
+  """Self-atten (+ optional cross-atten) + FFN (ref `TransformerLayer:6265`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("hidden_dim", 0, "FFN inner dim (0 = 4*input).")
+    p.Define("mask_self_atten", False, "Causal self-attention (decoder).")
+    p.Define("has_aux_atten", False, "Cross-attention to encoder outputs.")
+    p.Define("tr_atten_tpl", TransformerAttentionLayer.Params(),
+             "Self-attention template.")
+    p.Define("tr_aux_atten_tpl", None, "Cross-attention template (None = "
+             "same as tr_atten_tpl).")
+    p.Define("tr_fflayer_tpl", TransformerFeedForwardLayer.Params(),
+             "FFN template.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    atten_p = p.tr_atten_tpl.Copy().Set(
+        input_dim=p.input_dim, num_heads=p.num_heads, is_masked=p.mask_self_atten)
+    self.CreateChild("self_atten", atten_p)
+    if p.has_aux_atten:
+      aux_p = (p.tr_aux_atten_tpl or p.tr_atten_tpl).Copy().Set(
+          input_dim=p.input_dim, num_heads=p.num_heads, is_masked=False)
+      self.CreateChild("aux_atten", aux_p)
+    self.CreateChild(
+        "fflayer",
+        p.tr_fflayer_tpl.Copy().Set(
+            input_dim=p.input_dim,
+            hidden_dim=p.hidden_dim or 4 * p.input_dim))
+
+  def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
+            aux_paddings=None, atten_mask=None, segment_ids=None):
+    x, _ = self.self_atten.FProp(
+        theta.self_atten, inputs, paddings=paddings, atten_mask=atten_mask,
+        segment_ids=segment_ids)
+    if self.p.has_aux_atten:
+      assert aux_vecs is not None
+      x, _ = self.aux_atten.FProp(
+          theta.aux_atten, x, source_vecs=aux_vecs, paddings=aux_paddings)
+    return self.fflayer.FProp(theta.fflayer, x, paddings)
+
+  def InitStates(self, theta, batch_size, max_len):
+    return NestedMap(
+        self_atten=self.self_atten.InitStates(theta.self_atten, batch_size,
+                                              max_len))
+
+  def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
+                 aux_paddings=None):
+    x, new_sa = self.self_atten.ExtendStep(theta.self_atten, inputs,
+                                           cached_states.self_atten)
+    if self.p.has_aux_atten:
+      x, _ = self.aux_atten.FProp(
+          theta.aux_atten, x, source_vecs=aux_vecs, paddings=aux_paddings)
+    out = self.fflayer.FProp(theta.fflayer, x)
+    return out, NestedMap(self_atten=new_sa)
+
+
+class StackedTransformerLayers(base_layer.BaseLayer):
+  """N distinct transformer layers (ref `StackedTransformerLayers:7116`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_layers", 0, "Depth.")
+    p.Define("transformer_layer_params_tpl", TransformerLayer.Params(),
+             "Per-layer template.")
+    p.Define("final_ln", True, "LayerNorm on the final output.")
+    p.Define("input_dim", 0, "Model dim (propagated to layers).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.num_layers > 0
+    tpl = p.transformer_layer_params_tpl.Copy()
+    if p.input_dim:
+      tpl.input_dim = p.input_dim
+    self.CreateChildren("x_layers", [tpl.Copy() for _ in range(p.num_layers)])
+    if p.final_ln:
+      self.CreateChild(
+          "final_ln",
+          layers_lib.LayerNorm.Params().Set(
+              input_dim=p.input_dim or tpl.input_dim))
+
+  def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
+            aux_paddings=None, segment_ids=None):
+    x = inputs
+    for i, layer in enumerate(self.x_layers):
+      x = layer.FProp(theta.x_layers[i], x, paddings, aux_vecs, aux_paddings,
+                      segment_ids=segment_ids)
+    if self.p.final_ln:
+      x = self.final_ln.FProp(theta.final_ln, x)
+    return x
+
+  def InitStates(self, theta, batch_size, max_len):
+    return NestedMap(x_layers=[
+        l.InitStates(theta.x_layers[i], batch_size, max_len)
+        for i, l in enumerate(self.x_layers)
+    ])
+
+  def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
+                 aux_paddings=None):
+    x = inputs
+    new_states = NestedMap(x_layers=[])
+    for i, layer in enumerate(self.x_layers):
+      x, ns = layer.ExtendStep(theta.x_layers[i], x,
+                               cached_states.x_layers[i], aux_vecs,
+                               aux_paddings)
+      new_states.x_layers.append(ns)
+    if self.p.final_ln:
+      x = self.final_ln.FProp(theta.final_ln, x)
+    return x, new_states
+
+
+class RepeatedTransformerLayer(base_layer.BaseLayer):
+  """N IDENTICAL-architecture layers as one scan with stacked weights.
+
+  Ref `RepeatedTransformerLayer:6976` + `repeat_layer.GenericRepeatLayer:80`.
+  theta.body has every leaf stacked on axis 0 (length num_layers); FProp scans
+  the body over that axis. Compile time is O(1) in depth; per-layer dropout
+  folds the scan index into the step seed.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_layers", 0, "Repeat count.")
+    p.Define("body", TransformerLayer.Params(), "The repeated layer.")
+    p.Define("per_layer_checkpoint", True,
+             "jax.checkpoint each body iteration (remat for long stacks).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    assert self.p.num_layers > 0
+    self.CreateChild("body", self.p.body)
+
+  def InstantiateVariables(self, key):
+    if self._path is None:
+      self.FinalizePaths()
+    # Stack: layer i's weights use a per-i fold of the key, all materialized
+    # with one vmap (identical shapes by construction).
+    def _One(i):
+      return self.body.InstantiateVariables(jax.random.fold_in(key, i))
+
+    stacked = jax.vmap(_One)(jnp.arange(self.p.num_layers))
+    return NestedMap(body=stacked)
+
+  def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
+            aux_paddings=None, segment_ids=None):
+    p = self.p
+
+    def _Body(carry, per_layer):
+      theta_i, idx = per_layer
+      # Fold the layer index into step seeds: each scan iteration gets its
+      # own dropout masks even though FProp is traced once.
+      with py_utils.StepSeedSalt(idx):
+        x = self.body.FProp(theta_i, carry, paddings, aux_vecs, aux_paddings,
+                            segment_ids=segment_ids)
+      return x, ()
+
+    body_fn = _Body
+    if p.per_layer_checkpoint:
+      body_fn = jax.checkpoint(_Body)
+    out, _ = jax.lax.scan(body_fn, inputs,
+                          (theta.body, jnp.arange(p.num_layers)))
+    return out
+
+  def InitStates(self, theta, batch_size, max_len):
+    def _One(theta_i):
+      return self.body.InitStates(theta_i, batch_size, max_len)
+
+    return NestedMap(body=jax.vmap(_One)(theta.body))
+
+  def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
+                 aux_paddings=None):
+    def _Body(carry, per_layer):
+      theta_i, states_i = per_layer
+      x, new_states = self.body.ExtendStep(theta_i, carry, states_i, aux_vecs,
+                                           aux_paddings)
+      return x, new_states
+
+    out, new_states = jax.lax.scan(_Body, inputs,
+                                   (theta.body, cached_states.body))
+    return out, NestedMap(body=new_states)

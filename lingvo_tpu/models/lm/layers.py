@@ -1,0 +1,141 @@
+"""Language model task layers (ref: lingvo/tasks/lm/layers.py + gshard LMs).
+
+TransformerLm: embedding + repeated/stacked transformer + tied softmax over
+packed or plain batches. The flagship model family: DenseLm* configs
+(ref `tasks/lm/params/synthetic_packed_input.py`) instantiate this with mesh
+sharding annotations for tp/dp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import transformer as transformer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TransformerLm(base_model.BaseTask):
+  """Decoder-only transformer LM.
+
+  Input batch fields (packed format, ref pack_ops.cc producers):
+    ids: [b, t] int32        labels: [b, t] int32
+    paddings: [b, t] f32     (optional) segment_ids: [b, t] int32
+    (optional) segment_pos: [b, t] int32
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 32000, "Vocabulary size.")
+    p.Define("model_dim", 512, "Model dim.")
+    p.Define("num_layers", 6, "Depth.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("hidden_dim", 2048, "FFN inner dim.")
+    p.Define("use_repeat_layer", True,
+             "Scan-over-layers (True) vs distinct layers (False).")
+    p.Define("atten_tpl", None, "Optional attention template override.")
+    p.Define("use_rotary", True, "RoPE instead of absolute positions.")
+    p.Define("label_smoothing", 0.0, "Label smoothing.")
+    p.Define("softmax_logits_soft_max", 30.0, "Logit tanh cap (gshard-style).")
+    p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
+    p.Define("atten_dropout_prob", 0.0, "Attention dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "emb",
+        layers_lib.SharedEmbeddingSoftmaxLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.model_dim,
+            logits_soft_max=p.softmax_logits_soft_max,
+            weight_split_dims_mapping=("model", None)))
+    if not p.use_rotary:
+      self.CreateChild(
+          "pos_emb",
+          layers_lib.PositionalEmbeddingLayer.Params().Set(
+              embedding_dim=p.model_dim))
+
+    layer_body = transformer_lib.TransformerLayer.Params().Set(
+        input_dim=p.model_dim, num_heads=p.num_heads,
+        hidden_dim=p.hidden_dim, mask_self_atten=True)
+    atten_tpl = p.atten_tpl
+    if atten_tpl is not None:
+      layer_body.tr_atten_tpl.atten_tpl = atten_tpl.Copy()
+    layer_body.tr_atten_tpl.atten_tpl.use_rotary_position_emb = p.use_rotary
+    layer_body.tr_atten_tpl.atten_tpl.atten_dropout_prob = p.atten_dropout_prob
+    layer_body.tr_atten_tpl.atten_tpl.weight_split_dims_mapping = (
+        None, "model", None)
+    layer_body.tr_atten_tpl.residual_dropout_prob = p.residual_dropout_prob
+    layer_body.tr_fflayer_tpl.residual_dropout_prob = p.residual_dropout_prob
+    layer_body.tr_fflayer_tpl.weight_split_dims_mapping = (None, "model")
+
+    if p.use_repeat_layer:
+      self.CreateChild(
+          "stack",
+          transformer_lib.RepeatedTransformerLayer.Params().Set(
+              num_layers=p.num_layers, body=layer_body))
+    else:
+      self.CreateChild(
+          "stack",
+          transformer_lib.StackedTransformerLayers.Params().Set(
+              num_layers=p.num_layers, input_dim=p.model_dim,
+              transformer_layer_params_tpl=layer_body, final_ln=False))
+    self.CreateChild(
+        "final_ln",
+        layers_lib.LayerNorm.Params().Set(input_dim=p.model_dim))
+
+  # -- forward ---------------------------------------------------------------
+
+  def ComputePredictions(self, theta, input_batch):
+    p = self.p
+    ids = input_batch.ids
+    x = self.emb.EmbLookup(theta.emb, ids)
+    if not p.use_rotary:
+      pos = input_batch.Get("segment_pos")
+      if pos is not None:
+        pe = self.pos_emb.FProp(NestedMap(), position=pos.astype(jnp.float32))
+      else:
+        pe = self.pos_emb.FProp(NestedMap(), seq_length=ids.shape[1])[None]
+      x = x + pe.astype(x.dtype)
+    seg_ids = input_batch.Get("segment_ids")
+    x = self.stack.FProp(theta.stack, x, paddings=input_batch.paddings,
+                         segment_ids=seg_ids)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    logits = self.emb.Logits(theta.emb, x)
+    return NestedMap(logits=logits)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    p = self.p
+    xent = self.emb.XentLossFromLogits(
+        predictions.logits, class_ids=input_batch.labels,
+        label_smoothing=p.label_smoothing)
+    weights = py_utils.SequenceMask(input_batch.paddings)
+    tot_weight = jnp.maximum(jnp.sum(weights), 1e-8)
+    avg_xent = jnp.sum(xent.per_example_xent * weights) / tot_weight
+    metrics = NestedMap(
+        loss=(avg_xent, tot_weight),
+        log_pplx=(avg_xent, tot_weight),
+        fraction_of_correct_next_step_preds=(
+            jnp.sum((jnp.argmax(predictions.logits, -1) == input_batch.labels)
+                    * weights) / tot_weight, tot_weight),
+        num_predictions=(tot_weight, 1.0))
+    per_example = NestedMap(xent=xent.per_example_xent)
+    return metrics, per_example
+
+  # -- decode (sampling; beam search comes from core/beam_search) ------------
+
+  def InitDecodeState(self, theta, batch_size, max_len):
+    return self.stack.InitStates(theta.stack, batch_size, max_len)
+
+  def ExtendStep(self, theta, ids_t, states):
+    """ids_t: [b, 1] -> (logits [b, vocab], new states)."""
+    x = self.emb.EmbLookup(theta.emb, ids_t)
+    x, new_states = self.stack.ExtendStep(theta.stack, x, states)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    logits = self.emb.Logits(theta.emb, x)
+    return logits[:, 0, :], new_states

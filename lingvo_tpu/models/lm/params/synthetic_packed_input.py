@@ -1,0 +1,112 @@
+"""Dense LM experiment configs on synthetic packed input.
+
+Ref `lingvo/tasks/lm/params/synthetic_packed_input.py:161-289`: the DenseLm*
+family defines the scale points (8B on 128 cores, 128B on 8x8, 175B on 32x32,
+1T). Here: same model shapes, TPU-native sharding via mesh axis names
+('data', 'model') instead of DEVICE_MESH_SHAPE wid/zigzag orderings — the
+mesh geometry itself comes from runtime flags / parallel.mesh.
+"""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.lm import input_generator
+from lingvo_tpu.models.lm import layers as lm_layers
+
+
+class DenseLmTemplate(base_model_params.SingleTaskModelParams):
+  """Shared recipe for the DenseLm family (ref :107 DenseLmTemplate)."""
+
+  SEQUENCE_LENGTH = 1024
+  BATCH_SIZE = 8  # per host
+  VOCAB_SIZE = 32000
+  MODEL_DIM = 1024
+  NUM_LAYERS = 8
+  NUM_HEADS = 16
+  HIDDEN_DIM = 4096
+  USE_REPEAT = True
+  LEARNING_RATE = 2.5e-4
+  MAX_STEPS = 1_000_000
+
+  def Train(self):
+    return input_generator.SyntheticLmInput.Params().Set(
+        batch_size=self.BATCH_SIZE, seq_len=self.SEQUENCE_LENGTH,
+        vocab_size=self.VOCAB_SIZE, packing=True)
+
+  def Test(self):
+    return input_generator.SyntheticLmInput.Params().Set(
+        batch_size=self.BATCH_SIZE, seq_len=self.SEQUENCE_LENGTH,
+        vocab_size=self.VOCAB_SIZE, packing=True, seed=99)
+
+  def Task(self):
+    p = lm_layers.TransformerLm.Params()
+    p.name = "lm"
+    p.vocab_size = self.VOCAB_SIZE
+    p.model_dim = self.MODEL_DIM
+    p.num_layers = self.NUM_LAYERS
+    p.num_heads = self.NUM_HEADS
+    p.hidden_dim = self.HIDDEN_DIM
+    p.use_repeat_layer = self.USE_REPEAT
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=self.LEARNING_RATE,
+        optimizer=opt_lib.Adafactor.Params().Set(
+            beta1=0.9, multiply_by_parameter_scale=False),
+        lr_schedule=sched_lib.LinearRampupCosineDecay.Params().Set(
+            warmup_steps=1000, total_steps=self.MAX_STEPS),
+        clip_gradient_norm_to_value=1.0)
+    p.train.max_steps = self.MAX_STEPS
+    p.train.tpu_steps_per_loop = 20
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLmTiny(DenseLmTemplate):
+  """Smoke-test scale: trains on CPU in seconds."""
+
+  SEQUENCE_LENGTH = 64
+  BATCH_SIZE = 4
+  VOCAB_SIZE = 128
+  MODEL_DIM = 64
+  NUM_LAYERS = 2
+  NUM_HEADS = 4
+  HIDDEN_DIM = 128
+  LEARNING_RATE = 3e-3
+  MAX_STEPS = 2000
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLm1B(DenseLmTemplate):
+  """~1.3B params; single-host bench scale."""
+
+  SEQUENCE_LENGTH = 1024
+  MODEL_DIM = 2048
+  NUM_LAYERS = 24
+  NUM_HEADS = 16
+  HIDDEN_DIM = 8192
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLm8B(DenseLmTemplate):
+  """Ref DenseLm8B2x2 (`synthetic_packed_input.py:161-181`): 32 layers,
+  model_dim 8192, seq 1024."""
+
+  SEQUENCE_LENGTH = 1024
+  MODEL_DIM = 8192
+  NUM_LAYERS = 32
+  NUM_HEADS = 64
+  HIDDEN_DIM = 32768
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLm128B(DenseLmTemplate):
+  """Ref DenseLm128B8x8 (`synthetic_packed_input.py:200-237`)."""
+
+  SEQUENCE_LENGTH = 1024
+  MODEL_DIM = 16384
+  NUM_LAYERS = 64
+  NUM_HEADS = 128
+  HIDDEN_DIM = 65536

@@ -78,11 +78,11 @@ class BaseProgram:
   def Compile(self, state: NestedMap) -> None:
     """Ahead-of-time compile with a real batch (ref Compile:355)."""
     batch = self._PutBatch(self.input_generator.GetPreprocessedInputBatch())
-    fn = self._GetStepFn()
+    fn = self._GetStepFn(state)
     if hasattr(fn, "lower"):
       fn.lower(state, batch).compile()
 
-  def _GetStepFn(self):
+  def _GetStepFn(self, state: NestedMap | None = None):
     raise NotImplementedError
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
@@ -173,7 +173,7 @@ class EvalProgram(BaseProgram):
     p.Define("use_ema", True, "Eval with EMA weights when available.")
     return p
 
-  def _GetStepFn(self):
+  def _GetStepFn(self, state: NestedMap | None = None):
     if self._step_fn is None:
 
       def _Step(theta, batch):
@@ -188,19 +188,29 @@ class EvalProgram(BaseProgram):
       return state.ema_theta
     return state.theta
 
+  def _MaxEvalBatches(self) -> int:
+    """Eval budget: task's eval.samples_per_summary wins over steps_per_loop
+    (ref base_model.py eval params; 0 = unlimited for finite datasets)."""
+    sps = getattr(self._task.p.eval, "samples_per_summary", 0)
+    if sps:
+      bs = max(1, self.input_generator.InfeedBatchSize())
+      return max(1, -(-sps // bs))
+    return self.p.steps_per_loop
+
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
-    fn = self._GetStepFn()
+    fn = self._GetStepFn(state)
     theta = self._EvalTheta(state)
     acc = None
     gen = self.input_generator
+    max_batches = self._MaxEvalBatches()
     batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
-               else _TakeN(gen, self.p.steps_per_loop))
+               else _TakeN(gen, max_batches))
     n = 0
     for batch in batches:
       out = fn(theta, self._PutBatch(batch))
       acc = metrics_lib.AccumulateMetrics(acc, out)
       n += 1
-      if n >= self.p.steps_per_loop:
+      if n >= max_batches:
         break
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     step = int(jax.device_get(state.step))
@@ -220,7 +230,7 @@ class DecodeProgram(BaseProgram):
     p.Define("use_ema", True, "Decode with EMA weights when available.")
     return p
 
-  def _GetStepFn(self):
+  def _GetStepFn(self, state: NestedMap | None = None):
     if self._step_fn is None:
 
       def _Step(theta, batch):
@@ -231,7 +241,7 @@ class DecodeProgram(BaseProgram):
     return self._step_fn
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
-    fn = self._GetStepFn()
+    fn = self._GetStepFn(state)
     theta = (state.ema_theta
              if self.p.use_ema and "ema_theta" in state else state.theta)
     dec_metrics = self._task.CreateDecoderMetrics()
@@ -300,7 +310,8 @@ class SimpleProgramSchedule:
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, Any]]:
     results: dict[str, Any] = {}
     if self.train_program is not None:
-      for _ in range(self.p.train_executions_per_eval):
+      train_result = None
+      for _ in range(max(1, self.p.train_executions_per_eval)):
         state, train_result = self.train_program.Run(state)
       results["train"] = train_result
     for ep in self.eval_programs:

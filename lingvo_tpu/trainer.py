@@ -34,17 +34,24 @@ def _BuildSchedule(model_params, args):
   train_p = program_lib.TrainProgram.Params().Set(
       task=task_p, logdir=args.logdir,
       steps_per_loop=task_p.train.tpu_steps_per_loop)
+  from lingvo_tpu.core import base_model as base_model_lib
+  from lingvo_tpu.core import base_model_params as bmp
   eval_programs = []
+  has_decode = task_p.cls.Decode is not base_model_lib.BaseTask.Decode
   for ds in ("Test", "Dev"):
     try:
       ds_params = inst.GetDatasetParams(ds)
-    except Exception:
-      continue
+    except bmp.DatasetError:
+      continue  # dataset genuinely not defined; real errors propagate
     ep = program_lib.EvalProgram.Params().Set(
         task=task_p, logdir=args.logdir, dataset_name=ds,
         name=f"eval_{ds.lower()}")
     input_generators[ds] = ds_params.Instantiate()
     eval_programs.append(ep)
+    if has_decode and ds == "Test":
+      eval_programs.append(program_lib.DecodeProgram.Params().Set(
+          task=task_p, logdir=args.logdir, dataset_name=ds,
+          name=f"decode_{ds.lower()}"))
   if ps is None:
     ps = program_lib.SimpleProgramSchedule.Params().Set(
         train_program=train_p, eval_programs=eval_programs,

@@ -1,0 +1,280 @@
+"""Attention + transformer tests.
+
+Key properties (mirroring the reference's batch_major_attention_test):
+- causal masking: no future leakage
+- ExtendStep decode == FProp offline (streaming equivalence, ref
+  stream_step_test_base)
+- LocalSelfAttention == full attention when the window covers everything
+- packed segment masks isolate sequences
+- RepeatedTransformerLayer(scan) == StackedTransformerLayers with same
+  per-layer weights
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import attention, py_utils, transformer
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(7)
+B, T, D, N = 2, 12, 16, 4
+
+
+def _mha(**kw):
+  p = attention.MultiHeadedAttention.Params().Set(
+      name="mha", input_dim=D, hidden_dim=D, num_heads=N, **kw)
+  layer = p.Instantiate()
+  return layer, layer.InstantiateVariables(KEY)
+
+
+class TestMultiHeadedAttention:
+
+  def test_shapes(self):
+    layer, theta = _mha()
+    x = jax.random.normal(KEY, (B, T, D))
+    out, probs = layer.FProp(theta, x)
+    assert out.shape == (B, T, D)
+    assert probs.shape == (B, N, T, T)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, rtol=1e-3)
+
+  def test_key_paddings_ignored(self):
+    layer, theta = _mha()
+    x = jax.random.normal(KEY, (B, T, D))
+    paddings = py_utils.PaddingsFromLengths(jnp.array([T, 5]), T)
+    out1, probs = layer.FProp(theta, x, paddings=paddings)
+    x2 = x.at[1, 5:].set(777.0)  # garbage in padded keys of seq 1
+    out2, _ = layer.FProp(theta, x2, paddings=paddings)
+    np.testing.assert_allclose(out1[1, :5], out2[1, :5], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(probs[1, :, :, 5:]), 0.0, atol=1e-6)
+
+  def test_causal_mask_no_future(self):
+    layer, theta = _mha()
+    x = jax.random.normal(KEY, (1, T, D))
+    mask = attention.CausalMask(T)
+    out1, _ = layer.FProp(theta, x, atten_mask=mask)
+    x2 = x.at[:, 6:].set(-5.0)
+    out2, _ = layer.FProp(theta, x2, atten_mask=mask)
+    np.testing.assert_allclose(out1[:, :6], out2[:, :6], atol=1e-4)
+
+  def test_extend_step_matches_fprop(self):
+    layer, theta = _mha(use_rotary_position_emb=True)
+    x = jax.random.normal(KEY, (B, T, D))
+    offline, _ = layer.FProp(theta, x, atten_mask=attention.CausalMask(T))
+    states = layer.InitStates(theta, B, T)
+    outs = []
+    for t in range(T):
+      step_out, states = layer.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(step_out)
+    streaming = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(offline), np.asarray(streaming), atol=2e-4)
+
+  def test_segment_mask_isolates_sequences(self):
+    layer, theta = _mha()
+    x = jax.random.normal(KEY, (1, 8, D))
+    seg = jnp.array([[0, 0, 0, 0, 1, 1, 1, 1]])
+    out1, probs = layer.FProp(theta, x, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(probs[0, :, :4, 4:]), 0.0, atol=1e-6)
+    # perturbing segment 1 leaves segment 0 outputs unchanged
+    x2 = x.at[:, 4:].set(99.0)
+    out2, _ = layer.FProp(theta, x2, segment_ids=seg)
+    np.testing.assert_allclose(out1[:, :4], out2[:, :4], atol=1e-4)
+
+  def test_relative_position_bias(self):
+    layer, theta = _mha(rel_pos_emb_dim=8, rel_pos_max_distance=4)
+    assert theta.rel_pos_bias.shape == (N, 9)
+    x = jax.random.normal(KEY, (B, T, D))
+    out, _ = layer.FProp(theta, x)
+    assert out.shape == (B, T, D)
+
+  def test_cross_attention_dims(self):
+    p = attention.MultiHeadedAttention.Params().Set(
+        name="xatt", input_dim=D, source_dim=24, hidden_dim=D, num_heads=N)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    q = jax.random.normal(KEY, (B, 5, D))
+    kv = jax.random.normal(KEY, (B, 9, 24))
+    out, probs = layer.FProp(theta, q, key_vec=kv)
+    assert out.shape == (B, 5, D)
+    assert probs.shape == (B, N, 5, 9)
+
+
+class TestLocalAndChunkwise:
+
+  def test_local_equals_full_when_window_covers(self):
+    pl = attention.LocalSelfAttention.Params().Set(
+        name="local", input_dim=D, hidden_dim=D, num_heads=N,
+        block_size=T, left_context=T + 1, right_context=0)
+    local = pl.Instantiate()
+    theta = local.InstantiateVariables(KEY)
+    full = attention.MultiHeadedAttention.Params().Set(
+        name="local", input_dim=D, hidden_dim=D, num_heads=N).Instantiate()
+    x = jax.random.normal(KEY, (B, T, D))
+    out_local, _ = local.FProp(theta, x)
+    out_full, _ = full.FProp(theta, x, atten_mask=attention.CausalMask(T))
+    np.testing.assert_allclose(
+        np.asarray(out_local), np.asarray(out_full), atol=2e-4)
+
+  def test_local_window_limit(self):
+    pl = attention.LocalSelfAttention.Params().Set(
+        name="local", input_dim=D, hidden_dim=D, num_heads=N,
+        block_size=4, left_context=3, right_context=0)
+    local = pl.Instantiate()
+    theta = local.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (1, T, D))
+    out1, _ = local.FProp(theta, x)
+    # perturbing position 0 must not affect position 8 (distance 8 > 3)
+    x2 = x.at[:, 0].set(50.0)
+    out2, _ = local.FProp(theta, x2)
+    np.testing.assert_allclose(out1[:, 8:], out2[:, 8:], atol=1e-4)
+    # but must affect position 1 (distance 1)
+    assert not np.allclose(out1[:, 1], out2[:, 1], atol=1e-4)
+
+  def test_local_respects_paddings(self):
+    pl = attention.LocalSelfAttention.Params().Set(
+        name="local", input_dim=D, hidden_dim=D, num_heads=N,
+        block_size=4, left_context=4, right_context=2)
+    local = pl.Instantiate()
+    theta = local.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (2, 10, D))
+    paddings = py_utils.PaddingsFromLengths(jnp.array([10, 6]), 10)
+    out1, _ = local.FProp(theta, x, paddings=paddings)
+    x2 = x.at[1, 6:].set(123.0)
+    out2, _ = local.FProp(theta, x2, paddings=paddings)
+    np.testing.assert_allclose(np.asarray(out1[1, :6]),
+                               np.asarray(out2[1, :6]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out1[1, 6:]), 0.0, atol=1e-6)
+
+  def test_chunkwise_no_cross_chunk(self):
+    pc = attention.ChunkwiseSelfAttention.Params().Set(
+        name="chunk", input_dim=D, hidden_dim=D, num_heads=N, chunk_size=4)
+    chunk = pc.Instantiate()
+    theta = chunk.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (1, 8, D))
+    out1, _ = chunk.FProp(theta, x)
+    x2 = x.at[:, 0:4].set(9.0)  # perturb chunk 0
+    out2, _ = chunk.FProp(theta, x2)
+    np.testing.assert_allclose(out1[:, 4:], out2[:, 4:], atol=1e-4)
+
+
+class TestTransformer:
+
+  def _layer_p(self, **kw):
+    return transformer.TransformerLayer.Params().Set(
+        name="xf", input_dim=D, num_heads=N, hidden_dim=32, **kw)
+
+  def test_decoder_layer_fprop_extendstep(self):
+    p = self._layer_p(mask_self_atten=True)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (B, T, D))
+    offline = layer.FProp(theta, x)
+    states = layer.InitStates(theta, B, T)
+    outs = []
+    for t in range(T):
+      o, states = layer.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(o)
+    np.testing.assert_allclose(
+        np.asarray(offline), np.asarray(jnp.concatenate(outs, 1)), atol=3e-4)
+
+  def test_encoder_decoder_cross_attention(self):
+    p = self._layer_p(mask_self_atten=True, has_aux_atten=True)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    tgt = jax.random.normal(KEY, (B, 5, D))
+    src = jax.random.normal(KEY, (B, 9, D))
+    src_pad = py_utils.PaddingsFromLengths(jnp.array([9, 4]), 9)
+    out = layer.FProp(theta, tgt, aux_vecs=src, aux_paddings=src_pad)
+    assert out.shape == (B, 5, D)
+    src2 = src.at[1, 4:].set(55.0)
+    out2 = layer.FProp(theta, tgt, aux_vecs=src2, aux_paddings=src_pad)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(out2[1]),
+                               atol=1e-4)
+
+  def test_stacked_layers(self):
+    p = transformer.StackedTransformerLayers.Params().Set(
+        name="stack", num_layers=3, input_dim=D,
+        transformer_layer_params_tpl=self._layer_p(mask_self_atten=True))
+    stack = p.Instantiate()
+    theta = stack.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (B, T, D))
+    out = stack.FProp(theta, x)
+    assert out.shape == (B, T, D)
+    # streaming equivalence through the whole stack
+    states = stack.InitStates(theta, B, T)
+    outs = []
+    for t in range(T):
+      o, states = stack.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(o)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(jnp.concatenate(outs, 1)), atol=5e-4)
+
+  def test_repeated_matches_stacked(self):
+    body = self._layer_p(mask_self_atten=True)
+    rep_p = transformer.RepeatedTransformerLayer.Params().Set(
+        name="rep", num_layers=3, body=body.Copy(),
+        per_layer_checkpoint=False)
+    rep = rep_p.Instantiate()
+    rep_theta = rep.InstantiateVariables(KEY)
+    assert rep_theta.body.fflayer.ffn_in.w.shape[0] == 3  # stacked
+
+    # Build a stacked version with the SAME weights, layer by layer.
+    stack_p = transformer.StackedTransformerLayers.Params().Set(
+        name="stack", num_layers=3, input_dim=D,
+        transformer_layer_params_tpl=body.Copy(), final_ln=False)
+    stack = stack_p.Instantiate()
+    stack_theta = stack.InstantiateVariables(KEY)
+    for i in range(3):
+      stack_theta.x_layers[i] = jax.tree_util.tree_map(
+          lambda s: s[i], rep_theta.body)
+    x = jax.random.normal(KEY, (B, T, D))
+    out_rep = rep.FProp(rep_theta, x)
+    out_stack = stack.FProp(stack_theta, x)
+    np.testing.assert_allclose(
+        np.asarray(out_rep), np.asarray(out_stack), atol=2e-5)
+
+  def test_repeated_extend_step(self):
+    body = self._layer_p(mask_self_atten=True)
+    rep = transformer.RepeatedTransformerLayer.Params().Set(
+        name="rep", num_layers=2, body=body).Instantiate()
+    theta = rep.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (B, T, D))
+    offline = rep.FProp(theta, x)
+    states = rep.InitStates(theta, B, T)
+    outs = []
+    for t in range(T):
+      o, states = rep.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(o)
+    np.testing.assert_allclose(
+        np.asarray(offline), np.asarray(jnp.concatenate(outs, 1)), atol=5e-4)
+
+  def test_repeated_dropout_differs_per_layer(self):
+    body = self._layer_p(mask_self_atten=True)
+    body.tr_atten_tpl.residual_dropout_prob = 0.5
+    rep = transformer.RepeatedTransformerLayer.Params().Set(
+        name="rep", num_layers=2, body=body,
+        per_layer_checkpoint=False).Instantiate()
+    theta = rep.InstantiateVariables(KEY)
+    # Make both layers' weights identical: same input -> layer outputs
+    # differ iff dropout masks differ.
+    tied = jax.tree_util.tree_map(
+        lambda s: jnp.broadcast_to(s[0:1], s.shape), theta.body)
+    theta = NestedMap(body=tied)
+    x = jnp.ones((1, 4, D))
+    with py_utils.StepSeedContext(jax.random.PRNGKey(3)):
+      out_a = rep.FProp(theta, x)
+    with py_utils.StepSeedContext(jax.random.PRNGKey(3)):
+      out_b = rep.FProp(theta, x)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+  def test_ffn_gated_activation(self):
+    p = transformer.TransformerFeedForwardLayer.Params().Set(
+        name="ffn", input_dim=D, hidden_dim=32, activation="SILU",
+        use_gated_activation=True)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    out = layer.FProp(theta, jax.random.normal(KEY, (B, T, D)))
+    assert out.shape == (B, T, D)
+    assert "ffn_gate" in theta

@@ -197,6 +197,45 @@ class TestRegistryModels:
       assert len(specs.FlattenItems()) > 0, name
 
 
+class TestMetricsFixes:
+
+  def test_auc_tie_handling(self):
+    from lingvo_tpu.core import metrics as metrics_lib
+    m = metrics_lib.AUCMetric()
+    m.Update(1, 0.5)
+    m.Update(0, 0.5)
+    assert m.value == pytest.approx(0.5)  # constant classifier -> 0.5
+    m2 = metrics_lib.AUCMetric()
+    for label, s in [(1, 0.9), (1, 0.8), (0, 0.2), (0, 0.1)]:
+      m2.Update(label, s)
+    assert m2.value == pytest.approx(1.0)  # perfect separation
+
+  def test_epoch_batches_covers_tail(self):
+    from lingvo_tpu.core import base_input_generator as big
+    data = NestedMap(x=np.arange(10, dtype=np.float32))
+    gen = big.InMemoryInputGenerator.Params().Set(
+        name="g", data=data, batch_size=4, shuffle=False).Instantiate()
+    batches = list(gen.EpochBatches())
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[2].x, [8, 9, 0, 1])  # wrap-padded
+
+  def test_schedule_zero_train_executions(self):
+    from lingvo_tpu.runners import program as program_lib
+    mp = _TinyMnistModelParams(None, max_steps=10)
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+      train_p = program_lib.TrainProgram.Params().Set(
+          task=mp.task, logdir=d, steps_per_loop=2)
+      sched = program_lib.SimpleProgramSchedule(
+          program_lib.SimpleProgramSchedule.Params().Set(
+              train_program=train_p, train_executions_per_eval=0), task=task)
+      state = task.CreateTrainState(jax.random.PRNGKey(0))
+      state, results = sched.Run(state)
+      assert "train" in results  # clamped to one execution, no crash
+
+
 class TestTrainerCli:
 
   def test_inspect_params_and_model(self, tmp_path, capsys):
