@@ -1,0 +1,127 @@
+"""Benchmark: dense-LM training MFU on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+The flagship path: bf16 TransformerLm (scan-over-layers) full train step
+(fwd+bwd+Adafactor) on synthetic packed input. MFU = model FLOPs / (step
+time * peak FLOPs). Baseline target: 45% MFU (BASELINE.md north star).
+
+Model size auto-scales with the platform: a ~350M-param LM on TPU, a tiny
+one on CPU (so the script always completes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _PeakFlops(device) -> float:
+  kind = getattr(device, "device_kind", "").lower()
+  # bf16 peak per chip
+  table = {
+      "tpu v5 lite": 197e12,   # v5e
+      "tpu v5e": 197e12,
+      "tpu v5": 459e12,        # v5p
+      "tpu v5p": 459e12,
+      "tpu v4": 275e12,
+      "tpu v6 lite": 918e12,   # v6e / trillium
+      "tpu v6e": 918e12,
+  }
+  for k, v in sorted(table.items(), key=lambda kv: -len(kv[0])):
+    if k in kind:
+      return v
+  if "tpu" in kind:
+    return 197e12
+  return float(os.environ.get("BENCH_PEAK_FLOPS", 2e11))  # cpu-ish
+
+
+def main():
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  from lingvo_tpu import model_registry
+  import lingvo_tpu.models.all_params  # noqa: F401
+
+  dev = jax.devices()[0]
+  on_tpu = dev.platform != "cpu"
+  peak = _PeakFlops(dev)
+
+  mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  if on_tpu:
+    # ~350M params: fits v5e HBM with f32 master weights + Adafactor state.
+    mp.task.model_dim = 1024
+    mp.task.num_layers = 24
+    mp.task.num_heads = 16
+    mp.task.hidden_dim = 8192
+    mp.task.vocab_size = 32768
+    mp.task.input.vocab_size = 32768
+    mp.task.input.seq_len = 1024
+    mp.task.input.batch_size = 8
+    steps = 20
+  else:
+    mp.task.input.seq_len = 64
+    mp.task.input.batch_size = 4
+    steps = 10
+  mp.task.fprop_dtype = jnp.bfloat16
+
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  state = task.CreateTrainState(jax.random.PRNGKey(0))
+  gen = mp.input.Instantiate()
+  batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+
+  from lingvo_tpu.core import py_utils
+  n_params = py_utils.CountParams(state.theta)
+  emb_params = mp.task.vocab_size * mp.task.model_dim
+  p = mp.task
+  b, t = mp.task.input.batch_size, mp.task.input.seq_len
+  tokens = b * t
+  # 6 * non-emb params per token (fwd 2x + bwd 4x) + softmax matmul
+  # + attention scores/context (12 * B*T^2*D*L fwd+bwd).
+  matmul_flops = 6.0 * (n_params - emb_params) * tokens
+  softmax_flops = 6.0 * emb_params * tokens
+  attn_flops = 12.0 * b * t * t * p.model_dim * p.num_layers
+  flops_per_step = matmul_flops + softmax_flops + attn_flops
+
+  step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
+  # warmup/compile
+  state, out = step_fn(state, batch)
+  jax.block_until_ready(jax.tree_util.tree_leaves(state.theta)[0])
+
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    state, out = step_fn(state, batch)
+  jax.block_until_ready(jax.tree_util.tree_leaves(state.theta)[0])
+  wall = time.perf_counter() - t0
+  step_time = wall / steps
+
+  mfu = flops_per_step / (step_time * peak)
+  tokens_per_sec = tokens / step_time
+  loss = float(out.metrics.loss[0])
+
+  result = {
+      "metric": "dense_lm_train_mfu",
+      "value": round(mfu, 4),
+      "unit": "mfu_fraction",
+      "vs_baseline": round(mfu / 0.45, 4),
+      "detail": {
+          "device": str(getattr(dev, "device_kind", dev.platform)),
+          "params_m": round(n_params / 1e6, 1),
+          "step_time_s": round(step_time, 4),
+          "tokens_per_sec": round(tokens_per_sec, 1),
+          "flops_per_step_g": round(flops_per_step / 1e9, 1),
+          "peak_tflops": peak / 1e12,
+          "loss": round(loss, 3),
+      },
+  }
+  print(json.dumps(result))
+
+
+if __name__ == "__main__":
+  main()

@@ -28,6 +28,20 @@ from lingvo_tpu.core.nested_map import NestedMap
 from lingvo_tpu.core.py_utils import WeightInit, WeightParams
 
 
+def StackedInstantiateVariables(body: "BaseLayer", key: jax.Array,
+                                n: int) -> NestedMap:
+  """n independently-seeded copies of body's theta, stacked on axis 0.
+
+  Shared by scan-over-layers (RepeatedTransformerLayer) and pipeline stages
+  (PipelinedLayer); the caller must have FinalizePaths()'d the tree.
+  """
+
+  def _One(i):
+    return body.InstantiateVariables(jax.random.fold_in(key, i))
+
+  return jax.vmap(_One)(jnp.arange(n))
+
+
 class BaseLayer:
   """Base class for all layers.
 

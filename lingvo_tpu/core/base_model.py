@@ -161,12 +161,19 @@ class BaseTask(base_layer.BaseLayer):
         full_theta = self._MergeSubset(frozen_rest, trainable)
         with py_utils.StepSeedContext(step_key):
           with py_utils.ForwardStateContext() as fwd:
-            metrics_, per_example_ = self.FProp(full_theta, input_batch)
-        loss_val, _ = metrics_[lrn.p.loss_name]
+            with py_utils.AuxLossContext() as aux_losses:
+              metrics_, per_example_ = self.FProp(full_theta, input_batch)
+        loss_val, loss_w = metrics_[lrn.p.loss_name]
+        total = jnp.asarray(loss_val, jnp.float32)
+        if aux_losses:
+          aux_total = sum(jnp.asarray(v, jnp.float32)
+                          for v in aux_losses.values())
+          total = total + aux_total
+          metrics_ = metrics_.Copy()
+          metrics_.aux_loss = (aux_total, loss_w)
         reg = lrn.RegularizationLoss(trainable)
         # fwd updates are tracers from this trace: they MUST exit via aux.
-        return jnp.asarray(loss_val, jnp.float32) + reg, (metrics_,
-                                                          per_example_, fwd)
+        return total + reg, (metrics_, per_example_, fwd)
 
       trainable = self._TrainableSubset(theta, lrn)
       (_, (metrics, per_example, fwd_updates)), grads = jax.value_and_grad(

@@ -408,6 +408,36 @@ def AddForwardStateUpdate(path: str, value: Any) -> None:
     stack[-1][path] = value
 
 
+@contextlib.contextmanager
+def AuxLossContext():
+  """Collects auxiliary losses (MoE load-balancing etc.) emitted in FProp.
+
+  Yields a dict {path: scalar}; the train step adds their sum to the
+  optimized loss (ref: gshard aux_loss accumulation).
+  """
+  stack = _Stack("aux_loss")
+  collected: dict[str, Any] = {}
+  stack.append(collected)
+  try:
+    yield collected
+  finally:
+    stack.pop()
+
+
+def AddAuxLoss(path: str, value: Any) -> None:
+  """Adds an aux loss scalar (accumulates across repeated python calls).
+
+  IMPORTANT: values recorded inside a `lax.scan`/`vmap` body are tracers
+  local to that trace — layers that scan a body (RepeatedTransformerLayer,
+  PipelinedLayer) must open their OWN AuxLossContext inside the body, carry
+  the sum out through scan outputs, and re-emit it outside (they do).
+  """
+  stack = _Stack("aux_loss")
+  if stack:
+    prev = stack[-1].get(path)
+    stack[-1][path] = value if prev is None else prev + value
+
+
 def ApplyForwardStateUpdates(theta: NestedMap, updates: dict,
                              root_layer) -> NestedMap:
   """Merges collected forward-state updates back into a theta pytree.

@@ -294,32 +294,38 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
   def InstantiateVariables(self, key):
     if self._path is None:
       self.FinalizePaths()
-    # Stack: layer i's weights use a per-i fold of the key, all materialized
-    # with one vmap (identical shapes by construction).
-    def _One(i):
-      return self.body.InstantiateVariables(jax.random.fold_in(key, i))
-
-    stacked = jax.vmap(_One)(jnp.arange(self.p.num_layers))
-    return NestedMap(body=stacked)
+    return NestedMap(body=base_layer.StackedInstantiateVariables(
+        self.body, key, self.p.num_layers))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
             aux_paddings=None, segment_ids=None):
     p = self.p
+    body_emitted_aux = False
 
     def _Body(carry, per_layer):
+      nonlocal body_emitted_aux
       theta_i, idx = per_layer
       # Fold the layer index into step seeds: each scan iteration gets its
-      # own dropout masks even though FProp is traced once.
+      # own dropout masks even though FProp is traced once. Aux losses must
+      # not leak scan tracers, so collect per-iteration and carry them out
+      # through the scan outputs.
       with py_utils.StepSeedSalt(idx):
-        x = self.body.FProp(theta_i, carry, paddings, aux_vecs, aux_paddings,
-                            segment_ids=segment_ids)
-      return x, ()
+        with py_utils.AuxLossContext() as aux:
+          x = self.body.FProp(theta_i, carry, paddings, aux_vecs,
+                              aux_paddings, segment_ids=segment_ids)
+      if aux:
+        body_emitted_aux = True
+      aux_sum = (sum(jnp.asarray(v, jnp.float32) for v in aux.values())
+                 if aux else jnp.zeros((), jnp.float32))
+      return x, aux_sum
 
     body_fn = _Body
     if p.per_layer_checkpoint:
       body_fn = jax.checkpoint(_Body)
-    out, _ = jax.lax.scan(body_fn, inputs,
-                          (theta.body, jnp.arange(p.num_layers)))
+    out, aux_per_layer = jax.lax.scan(body_fn, inputs,
+                                      (theta.body, jnp.arange(p.num_layers)))
+    if body_emitted_aux:
+      py_utils.AddAuxLoss(f"{self.path}/aux_loss", jnp.sum(aux_per_layer))
     return out
 
   def InitStates(self, theta, batch_size, max_len):

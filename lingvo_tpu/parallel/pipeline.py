@@ -1,0 +1,126 @@
+"""Pipeline parallelism inside one SPMD program.
+
+Re-designs `gshard_layers.LayerwiseShardablePipelinedLayer:180` (and the
+graph-mode `gpipe.PipeliningLayer:324`) the TPU way: stages are the leading
+dim of stacked weights, sharded over the 'stage' mesh axis; a shifting state
+buffer moves activations stage->stage each iteration (XLA lowers the shift of
+a stage-sharded buffer to collective-permute over ICI); micro-batches stream
+through a lax.scan. One program, no per-device graph surgery.
+
+Schedule: classic GPipe fill/drain — M micro-batches through L stages in
+M + L - 1 iterations; bubble fraction (L-1)/(M+L-1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.parallel import mesh as mesh_lib
+
+
+class PipelinedLayer(base_layer.BaseLayer):
+  """Runs `body` as `num_stages` pipeline stages over micro-batches.
+
+  theta.body: every leaf stacked [num_stages, ...], annotated to shard dim 0
+  over 'stage'. FProp consumes [B, T, D] (B must divide into
+  num_microbatches) and is numerically identical to running the body layers
+  sequentially.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_stages", 1, "Pipeline stages L.")
+    p.Define("num_microbatches", 1, "Micro-batches M per global batch.")
+    p.Define("body", None, "Stage body layer params (one stage's compute).")
+    p.Define("stage_axis", mesh_lib.STAGE_AXIS,
+             "Mesh axis the stage dim shards over.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.num_stages >= 1 and p.body is not None
+    self.CreateChild("body", p.body)
+
+  def InstantiateVariables(self, key):
+    if self._path is None:
+      self.FinalizePaths()
+    return NestedMap(body=base_layer.StackedInstantiateVariables(
+        self.body, key, self.p.num_stages))
+
+  def _StageSpec(self, x):
+    """PartitionSpec sharding dim 0 (stages) of a buffer."""
+    return (self.p.stage_axis,) + (None,) * (x.ndim - 1)
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    l, m = p.num_stages, p.num_microbatches
+    b = inputs.shape[0]
+    assert b % m == 0, (b, m)
+    mb = b // m
+    # [M, mb, T, D] microbatches
+    x_micro = inputs.reshape((m, mb) + inputs.shape[1:])
+    pad_micro = (paddings.reshape((m, mb) + paddings.shape[1:])
+                 if paddings is not None else
+                 jnp.zeros((m, mb) + inputs.shape[1:2], jnp.float32))
+
+    state = jnp.zeros((l,) + x_micro.shape[1:], inputs.dtype)
+    pad_state = jnp.zeros((l,) + pad_micro.shape[1:], jnp.float32)
+    outputs = jnp.zeros_like(x_micro)
+    stage_ids = jnp.arange(l)
+
+    aux_emitted = False
+
+    def _RunStages(theta_body, xs, pads):
+      def _One(theta_i, x_i, pad_i, sid):
+        nonlocal aux_emitted
+        # aux losses inside vmap/scan are trace-local: collect per stage and
+        # return through the vmap output.
+        with py_utils.StepSeedSalt(sid):
+          with py_utils.AuxLossContext() as aux:
+            out = self.body.FProp(theta_i, x_i, pad_i)
+        if aux:
+          aux_emitted = True
+        aux_sum = (sum(jnp.asarray(v, jnp.float32) for v in aux.values())
+                   if aux else jnp.zeros((), jnp.float32))
+        return out[0] if isinstance(out, tuple) else out, aux_sum
+
+      return jax.vmap(_One)(theta_body, xs, pads, stage_ids)
+
+    def _Iter(carry, i):
+      state, pad_state, outputs, aux_acc = carry
+      # shift: stage s input <- stage s-1 output; stage 0 <- microbatch i
+      feed_idx = jnp.minimum(i, m - 1)
+      x_in = jax.lax.dynamic_index_in_dim(x_micro, feed_idx, 0,
+                                          keepdims=False)
+      pad_in = jax.lax.dynamic_index_in_dim(pad_micro, feed_idx, 0,
+                                            keepdims=False)
+      shifted = jnp.roll(state, 1, axis=0).at[0].set(x_in)
+      pad_shifted = jnp.roll(pad_state, 1, axis=0).at[0].set(pad_in)
+      shifted = mesh_lib.WithShardingConstraint(shifted, self._StageSpec(shifted))
+      new_state, aux_per_stage = _RunStages(theta.body, shifted, pad_shifted)
+      new_state = mesh_lib.WithShardingConstraint(new_state,
+                                                 self._StageSpec(new_state))
+      # aux losses only from stages holding a REAL microbatch (stage s at
+      # iteration i processes microbatch i-s; bubble stages hold garbage).
+      micro_idx = i - stage_ids
+      valid = ((micro_idx >= 0) & (micro_idx < m)).astype(jnp.float32)
+      aux_acc = aux_acc + jnp.sum(aux_per_stage * valid)
+      # collect the last stage's output; warmup garbage lands on slot 0 and
+      # is overwritten by the real microbatch-0 result at iteration l-1.
+      out_idx = jnp.maximum(i - (l - 1), 0)
+      outputs = jax.lax.dynamic_update_index_in_dim(
+          outputs, new_state[-1], out_idx, 0)
+      return (new_state, pad_shifted, outputs, aux_acc), ()
+
+    aux_acc0 = jnp.zeros((), jnp.float32)
+    (state, pad_state, outputs, aux_acc), _ = jax.lax.scan(
+        _Iter, (state, pad_state, outputs, aux_acc0), jnp.arange(m + l - 1))
+    if aux_emitted:
+      py_utils.AddAuxLoss(f"{self.path}/aux_loss", aux_acc)
+    return outputs.reshape(inputs.shape)

@@ -1,0 +1,106 @@
+"""Ring attention: exact causal attention with the sequence dim sharded.
+
+BEYOND-reference capability (SURVEY.md §5: the reference has no sequence/
+context parallelism — only blocked approximations). Here the sequence axis is
+a first-class mesh dim ('seq'): each device holds a T/n slice of Q/K/V; K/V
+blocks rotate around the ring with `ppermute` over ICI while each device
+accumulates its queries' attention online (flash-attention style running
+max/denominator), overlapping compute with neighbor transfers.
+
+Implemented with shard_map so the collective schedule is explicit; numerics
+match full attention exactly (f32 accumulators).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from lingvo_tpu.parallel import mesh as mesh_lib
+
+
+def _BlockAttend(q, k, v, mask):
+  """Block scores: q [b,tq,n,h], k/v [b,tk,n,h] -> (scores, ctx-unnormed).
+
+  Returns (m, l, o): running max [b,n,tq], denom [b,n,tq], out [b,tq,n,h]
+  for THIS block only (caller merges online).
+  """
+  s = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32)
+  s = jnp.where(mask, s, -jnp.inf)
+  m = jnp.max(s, axis=-1)                               # [b,n,q]
+  m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+  p = jnp.exp(s - m_safe[..., None])
+  p = jnp.where(mask, p, 0.0)
+  l = jnp.sum(p, axis=-1)                               # [b,n,q]
+  o = jnp.einsum("bnqk,bknh->bqnh", p.astype(v.dtype), v)
+  return m, l, o.astype(jnp.float32)
+
+
+def _Merge(m1, l1, o1, m2, l2, o2):
+  """Online-softmax merge of two partial attention results."""
+  m = jnp.maximum(m1, m2)
+  m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+  a1 = jnp.where(jnp.isfinite(m1), jnp.exp(m1 - m_safe), 0.0)
+  a2 = jnp.where(jnp.isfinite(m2), jnp.exp(m2 - m_safe), 0.0)
+  l = a1 * l1 + a2 * l2
+  o = (a1.swapaxes(1, 2)[..., None] * o1 +
+       a2.swapaxes(1, 2)[..., None] * o2)
+  return m, l, o
+
+
+def RingAttention(q, k, v, *, mesh: Mesh, seq_axis: str = mesh_lib.SEQ_AXIS,
+                  causal: bool = True):
+  """q/k/v: [b, T, n, h] GLOBALLY, sharded [b, T/num, n, h] over seq_axis.
+
+  Returns [b, T, n, h] attention output with the same sharding. Call inside
+  jit with q/k/v sharded (or let jit reshard by annotation).
+  """
+  num = mesh.shape[seq_axis]
+  axis = seq_axis
+
+  def _Shard(q, k, v):
+    # per-device shapes
+    b, t_local, n, h = q.shape
+    my_idx = jax.lax.axis_index(axis)
+    scale = 1.0 / math.sqrt(h)
+    q = q * scale
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)      # global q positions
+
+    m0 = jnp.full((b, n, t_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, n, t_local), jnp.float32)
+    o0 = jnp.zeros((b, t_local, n, h), jnp.float32)
+
+    perm = [(i, (i + 1) % num) for i in range(num)]
+
+    def _Step(i, carry):
+      m, l, o, k_blk, v_blk, blk_idx = carry
+      # mask for the currently-held K/V block (global positions)
+      k_pos = blk_idx * t_local + jnp.arange(t_local)
+      if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+      else:
+        mask = jnp.ones((t_local, t_local), jnp.bool_)
+      bm, bl, bo = _BlockAttend(q, k_blk, v_blk, mask[None, None])
+      m, l, o = _Merge(m, l, o, bm, bl, bo)
+      # rotate K/V to the next device (ring over ICI)
+      k_next = jax.lax.ppermute(k_blk, axis, perm)
+      v_next = jax.lax.ppermute(v_blk, axis, perm)
+      idx_next = jax.lax.ppermute(blk_idx, axis, perm)
+      return m, l, o, k_next, v_next, idx_next
+
+    carry = (m0, l0, o0, k, v, my_idx)
+    carry = jax.lax.fori_loop(0, num, _Step, carry)
+    m, l, o, _, _, _ = carry
+    l = jnp.maximum(l, 1e-20)
+    out = o / l.swapaxes(1, 2)[..., None]
+    return out.astype(q.dtype)
+
+  spec = PartitionSpec(None, axis, None, None)
+  return jax.shard_map(
+      _Shard, mesh=mesh, in_specs=(spec, spec, spec),
+      out_specs=spec, check_vma=False)(q, k, v)
