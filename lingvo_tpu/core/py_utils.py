@@ -428,14 +428,47 @@ def AddAuxLoss(path: str, value: Any) -> None:
   """Adds an aux loss scalar (accumulates across repeated python calls).
 
   IMPORTANT: values recorded inside a `lax.scan`/`vmap` body are tracers
-  local to that trace — layers that scan a body (RepeatedTransformerLayer,
-  PipelinedLayer) must open their OWN AuxLossContext inside the body, carry
-  the sum out through scan outputs, and re-emit it outside (they do).
+  local to that trace — layers that scan a body must wrap the body call in
+  `CollectAuxLosses` and re-emit the carried-out sum outside the scan
+  (RepeatedTransformerLayer / PipelinedLayer do).
   """
   stack = _Stack("aux_loss")
   if stack:
     prev = stack[-1].get(path)
     stack[-1][path] = value if prev is None else prev + value
+
+
+class _AuxFlag:
+  """Mutable trace-time flag shared across scan-body invocations."""
+
+  def __init__(self):
+    self.emitted = False
+
+
+def CollectAuxLosses(fn, flag: _AuxFlag):
+  """Wraps a scan/vmap body so aux losses exit via the return value.
+
+  Returns a callable with the same signature as `fn` whose result is
+  `(fn(...), aux_sum_scalar_f32)`; sets `flag.emitted` at trace time if the
+  body emitted any aux loss. The caller re-emits the summed scalar with
+  AddAuxLoss AFTER the scan, keeping tracers inside their trace.
+  """
+
+  def _Wrapped(*args, **kwargs):
+    with AuxLossContext() as aux:
+      out = fn(*args, **kwargs)
+    if aux:
+      flag.emitted = True
+    import jax.numpy as jnp_
+    aux_sum = (sum(jnp_.asarray(v, jnp_.float32) for v in aux.values())
+               if aux else jnp_.zeros((), jnp_.float32))
+    return out, aux_sum
+
+  return _Wrapped
+
+
+def NewAuxFlag() -> _AuxFlag:
+  return _AuxFlag()
 
 
 def ApplyForwardStateUpdates(theta: NestedMap, updates: dict,

@@ -300,23 +300,20 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
             aux_paddings=None, segment_ids=None):
     p = self.p
-    body_emitted_aux = False
+    aux_flag = py_utils.NewAuxFlag()
+
+    def _BodyInner(theta_i, idx, carry):
+      # Fold the layer index into step seeds: each scan iteration gets its
+      # own dropout masks even though FProp is traced once.
+      with py_utils.StepSeedSalt(idx):
+        return self.body.FProp(theta_i, carry, paddings, aux_vecs,
+                               aux_paddings, segment_ids=segment_ids)
+
+    wrapped = py_utils.CollectAuxLosses(_BodyInner, aux_flag)
 
     def _Body(carry, per_layer):
-      nonlocal body_emitted_aux
       theta_i, idx = per_layer
-      # Fold the layer index into step seeds: each scan iteration gets its
-      # own dropout masks even though FProp is traced once. Aux losses must
-      # not leak scan tracers, so collect per-iteration and carry them out
-      # through the scan outputs.
-      with py_utils.StepSeedSalt(idx):
-        with py_utils.AuxLossContext() as aux:
-          x = self.body.FProp(theta_i, carry, paddings, aux_vecs,
-                              aux_paddings, segment_ids=segment_ids)
-      if aux:
-        body_emitted_aux = True
-      aux_sum = (sum(jnp.asarray(v, jnp.float32) for v in aux.values())
-                 if aux else jnp.zeros((), jnp.float32))
+      x, aux_sum = wrapped(theta_i, idx, carry)
       return x, aux_sum
 
     body_fn = _Body
@@ -324,7 +321,7 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
       body_fn = jax.checkpoint(_Body)
     out, aux_per_layer = jax.lax.scan(body_fn, inputs,
                                       (theta.body, jnp.arange(p.num_layers)))
-    if body_emitted_aux:
+    if aux_flag.emitted:
       py_utils.AddAuxLoss(f"{self.path}/aux_loss", jnp.sum(aux_per_layer))
     return out
 

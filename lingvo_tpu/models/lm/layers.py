@@ -43,6 +43,14 @@ class TransformerLm(base_model.BaseTask):
     p.Define("softmax_logits_soft_max", 30.0, "Logit tanh cap (gshard-style).")
     p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
     p.Define("atten_dropout_prob", 0.0, "Attention dropout.")
+    p.Define("num_experts", 0,
+             "If >0, GShard MoE: alternate dense and MoE layers "
+             "(num_layers must be even; scanned as dense+MoE blocks).")
+    p.Define("moe_hidden_dim", 0, "Expert FFN dim (0 = hidden_dim).")
+    p.Define("moe_num_groups", 1, "Gating groups.")
+    p.Define("moe_capacity_factor", 2.0, "Expert capacity factor.")
+    p.Define("moe_aux_loss_weight", 0.01, "Load-balance loss weight.")
+    p.Define("moe_second_expert_policy", "all", "'all' or 'random'.")
     return p
 
   def __init__(self, params):
@@ -74,7 +82,27 @@ class TransformerLm(base_model.BaseTask):
     layer_body.tr_fflayer_tpl.residual_dropout_prob = p.residual_dropout_prob
     layer_body.tr_fflayer_tpl.weight_split_dims_mapping = (None, "model")
 
-    if p.use_repeat_layer:
+    if p.num_experts > 0:
+      from lingvo_tpu.parallel import gshard
+      assert p.num_layers % 2 == 0, "MoE interleave needs even num_layers"
+      moe_tpl = gshard.MoETransformerLayer.Params()
+      moe_tpl.tr_atten_tpl = layer_body.tr_atten_tpl.Copy()
+      moe_tpl.moe_tpl = gshard.MoEFeedForwardLayer.Params().Set(
+          hidden_dim=p.moe_hidden_dim or p.hidden_dim,
+          num_experts=p.num_experts,
+          num_groups=p.moe_num_groups,
+          capacity_factor=p.moe_capacity_factor,
+          aux_loss_weight=p.moe_aux_loss_weight,
+          second_expert_policy=p.moe_second_expert_policy,
+          residual_dropout_prob=p.residual_dropout_prob)
+      block = gshard.DenseMoEBlock.Params().Set(
+          input_dim=p.model_dim, num_heads=p.num_heads,
+          dense_tpl=layer_body, moe_tpl=moe_tpl)
+      self.CreateChild(
+          "stack",
+          transformer_lib.RepeatedTransformerLayer.Params().Set(
+              num_layers=p.num_layers // 2, body=block))
+    elif p.use_repeat_layer:
       self.CreateChild(
           "stack",
           transformer_lib.RepeatedTransformerLayer.Params().Set(

@@ -102,6 +102,48 @@ class DenseLm8B(DenseLmTemplate):
 
 
 @model_registry.RegisterSingleTaskModel
+class MoELmTiny(DenseLmTemplate):
+  """Smoke-test MoE LM (8 experts, alternate dense/MoE layers)."""
+
+  SEQUENCE_LENGTH = 64
+  BATCH_SIZE = 4
+  VOCAB_SIZE = 128
+  MODEL_DIM = 64
+  NUM_LAYERS = 2
+  NUM_HEADS = 4
+  HIDDEN_DIM = 128
+  LEARNING_RATE = 3e-3
+  NUM_EXPERTS = 8
+
+  def Task(self):
+    p = super().Task()
+    p.num_experts = self.NUM_EXPERTS
+    p.moe_num_groups = self.BATCH_SIZE
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class MoELm64E(DenseLmTemplate):
+  """The BASELINE north-star config: 64-expert GShard MoE transformer
+  (ref `tasks/lm/README.md` MoE models; target >=45% MFU on v5p-128)."""
+
+  SEQUENCE_LENGTH = 1024
+  BATCH_SIZE = 16
+  MODEL_DIM = 1024
+  NUM_LAYERS = 24
+  NUM_HEADS = 16
+  HIDDEN_DIM = 4096
+  NUM_EXPERTS = 64
+
+  def Task(self):
+    p = super().Task()
+    p.num_experts = self.NUM_EXPERTS
+    p.moe_num_groups = self.BATCH_SIZE
+    p.moe_second_expert_policy = "random"
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
 class DenseLm128B(DenseLmTemplate):
   """Ref DenseLm128B8x8 (`synthetic_packed_input.py:200-237`)."""
 

@@ -1,0 +1,64 @@
+"""Synthetic MT input: a deterministic learnable 'translation'.
+
+Ref shape contract: `tasks/mt/input_generator.py` NmtInput — batches with
+src.{ids,paddings}, tgt.{ids,labels,paddings,weights}. The synthetic task
+maps target = reversed(source) with a fixed token offset — forces real use of
+encoder attention (reversal) while remaining quickly learnable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class SyntheticMtInput(base_input_generator.BaseInputGenerator):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("src_seq_len", 16, "Max source length.")
+    p.Define("tgt_seq_len", 18, "Max target length (incl SOS/EOS).")
+    p.Define("vocab_size", 64, "Vocab (ids 3.. used for content).")
+    p.Define("sos_id", 1, "SOS.")
+    p.Define("eos_id", 2, "EOS.")
+    p.Define("offset", 3, "Token mapping offset.")
+    p.Define("reverse", False,
+             "Reverse source order in the target (harder task).")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 104729 * self._step) % (2**31))
+    self._step += 1
+    b = p.batch_size
+    src_ids = np.zeros((b, p.src_seq_len), np.int32)
+    src_pad = np.ones((b, p.src_seq_len), np.float32)
+    tgt_ids = np.zeros((b, p.tgt_seq_len), np.int32)
+    tgt_labels = np.zeros((b, p.tgt_seq_len), np.int32)
+    tgt_pad = np.ones((b, p.tgt_seq_len), np.float32)
+    content = p.vocab_size - 3
+    for i in range(b):
+      n = rng.randint(3, p.src_seq_len + 1)
+      src = rng.randint(0, content, n)
+      src_ids[i, :n] = 3 + src
+      src_pad[i, :n] = 0.0
+      mapped = src[::-1] if p.reverse else src
+      tgt = 3 + (mapped + p.offset) % content
+      # tgt_ids = [SOS, tgt...]; labels = [tgt..., EOS]
+      m = min(n + 1, p.tgt_seq_len)
+      tgt_ids[i, 0] = p.sos_id
+      tgt_ids[i, 1:m] = tgt[:m - 1]
+      tgt_labels[i, :m - 1] = tgt[:m - 1]
+      tgt_labels[i, m - 1] = p.eos_id
+      tgt_pad[i, :m] = 0.0
+    return NestedMap(
+        src=NestedMap(ids=src_ids, paddings=src_pad),
+        tgt=NestedMap(ids=tgt_ids, labels=tgt_labels, paddings=tgt_pad))

@@ -1,0 +1,173 @@
+"""MT transformer encoder/decoder (ref: lingvo/tasks/mt/{encoder,decoder}.py).
+
+Batch-major transformer enc-dec with beam-search decoding through the
+KV-cache ExtendStep path (no host round trips, unlike the reference's C++
+BeamSearchStep loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import beam_search as beam_search_lib
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import transformer as transformer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TransformerEncoder(base_layer.BaseLayer):
+  """Embedding + positional + self-attention stack (ref mt/encoder.py)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 32000, "Source vocab.")
+    p.Define("model_dim", 512, "Model dim.")
+    p.Define("num_layers", 6, "Depth.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("hidden_dim", 2048, "FFN dim.")
+    p.Define("input_dropout_prob", 0.0, "Dropout on embeddings.")
+    p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "emb",
+        layers_lib.SimpleEmbeddingLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.model_dim,
+            scale_sqrt_depth=True))
+    self.CreateChild(
+        "pos_emb",
+        layers_lib.PositionalEmbeddingLayer.Params().Set(
+            embedding_dim=p.model_dim))
+    tpl = transformer_lib.TransformerLayer.Params().Set(
+        input_dim=p.model_dim, num_heads=p.num_heads, hidden_dim=p.hidden_dim,
+        mask_self_atten=False)
+    tpl.tr_atten_tpl.residual_dropout_prob = p.residual_dropout_prob
+    tpl.tr_fflayer_tpl.residual_dropout_prob = p.residual_dropout_prob
+    self.CreateChild(
+        "stack",
+        transformer_lib.StackedTransformerLayers.Params().Set(
+            num_layers=p.num_layers, input_dim=p.model_dim,
+            transformer_layer_params_tpl=tpl))
+    self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
+
+  def FProp(self, theta, ids, paddings):
+    p = self.p
+    x = self.emb.EmbLookup(theta.emb, ids)
+    x = x + self.pos_emb.FProp(NestedMap(), seq_length=ids.shape[1])[None]
+    if p.input_dropout_prob > 0:
+      x = self.dropout.FProp(
+          self.ChildTheta(theta, "dropout"), x,
+          keep_prob=1.0 - p.input_dropout_prob)
+    return self.stack.FProp(theta.stack, x, paddings)
+
+
+class TransformerDecoder(base_layer.BaseLayer):
+  """Causal stack with cross-attention + softmax + beam search
+  (ref mt/decoder.py)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 32000, "Target vocab.")
+    p.Define("model_dim", 512, "Model dim.")
+    p.Define("num_layers", 6, "Depth.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("hidden_dim", 2048, "FFN dim.")
+    p.Define("label_smoothing", 0.1, "Label smoothing uncertainty.")
+    p.Define("input_dropout_prob", 0.0, "Embedding dropout.")
+    p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
+    p.Define("beam_search", beam_search_lib.BeamSearchHelper.Params(),
+             "Beam search config.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "emb",
+        layers_lib.SimpleEmbeddingLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.model_dim,
+            scale_sqrt_depth=True))
+    self.CreateChild(
+        "pos_emb",
+        layers_lib.PositionalEmbeddingLayer.Params().Set(
+            embedding_dim=p.model_dim))
+    tpl = transformer_lib.TransformerLayer.Params().Set(
+        input_dim=p.model_dim, num_heads=p.num_heads, hidden_dim=p.hidden_dim,
+        mask_self_atten=True, has_aux_atten=True)
+    tpl.tr_atten_tpl.residual_dropout_prob = p.residual_dropout_prob
+    tpl.tr_fflayer_tpl.residual_dropout_prob = p.residual_dropout_prob
+    self.CreateChild(
+        "stack",
+        transformer_lib.StackedTransformerLayers.Params().Set(
+            num_layers=p.num_layers, input_dim=p.model_dim,
+            transformer_layer_params_tpl=tpl))
+    self.CreateChild(
+        "softmax",
+        layers_lib.SimpleFullSoftmax.Params().Set(
+            input_dim=p.model_dim, num_classes=p.vocab_size))
+    self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
+
+  def _Embed(self, theta, ids, position=None, seq_length=None):
+    x = self.emb.EmbLookup(theta.emb, ids)
+    if position is not None:
+      pe = self.pos_emb.FProp(NestedMap(), position=position)
+    else:
+      pe = self.pos_emb.FProp(NestedMap(), seq_length=seq_length)[None]
+    x = x + pe.astype(x.dtype)
+    if self.p.input_dropout_prob > 0:
+      x = self.dropout.FProp(
+          self.ChildTheta(theta, "dropout"), x,
+          keep_prob=1.0 - self.p.input_dropout_prob)
+    return x
+
+  def FProp(self, theta, encoder_out, src_paddings, target_ids,
+            target_paddings, target_labels):
+    """Teacher-forced xent. Returns NestedMap(per_example_xent, logits,
+    avg_xent, total_weight)."""
+    p = self.p
+    x = self._Embed(theta, target_ids, seq_length=target_ids.shape[1])
+    x = self.stack.FProp(theta.stack, x, target_paddings,
+                         aux_vecs=encoder_out, aux_paddings=src_paddings)
+    xent = self.softmax.FProp(
+        theta.softmax, x, class_ids=target_labels,
+        label_smoothing=p.label_smoothing)
+    weights = py_utils.SequenceMask(target_paddings)
+    total_weight = jnp.maximum(jnp.sum(weights), 1e-8)
+    avg = jnp.sum(xent.per_example_xent * weights) / total_weight
+    return NestedMap(
+        per_example_xent=xent.per_example_xent, logits=xent.logits,
+        avg_xent=avg, total_weight=total_weight)
+
+  def BeamSearchDecode(self, theta, encoder_out, src_paddings):
+    """Beam search over the KV-cache ExtendStep path."""
+    p = self.p
+    bs_p = p.beam_search
+    b = encoder_out.shape[0]
+    k = bs_p.num_hyps_per_beam
+    t_max = bs_p.target_seq_len
+
+    # tile encoder outputs over beams: [B*K, S, D]
+    enc = jnp.repeat(encoder_out, k, axis=0)
+    src_pad = jnp.repeat(src_paddings, k, axis=0)
+    stack_states = self.stack.InitStates(theta.stack, b * k, t_max)
+    init_states = NestedMap(stack=stack_states,
+                            step=jnp.zeros((), jnp.int32))
+
+    def _StepFn(states, ids_t):
+      x = self._Embed(theta, ids_t,
+                      position=states.step.astype(jnp.float32)[None, None])
+      out, new_stack = self.stack.ExtendStep(
+          theta.stack, x, states.stack, aux_vecs=enc, aux_paddings=src_pad)
+      logits = self.softmax.Logits(theta.softmax, out)[:, 0, :]
+      return logits, NestedMap(stack=new_stack, step=states.step + 1)
+
+    helper = beam_search_lib.BeamSearchHelper(bs_p)
+    return helper.Search(b, init_states, _StepFn)

@@ -1,0 +1,81 @@
+"""MT task: encoder-decoder training + beam-search decode + BLEU.
+
+Ref: lingvo/tasks/mt/model.py (TransformerModel): batch fields
+src.{ids,paddings} tgt.{ids,labels,paddings}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import metrics as metrics_lib
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.mt import layers as mt_layers
+
+
+class TransformerModel(base_model.BaseTask):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("encoder", mt_layers.TransformerEncoder.Params(), "Encoder.")
+    p.Define("decoder", mt_layers.TransformerDecoder.Params(), "Decoder.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("enc", self.p.encoder)
+    self.CreateChild("dec", self.p.decoder)
+
+  def ComputePredictions(self, theta, input_batch):
+    encoder_out = self.enc.FProp(theta.enc, input_batch.src.ids,
+                                 input_batch.src.paddings)
+    dec_out = self.dec.FProp(
+        theta.dec, encoder_out, input_batch.src.paddings,
+        input_batch.tgt.ids, input_batch.tgt.paddings,
+        input_batch.tgt.labels)
+    return dec_out
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    metrics = NestedMap(
+        loss=(predictions.avg_xent, predictions.total_weight),
+        log_pplx=(predictions.avg_xent, predictions.total_weight))
+    acc = jnp.sum(
+        (jnp.argmax(predictions.logits, -1) == input_batch.tgt.labels) *
+        (1.0 - input_batch.tgt.paddings)) / predictions.total_weight
+    metrics.fraction_of_correct_next_step_preds = (acc,
+                                                   predictions.total_weight)
+    return metrics, NestedMap(xent=predictions.per_example_xent)
+
+  def Decode(self, theta, input_batch):
+    encoder_out = self.enc.FProp(theta.enc, input_batch.src.ids,
+                                 input_batch.src.paddings)
+    hyps = self.dec.BeamSearchDecode(theta.dec, encoder_out,
+                                     input_batch.src.paddings)
+    return NestedMap(
+        topk_ids=hyps.topk_ids, topk_lens=hyps.topk_lens,
+        topk_scores=hyps.topk_scores,
+        target_labels=input_batch.tgt.labels,
+        target_paddings=input_batch.tgt.paddings)
+
+  def CreateDecoderMetrics(self):
+    return {
+        "corpus_bleu": metrics_lib.CorpusBleuMetric(),
+        "examples": metrics_lib.AverageMetric(),
+    }
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    eos = self.dec.p.beam_search.target_eos_id
+    best = np.asarray(decode_out.topk_ids[:, 0, :])
+    lens = np.asarray(decode_out.topk_lens[:, 0])
+    labels = np.asarray(decode_out.target_labels)
+    pads = np.asarray(decode_out.target_paddings)
+    for i in range(best.shape[0]):
+      hyp = [str(t) for t in best[i, :lens[i]] if t != eos]
+      ref_len = int((1.0 - pads[i]).sum())
+      ref = [str(t) for t in labels[i, :ref_len] if t != eos]
+      decoder_metrics["corpus_bleu"].Update(ref, hyp)
+      decoder_metrics["examples"].Update(1.0)

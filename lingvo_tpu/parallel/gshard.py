@@ -214,6 +214,46 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     return inputs + out
 
 
+class DenseMoEBlock(base_layer.BaseLayer):
+  """The GShard interleave unit: one dense transformer layer + one MoE layer.
+
+  Ref: gshard MoE transformers alternate dense and MoE feed-forwards
+  (`gshard_builder.py` DenseBuilder.MoE interleave); scanning this block
+  N/2 times gives an N-layer half-MoE stack with O(1) compile time.
+  """
+
+  @classmethod
+  def Params(cls):
+    from lingvo_tpu.core import transformer as transformer_lib
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("dense_tpl", transformer_lib.TransformerLayer.Params(),
+             "Dense transformer layer template.")
+    p.Define("moe_tpl", None, "MoETransformerLayer template.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "dense",
+        p.dense_tpl.Copy().Set(input_dim=p.input_dim, num_heads=p.num_heads))
+    moe_tpl = p.moe_tpl or MoETransformerLayer.Params()
+    self.CreateChild(
+        "moe_layer",
+        moe_tpl.Copy().Set(input_dim=p.input_dim, num_heads=p.num_heads))
+
+  def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
+            aux_paddings=None, atten_mask=None, segment_ids=None):
+    x = self.dense.FProp(theta.dense, inputs, paddings, aux_vecs,
+                         aux_paddings, atten_mask=atten_mask,
+                         segment_ids=segment_ids)
+    return self.moe_layer.FProp(theta.moe_layer, x, paddings,
+                                atten_mask=atten_mask,
+                                segment_ids=segment_ids)
+
+
 class MoETransformerLayer(base_layer.BaseLayer):
   """Transformer layer whose FFN is an MoE block (GShard MoE transformer)."""
 

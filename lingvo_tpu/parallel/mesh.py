@@ -196,6 +196,13 @@ def PutBatch(mesh: Mesh, batch: NestedMap,
       lambda x, s: jax.device_put(jnp.asarray(x), s), batch, shardings)
 
 
+def MeshContext(mesh: Mesh):
+  """Enters `mesh` as the ambient mesh so PartitionSpec-based
+  with_sharding_constraint hints (MoE dispatch, pipeline buffers) reach
+  GSPMD. Use around jit calls: `with mesh_lib.MeshContext(mesh): ...`."""
+  return jax.set_mesh(mesh)
+
+
 def WithShardingConstraint(x, spec_or_names):
   """MeshSplit equivalent (ref gshard_utils.MeshSplit): annotate inside jit.
 

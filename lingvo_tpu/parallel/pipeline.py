@@ -74,23 +74,18 @@ class PipelinedLayer(base_layer.BaseLayer):
     outputs = jnp.zeros_like(x_micro)
     stage_ids = jnp.arange(l)
 
-    aux_emitted = False
+    aux_flag = py_utils.NewAuxFlag()
+
+    def _OneStage(theta_i, x_i, pad_i, sid):
+      with py_utils.StepSeedSalt(sid):
+        out = self.body.FProp(theta_i, x_i, pad_i)
+      return out[0] if isinstance(out, tuple) else out
+
+    # aux losses inside vmap/scan are trace-local: carried out via outputs.
+    _one_wrapped = py_utils.CollectAuxLosses(_OneStage, aux_flag)
 
     def _RunStages(theta_body, xs, pads):
-      def _One(theta_i, x_i, pad_i, sid):
-        nonlocal aux_emitted
-        # aux losses inside vmap/scan are trace-local: collect per stage and
-        # return through the vmap output.
-        with py_utils.StepSeedSalt(sid):
-          with py_utils.AuxLossContext() as aux:
-            out = self.body.FProp(theta_i, x_i, pad_i)
-        if aux:
-          aux_emitted = True
-        aux_sum = (sum(jnp.asarray(v, jnp.float32) for v in aux.values())
-                   if aux else jnp.zeros((), jnp.float32))
-        return out[0] if isinstance(out, tuple) else out, aux_sum
-
-      return jax.vmap(_One)(theta_body, xs, pads, stage_ids)
+      return jax.vmap(_one_wrapped)(theta_body, xs, pads, stage_ids)
 
     def _Iter(carry, i):
       state, pad_state, outputs, aux_acc = carry
@@ -121,6 +116,6 @@ class PipelinedLayer(base_layer.BaseLayer):
     aux_acc0 = jnp.zeros((), jnp.float32)
     (state, pad_state, outputs, aux_acc), _ = jax.lax.scan(
         _Iter, (state, pad_state, outputs, aux_acc0), jnp.arange(m + l - 1))
-    if aux_emitted:
+    if aux_flag.emitted:
       py_utils.AddAuxLoss(f"{self.path}/aux_loss", aux_acc)
     return outputs.reshape(inputs.shape)

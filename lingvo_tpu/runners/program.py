@@ -75,12 +75,21 @@ class BaseProgram:
           lambda x: jax.device_put(jnp.asarray(x), sharding))
     return batch.Transform(jnp.asarray)
 
+  def _MeshScope(self):
+    """Ambient-mesh context so sharding hints inside FProps apply."""
+    import contextlib
+    if self.p.mesh is not None:
+      from lingvo_tpu.parallel import mesh as mesh_lib
+      return mesh_lib.MeshContext(self.p.mesh)
+    return contextlib.nullcontext()
+
   def Compile(self, state: NestedMap) -> None:
     """Ahead-of-time compile with a real batch (ref Compile:355)."""
     batch = self._PutBatch(self.input_generator.GetPreprocessedInputBatch())
     fn = self._GetStepFn(state)
     if hasattr(fn, "lower"):
-      fn.lower(state, batch).compile()
+      with self._MeshScope():
+        fn.lower(state, batch).compile()
 
   def _GetStepFn(self, state: NestedMap | None = None):
     raise NotImplementedError
@@ -140,13 +149,15 @@ class TrainProgram(BaseProgram):
     acc = None
     stats_acc = None
     t0 = time.time()
-    for _ in range(p.steps_per_loop):
-      batch = self._PutBatch(self.input_generator.GetPreprocessedInputBatch())
-      state, out = fn(state, batch)
-      acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
-      stats_pairs = NestedMap(
-          {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
-      stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
+    with self._MeshScope():
+      for _ in range(p.steps_per_loop):
+        batch = self._PutBatch(
+            self.input_generator.GetPreprocessedInputBatch())
+        state, out = fn(state, batch)
+        acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
+        stats_pairs = NestedMap(
+            {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+        stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
     # One host sync per loop (ref: one session.run per steps_per_loop).
     jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
     wall = time.time() - t0
@@ -206,12 +217,13 @@ class EvalProgram(BaseProgram):
     batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
                else _TakeN(gen, max_batches))
     n = 0
-    for batch in batches:
-      out = fn(theta, self._PutBatch(batch))
-      acc = metrics_lib.AccumulateMetrics(acc, out)
-      n += 1
-      if n >= max_batches:
-        break
+    with self._MeshScope():
+      for batch in batches:
+        out = fn(theta, self._PutBatch(batch))
+        acc = metrics_lib.AccumulateMetrics(acc, out)
+        n += 1
+        if n >= max_batches:
+          break
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)
@@ -249,13 +261,14 @@ class DecodeProgram(BaseProgram):
     batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
                else _TakeN(gen, self.p.steps_per_loop))
     n = 0
-    for batch in batches:
-      out = fn(theta, self._PutBatch(batch))
-      host_out = jax.tree_util.tree_map(np.asarray, out)
-      self._task.PostProcessDecodeOut(host_out, dec_metrics)
-      n += 1
-      if n >= self.p.steps_per_loop:
-        break
+    with self._MeshScope():
+      for batch in batches:
+        out = fn(theta, self._PutBatch(batch))
+        host_out = jax.tree_util.tree_map(np.asarray, out)
+        self._task.PostProcessDecodeOut(host_out, dec_metrics)
+        n += 1
+        if n >= self.p.steps_per_loop:
+          break
     result = self._task.DecodeFinalize(dec_metrics)
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)

@@ -199,7 +199,8 @@ class TestMoE:
     theta_s = jax.device_put(theta, shardings)
     assert "expert" in str(theta_s.wi.sharding.spec)
     x_s = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
-    out2 = jax.jit(layer.FProp)(theta_s, x_s)
+    with mesh_lib.MeshContext(mesh):
+      out2 = jax.jit(layer.FProp)(theta_s, x_s)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=2e-5)
 
   def test_moe_in_train_step_gets_aux_loss_metric(self):
