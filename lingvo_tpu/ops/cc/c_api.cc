@@ -1,0 +1,105 @@
+// C ABI surface for the input-pipeline library (consumed via ctypes).
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "record_io.h"
+#include "record_yielder.h"
+
+namespace lingvo_tpu {
+
+namespace {
+
+// Wraps a yielder with a pending-record slot so a too-small caller buffer
+// never loses the record (two-call protocol: a call that returns a size
+// larger than buf_len leaves the record pending for the next call).
+struct YielderHandle {
+  std::unique_ptr<RecordYielder> yielder;
+  std::string pending;
+  int pending_source = 0;
+  bool has_pending = false;
+};
+
+bool TypeSupported(const std::string& type) {
+  return type == "text" || type == "tfrecord" || type == "recordio" ||
+         type == "iota";
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns nullptr when the type prefix is unknown or the glob matches no
+// files (ref record_yielder.cc fails loudly on "Found no files") — the
+// Python wrapper raises.
+void* LTYielderNew(const char* file_pattern, uint64_t seed,
+                   int64_t shuffle_buffer_size, int32_t num_threads,
+                   int64_t max_epochs, int32_t shuffle, int32_t shard_index,
+                   int32_t num_shards) {
+  std::string type, pattern;
+  RecordIterator::ParseSpec(file_pattern, &type, &pattern);
+  if (!TypeSupported(type)) return nullptr;
+  if (type != "iota" && RecordIterator::Glob(pattern).empty()) return nullptr;
+  YielderOptions opts;
+  opts.file_pattern = file_pattern;
+  opts.seed = seed;
+  opts.shuffle_buffer_size = shuffle_buffer_size;
+  opts.num_threads = num_threads;
+  opts.max_epochs = max_epochs;
+  opts.shuffle = shuffle != 0;
+  opts.shard_index = shard_index;
+  opts.num_shards = num_shards;
+  auto* h = new YielderHandle();
+  h->yielder = std::make_unique<BasicRecordYielder>(opts);
+  return h;
+}
+
+void* LTMixYielderNew(void** children, const double* weights, int32_t n,
+                      uint64_t seed) {
+  std::vector<std::unique_ptr<RecordYielder>> kids;
+  std::vector<double> w(weights, weights + n);
+  for (int32_t i = 0; i < n; ++i) {
+    auto* child = static_cast<YielderHandle*>(children[i]);
+    kids.emplace_back(std::move(child->yielder));
+    delete child;
+  }
+  auto* h = new YielderHandle();
+  h->yielder = std::make_unique<WeightedMixRecordYielder>(
+      std::move(kids), w, seed);
+  return h;
+}
+
+// Fills buf (cap buf_len) with the next record; returns the record length,
+// or -1 when exhausted. If the returned length exceeds buf_len the record
+// was NOT consumed — call again with a buffer of at least that size.
+int64_t LTYielderNext(void* handle, char* buf, int64_t buf_len,
+                      int32_t* source_id) {
+  auto* h = static_cast<YielderHandle*>(handle);
+  if (!h->has_pending) {
+    int src = 0;
+    if (!h->yielder->Yield(&h->pending, &src)) return -1;
+    h->pending_source = src;
+    h->has_pending = true;
+  }
+  int64_t n = static_cast<int64_t>(h->pending.size());
+  if (n > buf_len) return n;  // record stays pending
+  std::memcpy(buf, h->pending.data(), n);
+  if (source_id) *source_id = h->pending_source;
+  h->has_pending = false;
+  return n;
+}
+
+int64_t LTYielderEpochs(void* handle) {
+  return static_cast<YielderHandle*>(handle)->yielder->EpochsCompleted();
+}
+
+void LTYielderFree(void* handle) {
+  delete static_cast<YielderHandle*>(handle);
+}
+
+}  // extern "C"
+
+}  // namespace lingvo_tpu

@@ -1,0 +1,52 @@
+// Sequence packing: assign variable-length segments to fixed [rows, time]
+// slots (best-fit: fullest row that still fits), producing per-segment
+// (row, offset) with -1 for dropped segments.
+//
+// Re-implements the semantics of the reference's PackSequences op
+// (lingvo/core/ops/pack_ops.cc, x_ops.cc:1061-1304): the caller turns the
+// assignment into ids/segment_ids/segment_pos arrays (done vectorized in
+// numpy on the Python side — no per-token work here).
+
+#include <cstdint>
+#include <vector>
+
+namespace lingvo_tpu {
+
+extern "C" {
+
+// lens: [n] segment lengths. Outputs (size n): row index (-1 = dropped),
+// time offset within the row. Returns number of packed segments.
+int64_t LTPackSequences(const int32_t* lens, int64_t n, int32_t num_rows,
+                        int32_t time, int32_t* out_row, int32_t* out_offset,
+                        int32_t spread_first_n) {
+  (void)spread_first_n;  // reserved (ref pack_ops spread knob)
+  std::vector<int32_t> used(num_rows, 0);
+  int64_t packed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t len = lens[i];
+    out_row[i] = -1;
+    out_offset[i] = 0;
+    if (len <= 0 || len > time) continue;
+    // best-fit: the fullest row that still fits (ties -> lowest index);
+    // empty rows are only opened when nothing else fits, maximizing density.
+    int32_t best = -1;
+    int32_t best_used = -1;
+    for (int32_t r = 0; r < num_rows; ++r) {
+      if (used[r] + len <= time && used[r] > best_used) {
+        best = r;
+        best_used = used[r];
+      }
+    }
+    if (best >= 0) {
+      out_row[i] = best;
+      out_offset[i] = used[best];
+      used[best] += len;
+      ++packed;
+    }
+  }
+  return packed;
+}
+
+}  // extern "C"
+
+}  // namespace lingvo_tpu

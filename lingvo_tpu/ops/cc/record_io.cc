@@ -1,0 +1,138 @@
+#include "record_io.h"
+
+#include <glob.h>
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace lingvo_tpu {
+namespace {
+
+// ---- text lines -----------------------------------------------------------
+
+class TextLineIterator : public RecordIterator {
+ public:
+  explicit TextLineIterator(const std::string& path)
+      : f_(fopen(path.c_str(), "rb")) {}
+  ~TextLineIterator() override {
+    if (f_) fclose(f_);
+  }
+  bool Next(std::string* record) override {
+    if (!f_) return false;
+    record->clear();
+    int c;
+    bool any = false;
+    while ((c = fgetc(f_)) != EOF) {
+      any = true;
+      if (c == '\n') return true;
+      record->push_back(static_cast<char>(c));
+    }
+    return any;
+  }
+
+ private:
+  FILE* f_;
+};
+
+// ---- TFRecord (the reference's primary container) -------------------------
+// Layout per record: uint64 length | uint32 masked_crc(length) | data |
+// uint32 masked_crc(data). CRCs are not verified (payloads are checked by
+// downstream parsers; matches common fast-reader behavior).
+
+class TFRecordIterator : public RecordIterator {
+ public:
+  explicit TFRecordIterator(const std::string& path)
+      : f_(fopen(path.c_str(), "rb")) {}
+  ~TFRecordIterator() override {
+    if (f_) fclose(f_);
+  }
+  bool Next(std::string* record) override {
+    if (!f_) return false;
+    uint64_t len = 0;
+    if (fread(&len, sizeof(len), 1, f_) != 1) return false;
+    if (fseek(f_, 4, SEEK_CUR) != 0) return false;  // length crc
+    record->resize(len);
+    if (len > 0 && fread(record->data(), 1, len, f_) != len) return false;
+    if (fseek(f_, 4, SEEK_CUR) != 0) return false;  // data crc
+    return true;
+  }
+
+ private:
+  FILE* f_;
+};
+
+// ---- length-prefixed binary (our own simple container) --------------------
+
+class RecordIOIterator : public RecordIterator {
+ public:
+  explicit RecordIOIterator(const std::string& path)
+      : f_(fopen(path.c_str(), "rb")) {}
+  ~RecordIOIterator() override {
+    if (f_) fclose(f_);
+  }
+  bool Next(std::string* record) override {
+    if (!f_) return false;
+    uint32_t len = 0;
+    if (fread(&len, sizeof(len), 1, f_) != 1) return false;
+    record->resize(len);
+    if (len > 0 && fread(record->data(), 1, len, f_) != len) return false;
+    return true;
+  }
+
+ private:
+  FILE* f_;
+};
+
+// ---- iota (synthetic, for tests: "iota:<N>" yields "0".."N-1") ------------
+
+class IotaIterator : public RecordIterator {
+ public:
+  explicit IotaIterator(const std::string& spec)
+      : n_(std::strtoll(spec.c_str(), nullptr, 10)) {}
+  bool Next(std::string* record) override {
+    if (i_ >= n_) return false;
+    *record = std::to_string(i_++);
+    return true;
+  }
+
+ private:
+  int64_t n_;
+  int64_t i_ = 0;
+};
+
+}  // namespace
+
+std::unique_ptr<RecordIterator> RecordIterator::Open(const std::string& type,
+                                                     const std::string& path) {
+  if (type == "text") return std::make_unique<TextLineIterator>(path);
+  if (type == "tfrecord") return std::make_unique<TFRecordIterator>(path);
+  if (type == "recordio") return std::make_unique<RecordIOIterator>(path);
+  if (type == "iota") return std::make_unique<IotaIterator>(path);
+  return nullptr;
+}
+
+std::vector<std::string> RecordIterator::Glob(const std::string& pattern) {
+  std::vector<std::string> out;
+  glob_t g;
+  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
+    for (size_t i = 0; i < g.gl_pathc; ++i) out.emplace_back(g.gl_pathv[i]);
+  }
+  globfree(&g);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+void RecordIterator::ParseSpec(const std::string& spec, std::string* type,
+                               std::string* pattern) {
+  auto pos = spec.find(':');
+  if (pos == std::string::npos) {
+    *type = "text";
+    *pattern = spec;
+  } else {
+    *type = spec.substr(0, pos);
+    *pattern = spec.substr(pos + 1);
+  }
+}
+
+}  // namespace lingvo_tpu

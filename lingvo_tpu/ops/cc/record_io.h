@@ -1,0 +1,36 @@
+// Record iterators: pluggable file-format readers.
+//
+// TPU-native re-design of the reference's record plumbing
+// (lingvo/core/ops/record_yielder.h:62 RecordIterator registry): no TF Env /
+// kernel deps — plain POSIX IO, registered by file-type prefix
+// ("tfrecord:/path", "text:/path", "iota:N" for synthetic tests).
+
+#ifndef LINGVO_TPU_OPS_RECORD_IO_H_
+#define LINGVO_TPU_OPS_RECORD_IO_H_
+
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace lingvo_tpu {
+
+class RecordIterator {
+ public:
+  virtual ~RecordIterator() = default;
+  // Returns false at end of file/stream.
+  virtual bool Next(std::string* record) = 0;
+
+  // Factory: "type:pattern" -> iterator for one concrete file.
+  static std::unique_ptr<RecordIterator> Open(const std::string& type,
+                                              const std::string& path);
+  // Expands a (possibly comma-free) glob pattern to sorted file paths.
+  static std::vector<std::string> Glob(const std::string& pattern);
+  // Splits "type:pattern" (default type "text").
+  static void ParseSpec(const std::string& spec, std::string* type,
+                        std::string* pattern);
+};
+
+}  // namespace lingvo_tpu
+
+#endif  // LINGVO_TPU_OPS_RECORD_IO_H_

@@ -1,0 +1,174 @@
+#include "record_yielder.h"
+
+#include <algorithm>
+#include <cstdio>
+
+namespace lingvo_tpu {
+
+BasicRecordYielder::BasicRecordYielder(const YielderOptions& opts)
+    : opts_(opts), rng_(opts.seed) {
+  std::string type, pattern;
+  RecordIterator::ParseSpec(opts_.file_pattern, &type, &pattern);
+  std::vector<std::string> all;
+  if (type == "iota") {
+    all.push_back(pattern);  // single virtual "file"
+  } else {
+    all = RecordIterator::Glob(pattern);
+  }
+  for (size_t i = 0; i < all.size(); ++i) {
+    if (static_cast<int>(i % opts_.num_shards) == opts_.shard_index) {
+      files_.push_back(all[i]);
+    }
+  }
+  type_ = type;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    RefillEpochLocked();
+  }
+  int n = std::max(1, opts_.num_threads);
+  for (int i = 0; i < n; ++i) {
+    threads_.emplace_back([this, i] { WorkerLoop(i); });
+  }
+}
+
+BasicRecordYielder::~BasicRecordYielder() {
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    stop_ = true;
+  }
+  not_full_.notify_all();
+  not_empty_.notify_all();
+  for (auto& t : threads_) t.join();
+}
+
+void BasicRecordYielder::RefillEpochLocked() {
+  epoch_files_.clear();
+  for (size_t i = 0; i < files_.size(); ++i) {
+    epoch_files_.push_back(static_cast<int>(i));
+  }
+  if (opts_.shuffle) {
+    std::shuffle(epoch_files_.begin(), epoch_files_.end(), rng_);
+  }
+  next_file_ = 0;
+}
+
+void BasicRecordYielder::WorkerLoop(int worker_id) {
+  (void)worker_id;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    if (files_.empty()) {  // nothing to read: mark done, don't spin
+      producers_done_ = true;
+      not_empty_.notify_all();
+      return;
+    }
+  }
+  while (true) {
+    int file_idx = -1;
+    {
+      std::unique_lock<std::mutex> l(mu_);
+      while (!stop_ && !producers_done_ &&
+             next_file_ >= epoch_files_.size() && active_workers_ > 0) {
+        // wait for the epoch to finish draining before rolling over
+        not_full_.wait_for(l, std::chrono::milliseconds(50));
+      }
+      if (stop_ || producers_done_) return;
+      if (next_file_ >= epoch_files_.size()) {
+        // this worker observes the epoch end
+        epochs_done_.fetch_add(1);
+        ++current_epoch_;
+        if (opts_.max_epochs > 0 && current_epoch_ >= opts_.max_epochs) {
+          producers_done_ = true;
+          not_empty_.notify_all();
+          return;
+        }
+        RefillEpochLocked();
+      }
+      file_idx = epoch_files_[next_file_++];
+      ++active_workers_;
+    }
+
+    auto it = RecordIterator::Open(type_, files_[file_idx]);
+    std::string rec;
+    while (it && it->Next(&rec)) {
+      std::unique_lock<std::mutex> l(mu_);
+      not_full_.wait(l, [this] { return stop_ || !BufferFull(); });
+      if (stop_) {
+        --active_workers_;
+        return;
+      }
+      buf_.push_back(std::move(rec));
+      rec.clear();
+      not_empty_.notify_one();
+    }
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      --active_workers_;
+    }
+    not_full_.notify_all();
+    not_empty_.notify_all();
+  }
+}
+
+bool BasicRecordYielder::Yield(std::string* record, int* source_id) {
+  std::unique_lock<std::mutex> l(mu_);
+  not_empty_.wait(l, [this] {
+    // tail records may still be in flight until active workers drain
+    return stop_ || !buf_.empty() ||
+           (producers_done_ && active_workers_ == 0);
+  });
+  if (buf_.empty()) return false;  // exhausted or stopping
+  if (opts_.shuffle) {
+    size_t idx = (buf_.size() > 1) ? (rng_() % buf_.size()) : 0;
+    std::swap(buf_[idx], buf_.back());
+    *record = std::move(buf_.back());
+    buf_.pop_back();
+  } else {
+    // sequential mode: strict FIFO
+    *record = std::move(buf_.front());
+    buf_.pop_front();
+  }
+  if (source_id) *source_id = 0;
+  not_full_.notify_one();
+  return true;
+}
+
+WeightedMixRecordYielder::WeightedMixRecordYielder(
+    std::vector<std::unique_ptr<RecordYielder>> kids,
+    const std::vector<double>& weights, uint64_t seed)
+    : kids_(std::move(kids)), weights_(weights),
+      dist_(weights.begin(), weights.end()), rng_(seed) {}
+
+bool WeightedMixRecordYielder::Yield(std::string* record, int* source_id) {
+  std::lock_guard<std::mutex> l(mu_);
+  // Renormalize over non-exhausted children: a dead high-weight child must
+  // not starve live low-weight siblings.
+  while (true) {
+    bool any_alive = false;
+    for (double w : weights_) {
+      if (w > 0) any_alive = true;
+    }
+    if (!any_alive) return false;
+    int k = dist_(rng_);
+    if (weights_[k] <= 0) continue;  // (dist may lag one rebuild)
+    int unused = 0;
+    if (kids_[k]->Yield(record, &unused)) {
+      if (source_id) *source_id = k;
+      return true;
+    }
+    weights_[k] = 0.0;  // exhausted: remove and rebuild the distribution
+    bool rebuild_ok = false;
+    for (double w : weights_) {
+      if (w > 0) rebuild_ok = true;
+    }
+    if (!rebuild_ok) return false;
+    dist_ = std::discrete_distribution<int>(weights_.begin(), weights_.end());
+  }
+}
+
+int64_t WeightedMixRecordYielder::EpochsCompleted() const {
+  int64_t m = 0;
+  for (const auto& k : kids_) m = std::max(m, k->EpochsCompleted());
+  return m;
+}
+
+}  // namespace lingvo_tpu

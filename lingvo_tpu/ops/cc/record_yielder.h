@@ -1,0 +1,104 @@
+// BasicRecordYielder: multi-threaded sharded file reading with a shuffle
+// ring and epoch tracking.
+//
+// Re-designs lingvo/core/ops/record_yielder.{h,cc} (BasicRecordYielder:170)
+// without the TF runtime: worker threads stream shards through RecordIterator
+// into a bounded shuffle buffer; Yield() pops a uniformly-random element.
+// Epoch boundaries are tracked so callers can stop after N epochs
+// (require_sequential/eval mode uses shuffle_buffer=1, threads=1).
+// WeightedMixRecordYielder samples child yielders by weight
+// (ref weighted_mix_record_yielder.cc).
+
+#ifndef LINGVO_TPU_OPS_RECORD_YIELDER_H_
+#define LINGVO_TPU_OPS_RECORD_YIELDER_H_
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "record_io.h"
+
+namespace lingvo_tpu {
+
+struct YielderOptions {
+  std::string file_pattern;   // "type:glob"
+  uint64_t seed = 301;
+  int64_t shuffle_buffer_size = 10000;
+  int num_threads = 2;
+  int64_t max_epochs = 0;     // 0 = repeat forever
+  bool shuffle = true;
+  // Sharding across infeed hosts: this yielder reads files where
+  // (file_index % num_shards) == shard_index.
+  int shard_index = 0;
+  int num_shards = 1;
+};
+
+class RecordYielder {
+ public:
+  virtual ~RecordYielder() = default;
+  // Returns false when the stream is exhausted (max_epochs reached).
+  virtual bool Yield(std::string* record, int* source_id) = 0;
+  virtual int64_t EpochsCompleted() const = 0;
+};
+
+class BasicRecordYielder : public RecordYielder {
+ public:
+  explicit BasicRecordYielder(const YielderOptions& opts);
+  ~BasicRecordYielder() override;
+
+  bool Yield(std::string* record, int* source_id) override;
+  int64_t EpochsCompleted() const override { return epochs_done_; }
+
+ private:
+  void WorkerLoop(int worker_id);
+  bool BufferFull() const {
+    return static_cast<int64_t>(buf_.size()) >= opts_.shuffle_buffer_size;
+  }
+
+  YielderOptions opts_;
+  std::vector<std::string> files_;
+  std::string type_;
+  std::mt19937_64 rng_;
+
+  mutable std::mutex mu_;
+  std::condition_variable not_full_;
+  std::condition_variable not_empty_;
+  std::deque<std::string> buf_;
+  std::atomic<int64_t> epochs_done_{0};
+  bool producers_done_ = false;
+  bool stop_ = false;
+  std::vector<std::thread> threads_;
+
+  // work queue of (file index) for the current epoch
+  std::vector<int> epoch_files_;
+  size_t next_file_ = 0;
+  int active_workers_ = 0;
+  int64_t current_epoch_ = 0;
+  void RefillEpochLocked();
+};
+
+class WeightedMixRecordYielder : public RecordYielder {
+ public:
+  WeightedMixRecordYielder(std::vector<std::unique_ptr<RecordYielder>> kids,
+                           const std::vector<double>& weights, uint64_t seed);
+  bool Yield(std::string* record, int* source_id) override;
+  int64_t EpochsCompleted() const override;
+
+ private:
+  std::vector<std::unique_ptr<RecordYielder>> kids_;
+  std::vector<double> weights_;
+  std::discrete_distribution<int> dist_;
+  std::mt19937_64 rng_;
+  std::mutex mu_;
+};
+
+}  // namespace lingvo_tpu
+
+#endif  // LINGVO_TPU_OPS_RECORD_YIELDER_H_

@@ -1,0 +1,150 @@
+// Tokenizers: ascii char-level + vocab (whitespace token -> id).
+//
+// Re-implements the semantics of the reference's C++ tokenizer kernels
+// (lingvo/core/ops/ascii_tokenizer.cc, simple_vocab.cc, registered in
+// x_ops.cc:613-860): AsciiTokenizer lowercases and maps chars to a fixed id
+// space; VocabTokenizer looks up whitespace-split tokens in a file-loaded
+// vocabulary with <unk> fallback. Ids layout (ascii): 0=<s>/pad 1=</s>
+// 2=<n_> 3..28='a'..'z' 29..38='0'..'9' 39=' ' 40..=punct table, 73=<unk>.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace lingvo_tpu {
+namespace {
+
+constexpr int kSos = 0, kEos = 1, kNewline = 2, kUnk = 73, kSpace = 39;
+const char kPunct[] = "!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~";
+
+int AsciiCharToId(char c) {
+  if (c == '\n') return kNewline;
+  c = static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+  if (c >= 'a' && c <= 'z') return 3 + (c - 'a');
+  if (c >= '0' && c <= '9') return 29 + (c - '0');
+  if (c == ' ') return kSpace;
+  const char* p = std::strchr(kPunct, c);
+  if (p && c != '\0') return 40 + static_cast<int>(p - kPunct);
+  return kUnk;
+}
+
+char AsciiIdToChar(int id) {
+  if (id == kNewline) return '\n';
+  if (id >= 3 && id <= 28) return static_cast<char>('a' + id - 3);
+  if (id >= 29 && id <= 38) return static_cast<char>('0' + id - 29);
+  if (id == kSpace) return ' ';
+  if (id >= 40 && id < 40 + static_cast<int>(sizeof(kPunct) - 1)) {
+    return kPunct[id - 40];
+  }
+  return '?';
+}
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> token_to_id;
+  std::vector<std::string> id_to_token;
+  int32_t unk_id = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- ascii ---------------------------------------------------------------
+
+// Encodes text into out_ids (cap max_len). Returns emitted length.
+// append_eos: write kEos as the final id (truncating if needed).
+int32_t LTAsciiToIds(const char* text, int32_t text_len, int32_t* out_ids,
+                     int32_t max_len, int32_t append_eos) {
+  int32_t n = 0;
+  for (int32_t i = 0; i < text_len && n < max_len; ++i) {
+    out_ids[n++] = AsciiCharToId(text[i]);
+  }
+  if (append_eos && max_len > 0) {
+    if (n >= max_len) n = max_len - 1;
+    out_ids[n++] = kEos;
+  }
+  return n;
+}
+
+// Decodes ids into out_text (cap max_len); stops at eos. Returns length.
+int32_t LTIdsToAscii(const int32_t* ids, int32_t n, char* out_text,
+                     int32_t max_len) {
+  int32_t m = 0;
+  for (int32_t i = 0; i < n && m < max_len; ++i) {
+    if (ids[i] == kEos) break;
+    if (ids[i] == kSos) continue;
+    out_text[m++] = AsciiIdToChar(ids[i]);
+  }
+  return m;
+}
+
+// ---- vocab ---------------------------------------------------------------
+
+// Loads a vocab file (one token per line). Returns handle or null.
+void* LTVocabLoad(const char* path, const char* unk_token) {
+  std::ifstream f(path);
+  if (!f) return nullptr;
+  auto* v = new Vocab();
+  std::string line;
+  while (std::getline(f, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    v->token_to_id.emplace(line, static_cast<int32_t>(v->id_to_token.size()));
+    v->id_to_token.push_back(line);
+  }
+  auto it = v->token_to_id.find(unk_token);
+  v->unk_id = (it == v->token_to_id.end()) ? 0 : it->second;
+  return v;
+}
+
+void LTVocabFree(void* vocab) { delete static_cast<Vocab*>(vocab); }
+
+int32_t LTVocabSize(void* vocab) {
+  return static_cast<int32_t>(static_cast<Vocab*>(vocab)->id_to_token.size());
+}
+
+// Whitespace-splits text, looks up each token. Returns emitted count.
+int32_t LTVocabToIds(void* vocab, const char* text, int32_t text_len,
+                     int32_t* out_ids, int32_t max_len) {
+  auto* v = static_cast<Vocab*>(vocab);
+  int32_t n = 0;
+  int32_t i = 0;
+  while (i < text_len && n < max_len) {
+    while (i < text_len && std::isspace(static_cast<unsigned char>(text[i])))
+      ++i;
+    int32_t start = i;
+    while (i < text_len && !std::isspace(static_cast<unsigned char>(text[i])))
+      ++i;
+    if (i > start) {
+      std::string tok(text + start, i - start);
+      auto it = v->token_to_id.find(tok);
+      out_ids[n++] = (it == v->token_to_id.end()) ? v->unk_id : it->second;
+    }
+  }
+  return n;
+}
+
+// Joins ids back to space-separated tokens. Returns written length.
+int32_t LTVocabToText(void* vocab, const int32_t* ids, int32_t n,
+                      char* out_text, int32_t max_len) {
+  auto* v = static_cast<Vocab*>(vocab);
+  int32_t m = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    if (ids[i] < 0 || ids[i] >= static_cast<int32_t>(v->id_to_token.size()))
+      continue;
+    const std::string& tok = v->id_to_token[ids[i]];
+    if (i > 0 && m < max_len) out_text[m++] = ' ';
+    for (char c : tok) {
+      if (m >= max_len) return m;
+      out_text[m++] = c;
+    }
+  }
+  return m;
+}
+
+}  // extern "C"
+
+}  // namespace lingvo_tpu

@@ -1,0 +1,87 @@
+"""DataSource + SequenceBatcher tests (ref datasource_test /
+record_batcher_test semantics)."""
+
+import os
+
+import numpy as np
+
+from lingvo_tpu.core import datasource
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.ops import native
+
+
+def _write_lines(tmp_path, name, lines):
+  p = os.path.join(str(tmp_path), name)
+  with open(p, "w") as f:
+    for line in lines:
+      f.write(line + "\n")
+  return p
+
+
+class TestSimpleDataSource:
+
+  def test_single_pattern(self, tmp_path):
+    _write_lines(tmp_path, "a.txt", [f"r{i}" for i in range(20)])
+    p = datasource.SimpleDataSource.Params().Set(
+        file_pattern=f"text:{tmp_path}/a.txt", max_epochs=1)
+    records = list(p.Instantiate())
+    assert sorted(records) == sorted(f"r{i}".encode() for i in range(20))
+
+  def test_weighted_mix(self, tmp_path):
+    _write_lines(tmp_path, "a.txt", ["a"] * 400)
+    _write_lines(tmp_path, "b.txt", ["b"] * 400)
+    p = datasource.SimpleDataSource.Params().Set(
+        file_pattern=[f"text:{tmp_path}/a.txt", f"text:{tmp_path}/b.txt"],
+        weights=[3.0, 1.0])
+    it = iter(p.Instantiate())
+    got = [next(it) for _ in range(400)]
+    na, nb = got.count(b"a"), got.count(b"b")
+    assert na > 2 * nb
+
+
+class TestSequenceBatcher:
+
+  def test_bucketing_and_padding(self, tmp_path):
+    # records are "n" -> sequence of length n
+    _write_lines(tmp_path, "d.txt",
+                 [str(n) for n in [2, 3, 7, 8, 2, 3, 7, 8, 2, 2]])
+    src = datasource.SimpleDataSource.Params().Set(
+        file_pattern=f"text:{tmp_path}/d.txt", max_epochs=1,
+        shuffle=False, num_threads=1).Instantiate()
+
+    def processor(rec):
+      n = int(rec)
+      return NestedMap(
+          bucket_key=n,
+          ids=np.arange(n, dtype=np.int32),
+          paddings=np.zeros(n, np.float32))
+
+    batcher = datasource.SequenceBatcher(
+        src, processor, bucket_upper_bound=[4, 8], bucket_batch_limit=[4, 2])
+    batches = list(batcher)
+    # bucket0 (len<=4): 6 examples -> one full batch of 4 + flush of 2
+    # bucket1 (len<=8): 4 examples -> two batches of 2
+    shapes = sorted([tuple(b.ids.shape) for b in batches])
+    assert (4, 4) in shapes
+    assert (2, 8) in shapes
+    for b in batches:
+      assert b.ids.shape[1] in (4, 8)
+      # paddings are 1.0 in padded region
+      if b.ids.shape == (4, 4):
+        row_lens = (1.0 - b.paddings).sum(1)
+        assert row_lens.max() <= 4
+
+  def test_oversized_dropped(self, tmp_path):
+    _write_lines(tmp_path, "d.txt", ["12", "3"])
+    src = datasource.SimpleDataSource.Params().Set(
+        file_pattern=f"text:{tmp_path}/d.txt", max_epochs=1,
+        shuffle=False, num_threads=1).Instantiate()
+
+    def processor(rec):
+      n = int(rec)
+      return NestedMap(bucket_key=n, ids=np.zeros(n, np.int32))
+
+    batches = list(
+        datasource.SequenceBatcher(src, processor, [8], [4]))
+    assert len(batches) == 1
+    assert batches[0].ids.shape == (1, 8)  # only the len-3 record survived
