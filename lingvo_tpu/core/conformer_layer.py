@@ -1,0 +1,151 @@
+"""Conformer block: FFN/2 + MHSA + LConv + FFN/2 + LN.
+
+Re-designs `lingvo/core/conformer_layer.py` (LConvLayer:35,
+ConformerLayer:471). Streaming support comes from the causal depthwise conv
+(left-pad) + LocalSelfAttention options on the attention template.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import attention as attention_lib
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import transformer as transformer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+
+
+class LConvLayer(base_layer.BaseLayer):
+  """Lightweight conv block: LN -> pw-GLU -> dw-conv -> norm -> swish -> pw
+  (ref LConvLayer:35)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("kernel_size", 32, "Depthwise kernel size.")
+    p.Define("causal", False, "Causal depthwise conv (streaming).")
+    p.Define("conv_norm", "bn", "'bn' | 'ln' on the conv branch.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    d = p.input_dim
+    self.CreateChild("ln", layers_lib.LayerNorm.Params().Set(input_dim=d))
+    self.CreateVariable(
+        "pw_in", WeightParams((d, 2 * d), p.params_init, p.dtype))
+    self.CreateVariable(
+        "dw", WeightParams((p.kernel_size, d), p.params_init, p.dtype))
+    if p.conv_norm == "bn":
+      self.CreateChild("norm", layers_lib.BatchNormLayer.Params().Set(dim=d))
+    else:
+      self.CreateChild("norm", layers_lib.LayerNorm.Params().Set(input_dim=d))
+    self.CreateVariable("pw_out", WeightParams((d, d), p.params_init, p.dtype))
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    th = self.CastTheta(theta)
+    x = self.ln.FProp(theta.ln, inputs)
+    gated = jnp.einsum("btd,de->bte", x, th.pw_in)
+    a, b = jnp.split(gated, 2, axis=-1)
+    x = a * jax.nn.sigmoid(b)  # GLU
+    if paddings is not None:
+      x = py_utils.ApplyPadding(paddings, x)
+    # depthwise conv over time: [b,t,d] with kernel [k,d]
+    k = p.kernel_size
+    if p.causal:
+      pad = [(0, 0), (k - 1, 0), (0, 0)]
+    else:
+      pad = [(0, 0), ((k - 1) // 2, k // 2), (0, 0)]
+    xp = jnp.pad(x, pad)
+    x = jax.lax.conv_general_dilated(
+        xp, th.dw[:, None, :],  # [k, 1, d] HIO-ish
+        window_strides=(1,),
+        padding="VALID",
+        feature_group_count=p.input_dim,
+        dimension_numbers=("NHC", "HIO", "NHC"))
+    if p.conv_norm == "bn":
+      x = self.norm.FProp(theta.norm, x, paddings)
+    else:
+      x = self.norm.FProp(theta.norm, x)
+    x = jax.nn.silu(x)
+    x = jnp.einsum("btd,de->bte", x, th.pw_out)
+    if paddings is not None:
+      x = py_utils.ApplyPadding(paddings, x)
+    return inputs + x
+
+
+class ConformerLayer(base_layer.BaseLayer):
+  """Macaron conformer block (ref ConformerLayer:471)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("atten_num_heads", 4, "Heads.")
+    p.Define("ffn_hidden_dim", 0, "FFN dim (0 = 4x input).")
+    p.Define("kernel_size", 32, "LConv kernel.")
+    p.Define("causal", False, "Streaming-friendly (causal conv + local "
+             "attention window).")
+    p.Define("atten_left_context", 0,
+             "If >0 use LocalSelfAttention with this left context.")
+    p.Define("atten_right_context", 0, "Right context for local attention.")
+    p.Define("dropout_prob", 0.0, "Residual dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    d = p.input_dim
+    h = p.ffn_hidden_dim or 4 * d
+    ffn = transformer_lib.TransformerFeedForwardLayer.Params().Set(
+        input_dim=d, hidden_dim=h, activation="SILU",
+        residual_dropout_prob=p.dropout_prob, add_skip_connection=False)
+    self.CreateChild("ffn_start", ffn.Copy())
+    self.CreateChild("ffn_end", ffn.Copy())
+    if p.atten_left_context > 0:
+      # block must satisfy left_context <= block+1 and right_context <= block
+      block = max(p.atten_left_context - 1, p.atten_right_context, 1)
+      atten = attention_lib.LocalSelfAttention.Params().Set(
+          block_size=block,
+          left_context=p.atten_left_context,
+          right_context=p.atten_right_context)
+    else:
+      atten = attention_lib.MultiHeadedAttention.Params()
+    self.CreateChild(
+        "atten_ln", layers_lib.LayerNorm.Params().Set(input_dim=d))
+    self.CreateChild(
+        "atten",
+        atten.Set(input_dim=d, hidden_dim=d, num_heads=p.atten_num_heads,
+                  atten_dropout_prob=p.dropout_prob,
+                  use_rotary_position_emb=True))
+    self.CreateChild(
+        "lconv",
+        LConvLayer.Params().Set(
+            input_dim=d, kernel_size=p.kernel_size, causal=p.causal,
+            # BatchNorm pools statistics over time => future leaks into the
+            # past; streaming mode must use LayerNorm on the conv branch.
+            conv_norm="ln" if p.causal else "bn"))
+    self.CreateChild(
+        "final_ln", layers_lib.LayerNorm.Params().Set(input_dim=d))
+
+  def FProp(self, theta, inputs, paddings=None):
+    x = inputs + 0.5 * self.ffn_start.FProp(theta.ffn_start, inputs, paddings)
+    a = self.atten_ln.FProp(theta.atten_ln, x)
+    mask = None
+    if self.p.causal and self.p.atten_left_context <= 0:
+      mask = attention_lib.CausalMask(x.shape[1])
+    atten_out, _ = self.atten.FProp(theta.atten, a, paddings=paddings,
+                                    atten_mask=mask)
+    x = x + atten_out
+    x = self.lconv.FProp(theta.lconv, x, paddings)
+    x = x + 0.5 * self.ffn_end.FProp(theta.ffn_end, x, paddings)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    if paddings is not None:
+      x = py_utils.ApplyPadding(paddings, x)
+    return x

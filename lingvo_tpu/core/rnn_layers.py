@@ -1,0 +1,115 @@
+"""RNN layers: FRNN (functional scan), bidirectional, stacked.
+
+Re-designs `lingvo/core/rnn_layers.py` (RNN:69, FRNN:365, bidirectional
+variants). Batch-major inputs [b, t, d]; internally time-major for lax.scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import recurrent
+from lingvo_tpu.core import rnn_cell
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class FRNN(base_layer.BaseLayer):
+  """Functional RNN over a cell (ref FRNN:365)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("cell", rnn_cell.LSTMCellSimple.Params(), "The RNN cell.")
+    p.Define("reverse", False, "Process the sequence right-to-left.")
+    p.Define("remat", False, "Rematerialize steps in BPTT.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("cell", self.p.cell)
+
+  def FProp(self, theta, inputs, paddings=None, state0=None):
+    """inputs [b, t, d] -> (outputs [b, t, h], final_state)."""
+    p = self.p
+    b, t = inputs.shape[0], inputs.shape[1]
+    if paddings is None:
+      paddings = jnp.zeros((b, t), jnp.float32)
+    if state0 is None:
+      state0 = self.cell.InitState(b)
+    # time-parallel input transform (SRU's big matmul runs here, not in scan)
+    inputs = self.cell.PreProcessInputs(theta.cell, inputs)
+    xs = NestedMap(
+        x=jnp.swapaxes(inputs, 0, 1),          # [t, b, d]
+        padding=jnp.swapaxes(paddings, 0, 1))  # [t, b]
+    if p.reverse:
+      xs = xs.Transform(lambda v: jnp.flip(v, axis=0))
+
+    def _Cell(theta_cell, state, inputs_t):
+      return self.cell.FProp(theta_cell, state, inputs_t.x, inputs_t.padding)
+
+    all_states, final_state = recurrent.Recurrent(
+        theta.cell, state0, xs, _Cell, remat=p.remat)
+    out = jax.vmap(self.cell.GetOutput)(all_states)  # [t, b, h]
+    if p.reverse:
+      out = jnp.flip(out, axis=0)
+    return jnp.swapaxes(out, 0, 1), final_state
+
+
+class BidirectionalFRNN(base_layer.BaseLayer):
+  """Concatenated forward + backward FRNN (ref BidirectionalFRNN)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("fwd", rnn_cell.LSTMCellSimple.Params(), "Forward cell.")
+    p.Define("bak", rnn_cell.LSTMCellSimple.Params(), "Backward cell.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("fwd_rnn", FRNN.Params().Set(cell=self.p.fwd))
+    self.CreateChild("bak_rnn", FRNN.Params().Set(cell=self.p.bak,
+                                                  reverse=True))
+
+  def FProp(self, theta, inputs, paddings=None):
+    out_f, _ = self.fwd_rnn.FProp(theta.fwd_rnn, inputs, paddings)
+    out_b, _ = self.bak_rnn.FProp(theta.bak_rnn, inputs, paddings)
+    return jnp.concatenate([out_f, out_b], axis=-1)
+
+
+class StackedFRNNLayerByLayer(base_layer.BaseLayer):
+  """N stacked FRNNs with optional skip connections (ref StackedFRNN)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("cell_tpl", rnn_cell.LSTMCellSimple.Params(), "Cell template.")
+    p.Define("num_layers", 1, "Depth.")
+    p.Define("num_input_nodes", 0, "Input dim.")
+    p.Define("num_output_nodes", 0, "Hidden/output dim.")
+    p.Define("skip_start", 1,
+             "Residual connections from this layer index (-1 = none).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    cells = []
+    for i in range(p.num_layers):
+      cp = p.cell_tpl.Copy()
+      cp.num_input_nodes = p.num_input_nodes if i == 0 else p.num_output_nodes
+      cp.num_output_nodes = p.num_output_nodes
+      cells.append(FRNN.Params().Set(cell=cp))
+    self.CreateChildren("rnn", cells)
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    x = inputs
+    for i, layer in enumerate(self.rnn):
+      out, _ = layer.FProp(theta.rnn[i], x, paddings)
+      if p.skip_start >= 0 and i >= p.skip_start and out.shape == x.shape:
+        out = out + x
+      x = out
+    return x

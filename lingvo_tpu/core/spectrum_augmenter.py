@@ -1,0 +1,79 @@
+"""SpecAugment: frequency and time masking on spectrogram features.
+
+Re-designs `lingvo/core/spectrum_augmenter.py` (1073 LoC): the on-device
+masking path only (time-warp omitted — the reference's own TPU path skips it
+too). Masks are drawn from the deterministic step-seed stream, identity at
+eval.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+
+
+class SpectrumAugmenter(base_layer.BaseLayer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("freq_mask_max_bins", 27, "F: max width of a frequency mask.")
+    p.Define("freq_mask_count", 2, "Number of frequency masks.")
+    p.Define("time_mask_max_frames", 50, "T: max width of a time mask.")
+    p.Define("time_mask_count", 2, "Number of time masks.")
+    p.Define("time_mask_max_ratio", 1.0,
+             "Cap time-mask width at ratio * seq_len.")
+    return p
+
+  def _NameIsRequired(self):
+    return False
+
+  def _OneMask(self, key, size: int, max_width, batch: int):
+    """[batch, size] multiplicative mask with one random span zeroed.
+
+    max_width may be a python int or a per-example int array. Start is drawn
+    from [0, size - width] INCLUSIVE so the span can sit flush at the end.
+    """
+    k1, k2 = jax.random.split(key)
+    if isinstance(max_width, int):
+      width = jax.random.randint(k1, (batch,), 0, max_width + 1)
+    else:
+      width = (jax.random.uniform(k1, (batch,)) *
+               (max_width + 1).astype(jnp.float32)).astype(jnp.int32)
+    start = jax.random.randint(k2, (batch,), 0,
+                               jnp.maximum(size - width + 1, 1))
+    pos = jnp.arange(size)[None, :]
+    inside = (pos >= start[:, None]) & (pos < (start + width)[:, None])
+    return 1.0 - inside.astype(jnp.float32)
+
+  def FProp(self, theta, features, paddings=None):
+    """features: [b, t, f] or [b, t, f, c]; returns same shape."""
+    p = self.p
+    if py_utils.DoEval() or not py_utils.HasStepSeed():
+      return features
+    squeeze = False
+    if features.ndim == 3:
+      features = features[..., None]
+      squeeze = True
+    b, t, f, c = features.shape
+    key = py_utils.StepSeed(f"{self.path}/specaug")
+    mask = jnp.ones((b, t, f), jnp.float32)
+    max_t = p.time_mask_max_frames
+    if paddings is not None and p.time_mask_max_ratio < 1.0:
+      seq_lens = py_utils.LengthsFromPaddings(paddings)
+      max_t_per_ex = (seq_lens.astype(jnp.float32) *
+                      p.time_mask_max_ratio).astype(jnp.int32)
+    else:
+      max_t_per_ex = None
+    for i in range(p.freq_mask_count):
+      fk = jax.random.fold_in(key, 100 + i)
+      mask = mask * self._OneMask(fk, f, p.freq_mask_max_bins, b)[:, None, :]
+    for i in range(p.time_mask_count):
+      tk = jax.random.fold_in(key, 200 + i)
+      width = max_t if max_t_per_ex is None else max_t_per_ex
+      mask = mask * self._OneMask(tk, t, width, b)[:, :, None]
+    out = features * mask[..., None].astype(features.dtype)
+    return out[..., 0] if squeeze else out

@@ -18,3 +18,7 @@ try:
   from lingvo_tpu.models.mt.params import wmt14_en_de  # noqa: F401
 except ImportError:
   pass
+try:
+  from lingvo_tpu.models.asr.params import librispeech  # noqa: F401
+except ImportError:
+  pass

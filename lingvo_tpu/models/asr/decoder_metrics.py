@@ -1,0 +1,47 @@
+"""ASR decode metrics: WER via Levenshtein distance.
+
+Ref: lingvo/tasks/asr/decoder_metrics.py + levenshtein_distance.py.
+"""
+
+from __future__ import annotations
+
+from lingvo_tpu.core import metrics as metrics_lib
+
+
+def LevenshteinDistance(ref: list, hyp: list) -> int:
+  """Edit distance between token lists (ref levenshtein_distance.py)."""
+  m, n = len(ref), len(hyp)
+  if m == 0:
+    return n
+  if n == 0:
+    return m
+  prev = list(range(n + 1))
+  for i in range(1, m + 1):
+    cur = [i] + [0] * n
+    for j in range(1, n + 1):
+      cost = 0 if ref[i - 1] == hyp[j - 1] else 1
+      cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+    prev = cur
+  return prev[n]
+
+
+class WerMetric(metrics_lib.BaseMetric):
+  """Word (token) error rate accumulator."""
+
+  def __init__(self):
+    self._errors = 0
+    self._ref_tokens = 0
+    self._num_utts = 0
+
+  def Update(self, ref_tokens: list, hyp_tokens: list):
+    self._errors += LevenshteinDistance(ref_tokens, hyp_tokens)
+    self._ref_tokens += len(ref_tokens)
+    self._num_utts += 1
+
+  @property
+  def value(self) -> float:
+    return self._errors / max(self._ref_tokens, 1)
+
+  @property
+  def num_utterances(self) -> int:
+    return self._num_utts

@@ -1,0 +1,101 @@
+"""ASR frontend: waveform -> log-mel features, fully in jnp (on-device).
+
+Re-designs `lingvo/tasks/asr/frontend.py` (MelAsrFrontend): framing, Hann
+window, rFFT power spectrum, mel filterbank, log compression. Runs under jit
+on TPU (the reference computes this in the input pipeline on CPU).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+
+
+def _HzToMel(hz):
+  return 2595.0 * np.log10(1.0 + hz / 700.0)
+
+
+def _MelToHz(mel):
+  return 700.0 * (10.0**(mel / 2595.0) - 1.0)
+
+
+def MelFilterbank(num_bins: int, fft_size: int, sample_rate: float,
+                  lower_edge_hz: float = 125.0,
+                  upper_edge_hz: float = 7600.0) -> np.ndarray:
+  """[fft_size//2+1, num_bins] triangular mel weights."""
+  num_spectrogram_bins = fft_size // 2 + 1
+  fft_freqs = np.linspace(0, sample_rate / 2, num_spectrogram_bins)
+  mel_edges = np.linspace(
+      _HzToMel(lower_edge_hz), _HzToMel(upper_edge_hz), num_bins + 2)
+  hz_edges = _MelToHz(mel_edges)
+  weights = np.zeros((num_spectrogram_bins, num_bins), np.float32)
+  for i in range(num_bins):
+    lower, center, upper = hz_edges[i:i + 3]
+    up_slope = (fft_freqs - lower) / max(center - lower, 1e-8)
+    down_slope = (upper - fft_freqs) / max(upper - center, 1e-8)
+    weights[:, i] = np.maximum(0.0, np.minimum(up_slope, down_slope))
+  return weights
+
+
+class MelAsrFrontend(base_layer.BaseLayer):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("sample_rate", 16000.0, "Hz.")
+    p.Define("frame_size_ms", 25.0, "Window size.")
+    p.Define("frame_step_ms", 10.0, "Hop size.")
+    p.Define("num_bins", 80, "Mel bins.")
+    p.Define("lower_edge_hz", 125.0, "Mel low edge.")
+    p.Define("upper_edge_hz", 7600.0, "Mel high edge.")
+    return p
+
+  def _NameIsRequired(self):
+    return False
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self._frame_size = int(round(p.sample_rate * p.frame_size_ms / 1000.0))
+    self._frame_step = int(round(p.sample_rate * p.frame_step_ms / 1000.0))
+    self._fft_size = int(2**math.ceil(math.log2(self._frame_size)))
+    self._mel = jnp.asarray(
+        MelFilterbank(p.num_bins, self._fft_size, p.sample_rate,
+                      p.lower_edge_hz, p.upper_edge_hz))
+    self._window = jnp.asarray(
+        np.hanning(self._frame_size).astype(np.float32))
+
+  @property
+  def frame_step(self):
+    return self._frame_step
+
+  def FProp(self, theta, waveform, paddings=None):
+    """waveform: [b, samples] -> (features [b, frames, num_bins],
+    out_paddings [b, frames])."""
+    b, n = waveform.shape
+    if n < self._frame_size:  # zero-pad short clips to one full frame
+      waveform = jnp.pad(waveform, ((0, 0), (0, self._frame_size - n)))
+      if paddings is not None:
+        paddings = jnp.pad(paddings, ((0, 0), (0, self._frame_size - n)),
+                           constant_values=1.0)
+      n = self._frame_size
+    num_frames = max(1 + (n - self._frame_size) // self._frame_step, 1)
+    idx = (jnp.arange(num_frames)[:, None] * self._frame_step +
+           jnp.arange(self._frame_size)[None, :])
+    frames = waveform[:, idx]                       # [b, frames, frame_size]
+    frames = frames * self._window
+    spec = jnp.fft.rfft(frames, n=self._fft_size, axis=-1)
+    power = jnp.square(jnp.abs(spec)).astype(jnp.float32)
+    mel = jnp.einsum("btf,fm->btm", power, self._mel)
+    logmel = jnp.log(jnp.maximum(mel, 1e-6))
+    if paddings is not None:
+      frame_pad = paddings[:, idx[:, 0]]
+      logmel = py_utils.ApplyPadding(frame_pad, logmel)
+      return logmel, frame_pad
+    return logmel, jnp.zeros((b, num_frames), jnp.float32)

@@ -1,0 +1,62 @@
+"""Synthetic ASR input: per-token tone features, learnable waveform->text.
+
+Ref shape contract: `tasks/asr/input_generator.py` AsrInput (src features +
+tgt token ids). Each label token renders as a characteristic feature pattern
+over a few frames, so a conformer-CTC model can learn the mapping quickly
+and WER is a meaningful signal without shipping audio data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class SyntheticAsrInput(base_input_generator.BaseInputGenerator):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_bins", 80, "Feature bins.")
+    p.Define("max_label_len", 12, "Max tokens per utterance.")
+    p.Define("frames_per_token", 8, "Feature frames per token.")
+    p.Define("vocab_size", 30, "Token vocab (blank=0 excluded from labels).")
+    p.Define("noise", 0.2, "Feature noise stddev.")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    rng = np.random.RandomState(p.seed + 777)
+    # one fixed feature prototype per token id (proto_seed shared by splits)
+    self._protos = np.random.RandomState(777).randn(
+        p.vocab_size, p.num_bins).astype(np.float32)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 31337 * self._step) % (2**31))
+    self._step += 1
+    b = p.batch_size
+    max_frames = p.max_label_len * p.frames_per_token
+    feats = np.zeros((b, max_frames, p.num_bins), np.float32)
+    fpad = np.ones((b, max_frames), np.float32)
+    ids = np.zeros((b, p.max_label_len), np.int32)
+    lpad = np.ones((b, p.max_label_len), np.float32)
+    for i in range(b):
+      n = rng.randint(2, p.max_label_len + 1)
+      toks = rng.randint(1, p.vocab_size, n)  # 0 reserved for blank
+      ids[i, :n] = toks
+      lpad[i, :n] = 0.0
+      for j, tok in enumerate(toks):
+        s = j * p.frames_per_token
+        feats[i, s:s + p.frames_per_token] = self._protos[tok]
+      t = n * p.frames_per_token
+      feats[i, :t] += p.noise * rng.randn(t, p.num_bins)
+      fpad[i, :t] = 0.0
+    return NestedMap(
+        features=feats, feature_paddings=fpad,
+        tgt=NestedMap(ids=ids, paddings=lpad))

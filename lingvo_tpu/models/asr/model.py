@@ -1,0 +1,153 @@
+"""ASR task: Conformer-CTC (ref: lingvo/tasks/asr encoder/decoder stack).
+
+Pipeline: (waveform -> log-mel | precomputed features) -> SpecAugment ->
+conv subsampling -> conformer stack -> CTC loss; greedy CTC decode + WER.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import conformer_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import spectrum_augmenter
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.asr import decoder_metrics as dm
+from lingvo_tpu.models.asr import frontend as frontend_lib
+
+
+class CtcAsrModel(base_model.BaseTask):
+  """Conformer encoder + CTC head.
+
+  Input batch: either waveform [b, samples] (+paddings) or features
+  [b, t, num_bins] (+feature_paddings); labels: tgt.ids [b, l] with
+  tgt.paddings. Blank id = 0; label ids must be >= 1.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("frontend", frontend_lib.MelAsrFrontend.Params(),
+             "Waveform frontend (unused when features are fed directly).")
+    p.Define("specaug", spectrum_augmenter.SpectrumAugmenter.Params(),
+             "SpecAugment.")
+    p.Define("input_dim", 80, "Feature dim.")
+    p.Define("model_dim", 256, "Conformer dim.")
+    p.Define("num_layers", 16, "Conformer depth.")
+    p.Define("num_heads", 4, "Attention heads.")
+    p.Define("kernel_size", 32, "LConv kernel.")
+    p.Define("vocab_size", 77, "Output vocab incl. blank at 0.")
+    p.Define("subsample_factor", 4, "Time subsampling (2 conv stride-2).")
+    p.Define("dropout_prob", 0.0, "Dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild("frontend", p.frontend)
+    self.CreateChild("specaug", p.specaug)
+    # conv subsampling: two stride-2 convs over time
+    self.CreateChild(
+        "sub1",
+        layers_lib.Conv2DLayer.Params().Set(
+            filter_shape=(3, 3, 1, 32), filter_stride=(2, 2),
+            activation="RELU", batch_norm=False, has_bias=True))
+    self.CreateChild(
+        "sub2",
+        layers_lib.Conv2DLayer.Params().Set(
+            filter_shape=(3, 3, 32, 32), filter_stride=(2, 2),
+            activation="RELU", batch_norm=False, has_bias=True))
+    # two SAME stride-2 convs: freq -> ceil(ceil(f/2)/2)
+    sub_freq = -(-(-(-p.input_dim // 2)) // 2)
+    self.CreateChild(
+        "input_proj",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=32 * sub_freq, output_dim=p.model_dim))
+    blocks = []
+    for _ in range(p.num_layers):
+      blocks.append(conformer_layer.ConformerLayer.Params().Set(
+          input_dim=p.model_dim, atten_num_heads=p.num_heads,
+          kernel_size=p.kernel_size, dropout_prob=p.dropout_prob))
+    self.CreateChildren("conformer", blocks)
+    self.CreateChild(
+        "ctc_proj",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.model_dim, output_dim=p.vocab_size))
+
+  def _Encode(self, theta, input_batch):
+    p = self.p
+    if "features" in input_batch:
+      feats = input_batch.features
+      fpad = input_batch.Get("feature_paddings")
+      if fpad is None:
+        fpad = jnp.zeros(feats.shape[:2], jnp.float32)
+    else:
+      feats, fpad = self.frontend.FProp(
+          self.ChildTheta(theta, "frontend"), input_batch.waveform,
+          input_batch.Get("paddings"))
+    feats = self.specaug.FProp(self.ChildTheta(theta, "specaug"), feats,
+                               fpad)
+    x = feats[..., None]                     # [b, t, f, 1]
+    x, fpad = self.sub1.FProp(theta.sub1, x, fpad)
+    x, fpad = self.sub2.FProp(theta.sub2, x, fpad)
+    b, t = x.shape[0], x.shape[1]
+    x = x.reshape(b, t, -1)
+    x = self.input_proj.FProp(theta.input_proj, x)
+    for i, block in enumerate(self.conformer):
+      x = block.FProp(theta.conformer[i], x, fpad)
+    logits = self.ctc_proj.FProp(theta.ctc_proj, x)
+    return logits, fpad
+
+  def ComputePredictions(self, theta, input_batch):
+    logits, out_paddings = self._Encode(theta, input_batch)
+    return NestedMap(logits=logits, paddings=out_paddings)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    import optax
+    labels = input_batch.tgt.ids
+    label_paddings = input_batch.tgt.paddings
+    per_seq = optax.ctc_loss(
+        predictions.logits.astype(jnp.float32), predictions.paddings,
+        labels, label_paddings, blank_id=0)
+    label_counts = jnp.maximum(
+        jnp.sum(1.0 - label_paddings, axis=-1), 1.0)
+    num_seqs = float(labels.shape[0])
+    avg = jnp.mean(per_seq / label_counts)
+    metrics = NestedMap(loss=(avg, num_seqs))
+    return metrics, NestedMap(ctc=per_seq)
+
+  def Decode(self, theta, input_batch):
+    logits, out_paddings = self._Encode(theta, input_batch)
+    # greedy CTC: argmax frames (blank=0), collapse repeats, drop blanks
+    frame_ids = jnp.argmax(logits, axis=-1)
+    frame_ids = jnp.where(out_paddings > 0.5, 0, frame_ids)
+    return NestedMap(
+        frame_ids=frame_ids,
+        target_ids=input_batch.tgt.ids,
+        target_paddings=input_batch.tgt.paddings)
+
+  def CreateDecoderMetrics(self):
+    return {"wer": dm.WerMetric()}
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    frames = np.asarray(decode_out.frame_ids)
+    labels = np.asarray(decode_out.target_ids)
+    lpads = np.asarray(decode_out.target_paddings)
+    for i in range(frames.shape[0]):
+      hyp = []
+      prev = 0
+      for t in frames[i]:
+        if t != 0 and t != prev:
+          hyp.append(int(t))
+        prev = t
+      ref_len = int((1.0 - lpads[i]).sum())
+      ref = [int(x) for x in labels[i, :ref_len]]
+      decoder_metrics["wer"].Update(ref, hyp)
+
+  def DecodeFinalize(self, decoder_metrics):
+    return {"wer": decoder_metrics["wer"].value,
+            "num_utterances": float(decoder_metrics["wer"].num_utterances)}

@@ -1,0 +1,77 @@
+"""Librispeech ASR configs (ref: lingvo/tasks/asr/params/librispeech.py
+Librispeech960Grapheme:156 — grapheme LAS; here the modern Conformer-CTC
+recipe at comparable scale, on synthetic input until the native pipeline
+feeds real Librispeech tfrecords)."""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.asr import input_generator
+from lingvo_tpu.models.asr import model as asr_model
+
+
+@model_registry.RegisterSingleTaskModel
+class Librispeech960ConformerCtc(base_model_params.SingleTaskModelParams):
+  """Conformer-CTC at Librispeech-960 grapheme scale."""
+
+  BATCH_SIZE = 16
+  NUM_BINS = 80
+  MODEL_DIM = 256
+  NUM_LAYERS = 16
+  NUM_HEADS = 4
+  VOCAB = 77  # graphemes + blank (ref grapheme vocab size)
+
+  def Train(self):
+    return input_generator.SyntheticAsrInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_bins=self.NUM_BINS,
+        vocab_size=min(self.VOCAB, 30))
+
+  def Test(self):
+    return input_generator.SyntheticAsrInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_bins=self.NUM_BINS,
+        vocab_size=min(self.VOCAB, 30), seed=99)
+
+  def Task(self):
+    p = asr_model.CtcAsrModel.Params()
+    p.name = "librispeech_ctc"
+    p.input_dim = self.NUM_BINS
+    p.model_dim = self.MODEL_DIM
+    p.num_layers = self.NUM_LAYERS
+    p.num_heads = self.NUM_HEADS
+    p.vocab_size = self.VOCAB
+    p.dropout_prob = 0.1
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=2.0,
+        optimizer=opt_lib.AdamW.Params().Set(beta2=0.98, weight_decay=1e-6),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=10000, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=1.0)
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class LibrispeechConformerCtcTiny(Librispeech960ConformerCtc):
+  """Smoke-test scale."""
+
+  BATCH_SIZE = 4
+  NUM_BINS = 16
+  MODEL_DIM = 32
+  NUM_LAYERS = 2
+  NUM_HEADS = 2
+  VOCAB = 30
+
+  def Task(self):
+    p = super().Task()
+    p.kernel_size = 8
+    p.dropout_prob = 0.0
+    p.specaug.freq_mask_max_bins = 4
+    p.specaug.time_mask_max_frames = 8
+    p.train.learner.learning_rate = 2e-3
+    p.train.learner.lr_schedule = sched_lib.Constant.Params()
+    p.train.tpu_steps_per_loop = 20
+    return p

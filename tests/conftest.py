@@ -18,6 +18,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # remote handshake.
 try:
   import jax  # noqa: E402  (may already be imported by sitecustomize)
+  # chex/checkify register lowering rules for the 'tpu' platform at import;
+  # do it BEFORE we strip non-cpu plugin factories or the registration fails.
+  try:
+    import chex  # noqa: E402,F401
+  except ImportError:
+    pass
   from jax._src import xla_bridge  # noqa: E402
 
   # sitecustomize may have imported jax with JAX_PLATFORMS=axon already

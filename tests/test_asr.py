@@ -1,0 +1,137 @@
+"""ASR stack tests: frontend, SpecAugment, conformer, CTC task, WER."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import conformer_layer, py_utils, spectrum_augmenter
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.asr import decoder_metrics as dm
+from lingvo_tpu.models.asr import frontend as frontend_lib
+
+KEY = jax.random.PRNGKey(9)
+
+
+class TestFrontend:
+
+  def test_logmel_shapes(self):
+    p = frontend_lib.MelAsrFrontend.Params().Set(num_bins=40)
+    fe = p.Instantiate()
+    wav = jax.random.normal(KEY, (2, 16000))  # 1s at 16kHz
+    feats, fpad = fe.FProp(NestedMap(), wav)
+    assert feats.shape[0] == 2 and feats.shape[2] == 40
+    assert feats.shape[1] == fpad.shape[1]
+    assert np.all(np.isfinite(np.asarray(feats)))
+
+  def test_pure_tone_peaks_at_expected_bin(self):
+    p = frontend_lib.MelAsrFrontend.Params().Set(num_bins=40)
+    fe = p.Instantiate()
+    t = np.arange(16000) / 16000.0
+    low = np.sin(2 * np.pi * 300 * t)[None].astype("float32")
+    high = np.sin(2 * np.pi * 4000 * t)[None].astype("float32")
+    f_low, _ = fe.FProp(NestedMap(), jnp.asarray(low))
+    f_high, _ = fe.FProp(NestedMap(), jnp.asarray(high))
+    assert int(np.argmax(np.asarray(f_low).mean(1))) < int(
+        np.argmax(np.asarray(f_high).mean(1)))
+
+
+class TestSpecAugment:
+
+  def test_identity_in_eval(self):
+    sa = spectrum_augmenter.SpectrumAugmenter.Params().Instantiate()
+    x = jax.random.normal(KEY, (2, 20, 16))
+    np.testing.assert_array_equal(sa.FProp(NestedMap(), x), x)  # no seed ctx
+
+  def test_masks_in_train(self):
+    sa = spectrum_augmenter.SpectrumAugmenter.Params().Set(
+        freq_mask_max_bins=4, time_mask_max_frames=6).Instantiate()
+    x = jnp.ones((2, 40, 16))
+    with py_utils.StepSeedContext(jax.random.PRNGKey(0)):
+      out = np.asarray(sa.FProp(NestedMap(), x))
+    assert (out == 0).any()
+    assert (out == 1).any()
+    # deterministic per step seed
+    with py_utils.StepSeedContext(jax.random.PRNGKey(0)):
+      out2 = np.asarray(sa.FProp(NestedMap(), x))
+    np.testing.assert_array_equal(out, out2)
+
+
+class TestConformer:
+
+  def test_block_shapes_and_padding(self):
+    p = conformer_layer.ConformerLayer.Params().Set(
+        name="conf", input_dim=16, atten_num_heads=2, kernel_size=8)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (2, 12, 16))
+    paddings = py_utils.PaddingsFromLengths(jnp.array([12, 6]), 12)
+    with py_utils.ForwardStateContext():
+      out = layer.FProp(theta, x, paddings)
+    assert out.shape == x.shape
+    np.testing.assert_allclose(np.asarray(out[1, 6:]), 0.0, atol=1e-6)
+
+  def test_causal_variant_no_future_leak(self):
+    p = conformer_layer.ConformerLayer.Params().Set(
+        name="conf", input_dim=16, atten_num_heads=2, kernel_size=4,
+        causal=True)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (1, 10, 16))
+    with py_utils.ForwardStateContext():
+      out1 = layer.FProp(theta, x)
+      out2 = layer.FProp(theta, x.at[:, 6:].set(9.0))
+    np.testing.assert_allclose(np.asarray(out1[:, :6]),
+                               np.asarray(out2[:, :6]), atol=1e-4)
+
+  def test_lconv_depthwise(self):
+    p = conformer_layer.LConvLayer.Params().Set(
+        name="lconv", input_dim=8, kernel_size=4)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    with py_utils.ForwardStateContext():
+      out = layer.FProp(theta, jax.random.normal(KEY, (2, 10, 8)))
+    assert out.shape == (2, 10, 8)
+
+
+class TestWer:
+
+  def test_levenshtein(self):
+    assert dm.LevenshteinDistance([1, 2, 3], [1, 2, 3]) == 0
+    assert dm.LevenshteinDistance([1, 2, 3], [1, 3]) == 1
+    assert dm.LevenshteinDistance([], [1, 2]) == 2
+    assert dm.LevenshteinDistance([1, 2], [2, 1]) == 2
+
+  def test_wer_metric(self):
+    m = dm.WerMetric()
+    m.Update([1, 2, 3, 4], [1, 2, 3, 4])
+    m.Update([1, 2], [1, 5])  # 1 error / 2 ref tokens
+    assert m.value == pytest.approx(1 / 6)
+
+
+class TestCtcTask:
+
+  def test_fprop_loss_and_decode(self):
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    mp = model_registry.GetParams(
+        "asr.librispeech.LibrispeechConformerCtcTiny", "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    step = jax.jit(task.TrainStep)
+    first = None
+    for _ in range(60):
+      state, out = step(state, batch)
+      if first is None:
+        first = float(out.metrics.loss[0])
+    assert float(out.metrics.loss[0]) < 0.7 * first
+    # decode pipeline produces a finite WER
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    metrics = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(
+        jax.tree_util.tree_map(np.asarray, dec), metrics)
+    results = task.DecodeFinalize(metrics)
+    assert 0.0 <= results["wer"] <= 2.0

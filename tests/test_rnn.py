@@ -1,0 +1,218 @@
+"""RNN cell/layer tests (ref rnn_cell_test / rnn_layers_test coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import recurrent, rnn_cell, rnn_layers
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(5)
+B, T, D, H = 2, 8, 4, 6
+
+
+def _cell(cls, **kw):
+  p = cls.Params().Set(name="cell", num_input_nodes=D, num_output_nodes=H,
+                       **kw)
+  cell = p.Instantiate()
+  return cell, cell.InstantiateVariables(KEY)
+
+
+class TestCells:
+
+  @pytest.mark.parametrize("cls", [
+      rnn_cell.LSTMCellSimple, rnn_cell.LayerNormalizedLSTMCellSimple,
+      rnn_cell.GRUCell, rnn_cell.SRUCell
+  ])
+  def test_step_shapes_and_finite(self, cls):
+    cell, theta = _cell(cls)
+    state = cell.InitState(B)
+    x = jax.random.normal(KEY, (B, D))
+    state1 = cell.FProp(theta, state, x)
+    assert cell.GetOutput(state1).shape == (B, H)
+    assert np.all(np.isfinite(np.asarray(cell.GetOutput(state1))))
+    assert not np.allclose(cell.GetOutput(state1), 0.0)
+
+  def test_padding_freezes_state(self):
+    cell, theta = _cell(rnn_cell.LSTMCellSimple)
+    state = cell.InitState(B)
+    x = jax.random.normal(KEY, (B, D))
+    s1 = cell.FProp(theta, state, x, padding=jnp.array([0.0, 1.0]))
+    # row 1 padded: state unchanged
+    np.testing.assert_allclose(s1.m[1], state.m[1])
+    assert not np.allclose(s1.m[0], state.m[0])
+
+  def test_lstm_projection(self):
+    cell, theta = _cell(rnn_cell.LSTMCellSimple, num_hidden_nodes=12)
+    assert theta.w_proj.shape == (12, H)
+    state = cell.InitState(B)
+    assert state.c.shape == (B, 12) and state.m.shape == (B, H)
+    s1 = cell.FProp(theta, state, jnp.ones((B, D)))
+    assert s1.m.shape == (B, H)
+
+  def test_forget_gate_bias_effect(self):
+    c1, t1 = _cell(rnn_cell.LSTMCellSimple, forget_gate_bias=0.0)
+    c2 = rnn_cell.LSTMCellSimple.Params().Set(
+        name="cell", num_input_nodes=D, num_output_nodes=H,
+        forget_gate_bias=5.0).Instantiate()
+    # same weights, different forget bias -> different cell evolution
+    state = c1.InitState(B)
+    state = NestedMap(m=jnp.ones((B, H)) * 0.3, c=jnp.ones((B, H)) * 0.5)
+    x = jnp.ones((B, D))
+    s_a = c1.FProp(t1, state, x)
+    s_b = c2.FProp(t1, state, x)
+    assert float(jnp.abs(s_b.c).mean()) > float(jnp.abs(s_a.c).mean())
+
+
+class TestRecurrent:
+
+  def test_scan_matches_loop(self):
+    cell, theta = _cell(rnn_cell.LSTMCellSimple)
+    xs = jax.random.normal(KEY, (T, B, D))
+    state = cell.InitState(B)
+    inputs = NestedMap(x=xs, padding=jnp.zeros((T, B)))
+
+    def cell_fn(th, s, inp):
+      return cell.FProp(th, s, inp.x, inp.padding)
+
+    all_states, final = recurrent.Recurrent(theta, state, inputs, cell_fn)
+    # manual loop
+    s = cell.InitState(B)
+    for t in range(T):
+      s = cell.FProp(theta, s, xs[t])
+    np.testing.assert_allclose(np.asarray(final.m), np.asarray(s.m),
+                               rtol=1e-5)
+    assert all_states.m.shape == (T, B, H)
+
+  def test_remat_same_grads(self):
+    cell, theta = _cell(rnn_cell.GRUCell)
+    xs = jax.random.normal(KEY, (T, B, D))
+    inputs = NestedMap(x=xs, padding=jnp.zeros((T, B)))
+
+    def loss(th, remat):
+      _, final = recurrent.Recurrent(
+          th, cell.InitState(B), inputs,
+          lambda t_, s, i: cell.FProp(t_, s, i.x, i.padding), remat=remat)
+      return jnp.sum(jnp.square(final.m))
+
+    g1 = jax.grad(lambda th: loss(th, False))(theta)
+    g2 = jax.grad(lambda th: loss(th, True))(theta)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+  def test_numeric_gradient_check(self):
+    """Finite differences vs autodiff through the scan (ref
+    recurrent_test.py numeric grad checks)."""
+    cell, theta = _cell(rnn_cell.SRUCell)
+    xs = jax.random.normal(KEY, (4, 1, D))
+    inputs = NestedMap(x=xs, padding=jnp.zeros((4, 1)))
+
+    def loss_w(w):
+      th = theta.Copy()
+      th.w = w
+      _, final = recurrent.Recurrent(
+          th, cell.InitState(1), inputs,
+          lambda t_, s, i: cell.FProp(t_, s, i.x, i.padding))
+      return jnp.sum(final.m)
+
+    g = jax.grad(loss_w)(theta.w)
+    eps = 1e-3
+    w = np.asarray(theta.w).copy()
+    idxs = [(0, 0), (1, 5), (3, 2 * H + 1)]
+    for i, j in idxs:
+      w_p, w_m = w.copy(), w.copy()
+      w_p[i, j] += eps
+      w_m[i, j] -= eps
+      fd = (float(loss_w(jnp.asarray(w_p))) -
+            float(loss_w(jnp.asarray(w_m)))) / (2 * eps)
+      np.testing.assert_allclose(float(g[i, j]), fd, rtol=0.05, atol=1e-3)
+
+
+class TestRnnLayers:
+
+  def test_frnn_shapes_and_padding(self):
+    p = rnn_layers.FRNN.Params().Set(
+        name="frnn",
+        cell=rnn_cell.LSTMCellSimple.Params().Set(
+            num_input_nodes=D, num_output_nodes=H))
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (B, T, D))
+    paddings = jnp.zeros((B, T)).at[1, 4:].set(1.0)
+    out, final = layer.FProp(theta, x, paddings)
+    assert out.shape == (B, T, H)
+    # padded tail: output equals the frozen state at t=3
+    np.testing.assert_allclose(np.asarray(out[1, 4]), np.asarray(out[1, 7]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(final.m[1]), np.asarray(out[1, 3]),
+                               rtol=1e-5)
+
+  def test_frnn_reverse_flips_time(self):
+    cellp = rnn_cell.GRUCell.Params().Set(
+        num_input_nodes=D, num_output_nodes=H)
+    fwd = rnn_layers.FRNN.Params().Set(name="f", cell=cellp).Instantiate()
+    theta = fwd.InstantiateVariables(KEY)
+    rev = rnn_layers.FRNN.Params().Set(
+        name="f", cell=cellp, reverse=True).Instantiate()
+    x = jax.random.normal(KEY, (B, T, D))
+    out_f, _ = fwd.FProp(theta, x)
+    out_r, _ = rev.FProp(theta, jnp.flip(x, axis=1))
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(jnp.flip(out_r, axis=1)), rtol=1e-5)
+
+  def test_bidirectional(self):
+    p = rnn_layers.BidirectionalFRNN.Params().Set(
+        name="birnn",
+        fwd=rnn_cell.LSTMCellSimple.Params().Set(
+            num_input_nodes=D, num_output_nodes=H),
+        bak=rnn_cell.LSTMCellSimple.Params().Set(
+            num_input_nodes=D, num_output_nodes=H))
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    out = layer.FProp(theta, jax.random.normal(KEY, (B, T, D)))
+    assert out.shape == (B, T, 2 * H)
+
+  def test_stacked_with_residual(self):
+    p = rnn_layers.StackedFRNNLayerByLayer.Params().Set(
+        name="stack", num_layers=3, num_input_nodes=D, num_output_nodes=D,
+        cell_tpl=rnn_cell.SRUCell.Params())
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    out = layer.FProp(theta, jax.random.normal(KEY, (B, T, D)))
+    assert out.shape == (B, T, D)
+
+  def test_frnn_trains(self):
+    """FRNN learns a toy cumulative-sum-sign task end to end."""
+    from lingvo_tpu.core import learner as learner_lib
+    from lingvo_tpu.core import optimizer as opt_lib
+    p = rnn_layers.FRNN.Params().Set(
+        name="frnn",
+        cell=rnn_cell.GRUCell.Params().Set(
+            num_input_nodes=1, num_output_nodes=8))
+    layer = p.Instantiate()
+    theta = NestedMap(
+        rnn=layer.InstantiateVariables(KEY),
+        readout=jax.random.normal(KEY, (8, 1)) * 0.1)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 10, 1).astype("float32")
+    y = (np.cumsum(x[:, :, 0], axis=1) > 0).astype("float32")
+
+    def loss_fn(th):
+      out, _ = layer.FProp(th.rnn, jnp.asarray(x))
+      logits = (out @ th.readout)[:, :, 0]
+      return jnp.mean(
+          jnp.maximum(logits, 0) - logits * y +
+          jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    lrn = learner_lib.Learner.Params().Set(
+        name="l", learning_rate=0.05,
+        optimizer=opt_lib.Adam.Params()).Instantiate()
+    state = lrn.InitState(theta)
+    step = jax.jit(lambda th, s: (lambda g: lrn.Apply(th, g, 0, s))(
+        jax.grad(loss_fn)(th)))
+    first = float(loss_fn(theta))
+    for _ in range(60):
+      theta, state, _ = step(theta, state)
+    assert float(loss_fn(theta)) < 0.6 * first
