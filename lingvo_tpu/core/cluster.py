@@ -1,0 +1,132 @@
+"""Cluster spec: run topology for single- and multi-host training.
+
+Re-designs `lingvo/core/cluster.py` (673 LoC). The reference models a TF1
+job zoo (controller/worker/ps/input/...) with device placement; the
+TPU-native runtime collapses to: process topology (hosts x local devices),
+mesh geometry, and per-host infeed sharding. Also carries the reference's
+thread-local current-cluster stack (`cluster_factory.Current`) and
+job-role-gated summary writing (`cluster.add_summary`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from lingvo_tpu.core import hyperparams
+
+_TLS = threading.local()
+
+
+class Cluster:
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", "cluster", "Name.")
+    p.Define("job", "executor_tpu", "This process's role.")
+    p.Define("mode", "sync", "sync (SPMD) | async (unsupported on TPU).")
+    p.Define("do_eval", False, "Eval-mode graph construction.")
+    p.Define("add_summary", None,
+             "Whether this job writes summaries (None = by role).")
+    p.Define("mesh_axes", None, "dict axis->size for the device mesh.")
+    p.Define("num_infeed_hosts", 0, "0 = jax.process_count().")
+    p.Define("infeed_host_index", -1, "-1 = jax.process_index().")
+    return p
+
+  def __init__(self, params):
+    self.p = params.Copy()
+
+  # ---- topology ------------------------------------------------------------
+
+  @property
+  def num_devices(self) -> int:
+    return jax.device_count()
+
+  @property
+  def num_devices_per_host(self) -> int:
+    return jax.local_device_count()
+
+  @property
+  def num_infeed_hosts(self) -> int:
+    return self.p.num_infeed_hosts or jax.process_count()
+
+  @property
+  def infeed_host_index(self) -> int:
+    idx = self.p.infeed_host_index
+    return jax.process_index() if idx < 0 else idx
+
+  @property
+  def do_eval(self) -> bool:
+    return self.p.do_eval
+
+  @property
+  def add_summary(self) -> bool:
+    if self.p.add_summary is not None:
+      return self.p.add_summary
+    # by role: trainers/executors write summaries; decoders do their own
+    return self.p.job in ("executor_tpu", "trainer", "trainer_client",
+                          "controller", "evaler")
+
+  def MakeMesh(self):
+    from lingvo_tpu.parallel import mesh as mesh_lib
+    axes = self.p.mesh_axes or {mesh_lib.DATA_AXIS: -1}
+    return mesh_lib.MakeMesh(axes)
+
+  def InputShardParams(self):
+    """(shard_index, num_shards) for this host's input pipeline (the
+    InfeedContextScope equivalent, ref cluster.py:47-59)."""
+    return self.infeed_host_index, self.num_infeed_hosts
+
+
+def _Stack():
+  if not hasattr(_TLS, "stack"):
+    _TLS.stack = []
+  return _TLS.stack
+
+
+def Current() -> Cluster:
+  """The innermost active cluster (a default one outside any scope)."""
+  stack = _Stack()
+  if stack:
+    return stack[-1]
+  return Cluster(Cluster.Params())
+
+
+@contextlib.contextmanager
+def ClusterScope(cluster: Cluster):
+  """ref cluster_factory.Cluster(params) context."""
+  stack = _Stack()
+  stack.append(cluster)
+  try:
+    yield cluster
+  finally:
+    stack.pop()
+
+
+@contextlib.contextmanager
+def SetEval(do_eval: bool = True):
+  """ref cluster_factory.SetEval."""
+  cur = Current()
+  p = cur.p.Copy()
+  p.do_eval = do_eval
+  with ClusterScope(Cluster(p)) as c:
+    yield c
+
+
+def InitDistributed(coordinator_address: str | None = None,
+                    num_processes: int | None = None,
+                    process_id: int | None = None) -> None:
+  """Multi-host control plane: jax.distributed over DCN (the gRPC
+  tf.distribute.Server equivalent, ref trainer.py:256-278). No-op when
+  single-process or already initialized."""
+  if num_processes is None and coordinator_address is None:
+    return
+  try:
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+  except RuntimeError:
+    pass  # already initialized

@@ -252,3 +252,20 @@ jax.tree_util.register_pytree_with_keys(
     _nested_map_unflatten,
     flatten_func=_nested_map_flatten,
 )
+
+# jax.export serialization support: NestedMap aux data (the sorted key tuple)
+# round-trips as JSON so exported inference graphs can carry NestedMap
+# feeds/fetches.
+try:
+  import json as _json
+
+  from jax import export as _jax_export
+
+  _jax_export.register_pytree_node_serialization(
+      NestedMap,
+      serialized_name="lingvo_tpu.NestedMap",
+      serialize_auxdata=lambda keys: _json.dumps(list(keys)).encode(),
+      deserialize_auxdata=lambda data: tuple(_json.loads(data.decode())),
+  )
+except (ImportError, AttributeError):  # older jax without the API
+  pass

@@ -103,8 +103,9 @@ class LSTMCellSimple(RNNCell):
       gates = gates + th.b
     return gates
 
-  def FProp(self, theta, state0, inputs, padding=None):
+  def FProp(self, theta, state0, inputs, padding=None, preprocessed=False):
     """inputs: [b, D]; padding: optional [b]."""
+    del preprocessed  # identity PreProcessInputs
     p = self.p
     th = self.CastTheta(theta)
     xm = jnp.concatenate([self.ToFPropDtype(inputs), state0.m], axis=-1)
@@ -172,7 +173,8 @@ class GRUCell(RNNCell):
     return NestedMap(
         m=jnp.zeros((batch_size, self.p.num_output_nodes), self.fprop_dtype))
 
-  def FProp(self, theta, state0, inputs, padding=None):
+  def FProp(self, theta, state0, inputs, padding=None, preprocessed=False):
+    del preprocessed  # identity PreProcessInputs
     th = self.CastTheta(theta)
     x = self.ToFPropDtype(inputs)
     xm = jnp.concatenate([x, state0.m], axis=-1)
@@ -207,12 +209,11 @@ class SRUCell(RNNCell):
     th = self.CastTheta(theta)
     return self.ToFPropDtype(inputs_btd) @ th.w + th.b
 
-  def FProp(self, theta, state0, inputs, padding=None):
-    # `inputs` is the PREPROJECTED [b, 4H] slice when driven by FRNN; a raw
-    # [b, D] input (direct cell use) is projected here.
-    proj = inputs
-    if proj.shape[-1] != 4 * self.p.num_output_nodes:
-      proj = self.PreProcessInputs(theta, inputs)
+  def FProp(self, theta, state0, inputs, padding=None, preprocessed=False):
+    """`preprocessed=True` means `inputs` is the [b, 4H] PreProcessInputs
+    output (FRNN sets this); otherwise a raw [b, D] input is projected here.
+    """
+    proj = inputs if preprocessed else self.PreProcessInputs(theta, inputs)
     x_t, f_pre, r_pre, x_skip = jnp.split(proj, 4, axis=-1)
     f = jax.nn.sigmoid(f_pre)
     r = jax.nn.sigmoid(r_pre)

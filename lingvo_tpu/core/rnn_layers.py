@@ -47,7 +47,8 @@ class FRNN(base_layer.BaseLayer):
       xs = xs.Transform(lambda v: jnp.flip(v, axis=0))
 
     def _Cell(theta_cell, state, inputs_t):
-      return self.cell.FProp(theta_cell, state, inputs_t.x, inputs_t.padding)
+      return self.cell.FProp(theta_cell, state, inputs_t.x, inputs_t.padding,
+                             preprocessed=True)
 
     all_states, final_state = recurrent.Recurrent(
         theta.cell, state0, xs, _Cell, remat=p.remat)

@@ -31,11 +31,14 @@ class SpectrumAugmenter(base_layer.BaseLayer):
   def _NameIsRequired(self):
     return False
 
-  def _OneMask(self, key, size: int, max_width, batch: int):
+  def _OneMask(self, key, size: int, max_width, batch: int,
+               choose_range=None):
     """[batch, size] multiplicative mask with one random span zeroed.
 
     max_width may be a python int or a per-example int array. Start is drawn
-    from [0, size - width] INCLUSIVE so the span can sit flush at the end.
+    from [0, limit - width] INCLUSIVE, where limit is the per-example valid
+    length (`choose_range`, ref _GetMask choose_range) or `size` — so masks
+    land inside real content, and can sit flush at its end.
     """
     k1, k2 = jax.random.split(key)
     if isinstance(max_width, int):
@@ -43,8 +46,10 @@ class SpectrumAugmenter(base_layer.BaseLayer):
     else:
       width = (jax.random.uniform(k1, (batch,)) *
                (max_width + 1).astype(jnp.float32)).astype(jnp.int32)
+    limit = (jnp.full((batch,), size, jnp.int32) if choose_range is None
+             else choose_range.astype(jnp.int32))
     start = jax.random.randint(k2, (batch,), 0,
-                               jnp.maximum(size - width + 1, 1))
+                               jnp.maximum(limit - width + 1, 1))
     pos = jnp.arange(size)[None, :]
     inside = (pos >= start[:, None]) & (pos < (start + width)[:, None])
     return 1.0 - inside.astype(jnp.float32)
@@ -61,19 +66,22 @@ class SpectrumAugmenter(base_layer.BaseLayer):
     b, t, f, c = features.shape
     key = py_utils.StepSeed(f"{self.path}/specaug")
     mask = jnp.ones((b, t, f), jnp.float32)
-    max_t = p.time_mask_max_frames
-    if paddings is not None and p.time_mask_max_ratio < 1.0:
-      seq_lens = py_utils.LengthsFromPaddings(paddings)
-      max_t_per_ex = (seq_lens.astype(jnp.float32) *
-                      p.time_mask_max_ratio).astype(jnp.int32)
+    seq_lens = (py_utils.LengthsFromPaddings(paddings)
+                if paddings is not None else None)
+    if seq_lens is not None and p.time_mask_max_ratio < 1.0:
+      # width cap = min(absolute cap, ratio * per-example length)
+      time_width = jnp.minimum(
+          jnp.asarray(p.time_mask_max_frames, jnp.int32),
+          (seq_lens.astype(jnp.float32) *
+           p.time_mask_max_ratio).astype(jnp.int32))
     else:
-      max_t_per_ex = None
+      time_width = p.time_mask_max_frames
     for i in range(p.freq_mask_count):
       fk = jax.random.fold_in(key, 100 + i)
       mask = mask * self._OneMask(fk, f, p.freq_mask_max_bins, b)[:, None, :]
     for i in range(p.time_mask_count):
       tk = jax.random.fold_in(key, 200 + i)
-      width = max_t if max_t_per_ex is None else max_t_per_ex
-      mask = mask * self._OneMask(tk, t, width, b)[:, :, None]
+      mask = mask * self._OneMask(tk, t, time_width, b,
+                                  choose_range=seq_lens)[:, :, None]
     out = features * mask[..., None].astype(features.dtype)
     return out[..., 0] if squeeze else out

@@ -30,8 +30,8 @@ class SyntheticAsrInput(base_input_generator.BaseInputGenerator):
   def __init__(self, params):
     super().__init__(params)
     p = self.p
-    rng = np.random.RandomState(p.seed + 777)
-    # one fixed feature prototype per token id (proto_seed shared by splits)
+    # one fixed feature prototype per token id (seed shared across splits so
+    # train/test see the same token->feature mapping)
     self._protos = np.random.RandomState(777).randn(
         p.vocab_size, p.num_bins).astype(np.float32)
     self._step = 0

@@ -62,7 +62,8 @@ class CtcAsrModel(base_model.BaseTask):
             filter_shape=(3, 3, 32, 32), filter_stride=(2, 2),
             activation="RELU", batch_norm=False, has_bias=True))
     # two SAME stride-2 convs: freq -> ceil(ceil(f/2)/2)
-    sub_freq = -(-(-(-p.input_dim // 2)) // 2)
+    sub_freq = (p.input_dim + 1) // 2
+    sub_freq = (sub_freq + 1) // 2
     self.CreateChild(
         "input_proj",
         layers_lib.ProjectionLayer.Params().Set(

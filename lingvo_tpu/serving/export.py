@@ -1,0 +1,106 @@
+"""Inference export: task subgraphs -> serialized StableHLO + manifest.
+
+Re-designs `lingvo/core/inference_graph_exporter.py` (+inference_graph.proto):
+`task.Inference()` returns {subgraph_name: (fn, example_inputs)}; each is
+jit-lowered and serialized with `jax.export` (StableHLO), with a JSON
+manifest of feeds/fetches shapes/dtypes — the TPU-native InferenceGraph.
+Weights are saved alongside via orbax so the Predictor restores everything
+from one directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def _ToNestedMap(tree):
+  """Plain dicts (orbax restore output) -> NestedMap, recursively."""
+  if isinstance(tree, dict):
+    return NestedMap({k: _ToNestedMap(v) for k, v in tree.items()})
+  if isinstance(tree, list):
+    return [_ToNestedMap(v) for v in tree]
+  return tree
+
+
+def _SpecManifest(tree) -> Any:
+  return jax.tree_util.tree_map(
+      lambda x: {"shape": list(np.shape(x)),
+                 "dtype": str(np.asarray(x).dtype)}, tree)
+
+
+class InferenceGraphExporter:
+  """Exports a task's inference subgraphs + theta to `export_dir`."""
+
+  @staticmethod
+  def Export(task, theta: NestedMap, export_dir: str,
+             bfloat16_activations: bool = False) -> dict:
+    os.makedirs(export_dir, exist_ok=True)
+    subgraphs = task.Inference()
+    manifest = {"subgraphs": {}}
+    from jax import export as jax_export
+    for name, (fn, example_inputs) in subgraphs.items():
+      if bfloat16_activations:
+        example_inputs = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, example_inputs)
+
+      def wrapped(theta_, inputs_, fn=fn):
+        return fn(theta_, inputs_)
+
+      args = (theta, example_inputs)
+      exported = jax_export.export(jax.jit(wrapped))(
+          *jax.tree_util.tree_map(
+              lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                             np.asarray(x).dtype), args))
+      blob = exported.serialize()
+      with open(os.path.join(export_dir, f"{name}.stablehlo"), "wb") as f:
+        f.write(blob)
+      manifest["subgraphs"][name] = {
+          "feeds": _SpecManifest(example_inputs),
+          "fetches": "see exported signature",
+          "artifact": f"{name}.stablehlo",
+      }
+    # weights
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(export_dir, "theta"), dict(theta=theta))
+    ckptr.wait_until_finished()
+    with open(os.path.join(export_dir, "inference_graph.json"), "w") as f:
+      json.dump(manifest, f, indent=2)
+    return manifest
+
+
+class Predictor:
+  """Loads an export dir and runs subgraphs (ref predictor.py:58)."""
+
+  def __init__(self, export_dir: str):
+    self._dir = export_dir
+    with open(os.path.join(export_dir, "inference_graph.json")) as f:
+      self._manifest = json.load(f)
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.join(export_dir, "theta"))
+    self._theta = _ToNestedMap(restored["theta"])
+    self._fns = {}
+    from jax import export as jax_export
+    for name, info in self._manifest["subgraphs"].items():
+      with open(os.path.join(export_dir, info["artifact"]), "rb") as f:
+        self._fns[name] = jax_export.deserialize(f.read())
+
+  @property
+  def subgraph_names(self):
+    return sorted(self._fns)
+
+  def Run(self, subgraph_name: str, inputs) -> Any:
+    """Runs a subgraph on `inputs` (same structure as export-time example)."""
+    exported = self._fns[subgraph_name]
+    return exported.call(self._theta, inputs)

@@ -1,0 +1,152 @@
+"""Tests: serving export/predictor, early stop, task scheduler, cluster."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import cluster as cluster_lib
+from lingvo_tpu.core import early_stop, task_scheduler
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TestServingExport:
+
+  def test_export_and_predict_roundtrip(self, tmp_path):
+    from lingvo_tpu.core import base_model, layers, learner as learner_lib
+    from lingvo_tpu.serving import export as export_lib
+
+    class TinyTask(base_model.BaseTask):
+
+      def __init__(self, params):
+        super().__init__(params)
+        self.CreateChild(
+            "proj",
+            layers.ProjectionLayer.Params().Set(input_dim=4, output_dim=2))
+
+      def ComputePredictions(self, theta, input_batch):
+        return self.proj.FProp(theta.proj, input_batch.x)
+
+      def ComputeLoss(self, theta, predictions, input_batch):
+        return NestedMap(loss=(jnp.mean(predictions), 1.0)), NestedMap()
+
+      def Inference(self):
+        example = NestedMap(x=jnp.ones((3, 4)))
+
+        def default_fn(theta, inputs):
+          return NestedMap(out=self.proj.FProp(theta.proj, inputs.x))
+
+        return {"default": (default_fn, example)}
+
+    task = TinyTask.Params().Set(name="tiny").Instantiate()
+    task.FinalizePaths()
+    theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+    export_dir = str(tmp_path / "export")
+    manifest = export_lib.InferenceGraphExporter.Export(task, theta,
+                                                        export_dir)
+    assert "default" in manifest["subgraphs"]
+    assert os.path.exists(os.path.join(export_dir, "default.stablehlo"))
+
+    predictor = export_lib.Predictor(export_dir)
+    assert predictor.subgraph_names == ["default"]
+    x = NestedMap(x=jnp.full((3, 4), 2.0))
+    out = predictor.Run("default", x)
+    expected = task.ComputePredictions(theta, x)
+    np.testing.assert_allclose(np.asarray(out["out"]), np.asarray(expected),
+                               rtol=1e-5)
+
+
+class TestEarlyStop:
+
+  def test_best_step_and_plateau(self, tmp_path):
+    mh = early_stop.MetricHistory(str(tmp_path), "eval", "loss")
+    values = [(100, 5.0), (200, 4.0), (300, 3.5), (400, 3.6), (500, 3.55)]
+    for s, v in values:
+      mh.ConditionalAppend(s, v)
+    best, last = early_stop.BestStep(mh.path)
+    assert (best, last) == (300, 500)
+    es = early_stop.EarlyStop(early_stop.EarlyStop.Params().Set(
+        window=150, metric_history=mh))
+    assert es.Stop(500)  # 500-300 > 150
+    es2 = early_stop.EarlyStop(early_stop.EarlyStop.Params().Set(
+        window=300, metric_history=mh))
+    assert not es2.Stop(500)
+
+  def test_tolerance(self, tmp_path):
+    mh = early_stop.MetricHistory(str(tmp_path), "e", "m")
+    for s, v in [(1, 1.0), (2, 0.999), (3, 0.9)]:
+      mh.ConditionalAppend(s, v)
+    best, _ = early_stop.BestStep(mh.path, tolerance=0.05)
+    assert best == 3  # 0.999 not enough improvement; 0.9 is
+
+  def test_maximize_mode(self, tmp_path):
+    mh = early_stop.MetricHistory(str(tmp_path), "e", "bleu",
+                                  minimize=False)
+    for s, v in [(1, 10.0), (2, 20.0), (3, 15.0)]:
+      mh.ConditionalAppend(s, v)
+    best, _ = early_stop.BestStep(mh.path, minimize=False)
+    assert best == 2
+
+
+class TestTaskScheduler:
+
+  def test_constant(self):
+    p = task_scheduler.ConstantScheduler.Params().Set(
+        task_probs=[("a", 0.9), ("b", 0.1)], seed=0)
+    s = p.Instantiate()
+    picks = [s.Sample(0) for _ in range(300)]
+    assert picks.count("a") > 2 * picks.count("b")
+
+  def test_exponential_anneals(self):
+    p = task_scheduler.ExponentialScheduler.Params().Set(
+        task_probs=[("a", 1.0), ("b", 0.0)],
+        task_probs_final=[("a", 0.0), ("b", 1.0)], alpha=1e-3, seed=0)
+    s = p.Instantiate()
+    s.Sample(0)
+    early = s.cur_probs.copy()
+    s.Sample(10000)
+    late = s.cur_probs
+    assert early[0] > 0.9 and late[1] > 0.9
+
+  def test_adaptive_prefers_lagging(self):
+    p = task_scheduler.AdaptiveScheduler.Params().Set(
+        targets=[("a", 1.0), ("b", 1.0)], seed=0)
+    s = p.Instantiate()
+    s.ReportMetric("a", 5.0)  # far from target
+    s.ReportMetric("b", 1.0)  # at target
+    s.Sample(0)
+    assert s.cur_probs[0] > s.cur_probs[1]
+
+
+class TestCluster:
+
+  def test_current_and_scope(self):
+    default = cluster_lib.Current()
+    assert default.p.job == "executor_tpu"
+    p = cluster_lib.Cluster.Params().Set(job="decoder")
+    with cluster_lib.ClusterScope(cluster_lib.Cluster(p)) as c:
+      assert cluster_lib.Current() is c
+      assert not cluster_lib.Current().add_summary
+    assert cluster_lib.Current().p.job == "executor_tpu"
+
+  def test_set_eval(self):
+    assert not cluster_lib.Current().do_eval
+    with cluster_lib.SetEval():
+      assert cluster_lib.Current().do_eval
+
+  def test_topology_and_mesh(self):
+    c = cluster_lib.Current()
+    assert c.num_devices >= 1
+    shard, num = c.InputShardParams()
+    assert 0 <= shard < num
+    mesh = c.MakeMesh()
+    assert mesh.devices.size == c.num_devices
+
+  def test_trial_noop(self):
+    from lingvo_tpu.core import base_trial
+    t = base_trial.NoOpTrial()
+    assert t.OverrideModelParams({"x": 1}) == {"x": 1}
+    assert not t.ReportEvalMeasure(0, {})
+    assert not t.ShouldStop()
