@@ -98,6 +98,11 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     p.Define("atten_dropout_prob", 0.0, "Attention prob dropout.")
     p.Define("atten_logit_cap", 0.0, "If >0, tanh-cap logits.")
     p.Define("use_rotary_position_emb", False, "Apply RoPE to q/k.")
+    p.Define(
+        "use_flash_attention", False,
+        "Use the fused Pallas flash kernel when eligible (self-attention, "
+        "causal-or-full, no paddings/segments/rel-bias/dropout/logit-cap); "
+        "falls back to the einsum path otherwise.")
     p.Define("rel_pos_emb_dim", 0,
              "If >0, learned relative position bias buckets (T5-style).")
     p.Define("rel_pos_max_distance", 128, "Relative bucket clip distance.")
@@ -198,14 +203,24 @@ class MultiHeadedAttention(base_layer.BaseLayer):
           keep_prob=1.0 - p.atten_dropout_prob)
     return jnp.einsum("BNTS,BSNH->BTNH", probs, v), probs
 
+  def _FlashEligible(self, key_vec, paddings, atten_mask, segment_ids, t):
+    p = self.p
+    return (p.use_flash_attention and key_vec is None and paddings is None
+            and atten_mask is None and segment_ids is None and
+            p.rel_pos_emb_dim == 0 and p.atten_logit_cap == 0 and
+            p.atten_dropout_prob == 0 and t % 16 == 0)
+
   def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
-            paddings=None, atten_mask=None, segment_ids=None):
-    """Returns ([B,T,D] output, [B,N,T,S] probs).
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
+    """Returns ([B,T,D] output, [B,N,T,S] probs or None on the flash path).
 
     atten_mask: optional additive mask (e.g. CausalMask). paddings are key
     paddings [B,S]. segment_ids: [B,T] packed-input ids for both q and k
-    (self-attention) — adds a SegmentMask.
+    (self-attention) — adds a SegmentMask. `causal=True` is an alternative
+    to passing CausalMask that lets the fused flash kernel run.
     """
+    use_flash = self._FlashEligible(key_vec, paddings, atten_mask,
+                                    segment_ids, query_vec.shape[1])
     key_vec = query_vec if key_vec is None else key_vec
     value_vec = key_vec if value_vec is None else value_vec
     q = self._HeadsProj(theta, "query", query_vec)
@@ -216,7 +231,18 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       q = self.rotary.FProp(rt, q)
       k = self.rotary.FProp(rt, k)
     q = self._ScaleQuery(theta, q)
+    if use_flash:
+      from lingvo_tpu.ops import flash_attention
+      # the kernel scales by 1/sqrt(h) internally; q already carries the
+      # (learned) query scale, so cancel the kernel's factor.
+      h = self._dim_per_head
+      ctx = flash_attention.FlashAttention(
+          q * math.sqrt(h), k, v, causal=causal)
+      return self._PostProj(theta, ctx), None
     mask = atten_mask
+    if causal:
+      cm = CausalMask(query_vec.shape[1])
+      mask = cm if mask is None else mask + cm
     if paddings is not None:
       pm = PaddingsToMask(paddings)
       mask = pm if mask is None else mask + pm
@@ -291,9 +317,12 @@ class LocalSelfAttention(MultiHeadedAttention):
     assert p.right_context <= p.block_size, "right_context > block_size"
 
   def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
-            paddings=None, atten_mask=None, segment_ids=None):
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
     p = self.p
     del key_vec, value_vec  # self-attention only
+    # causality is inherent to the window config (right_context=0); the
+    # kwarg exists for signature compatibility with the base class.
+    del causal
     b, t, d = query_vec.shape
     w = p.block_size
     num_blocks = -(-t // w)
@@ -371,8 +400,9 @@ class ChunkwiseSelfAttention(MultiHeadedAttention):
     return p
 
   def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
-            paddings=None, atten_mask=None, segment_ids=None):
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
     p = self.p
+    del causal  # governed by p.causal (within-chunk masking)
     b, t, d = query_vec.shape
     c = p.chunk_size
     num_chunks = -(-t // c)

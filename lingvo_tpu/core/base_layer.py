@@ -28,6 +28,26 @@ from lingvo_tpu.core.nested_map import NestedMap
 from lingvo_tpu.core.py_utils import WeightInit, WeightParams
 
 
+def StackedVariableSpecs(body: "BaseLayer", n: int) -> NestedMap:
+  """body's VariableSpecs with a leading stack dim of n (replicated axis).
+
+  Keeps VariableSpecs (param counts, sharding derivation) truthful for
+  scan-over-layers / pipeline layers whose theta leaves are stacked.
+  """
+
+  def _Stack(wp: WeightParams) -> WeightParams:
+    sdm = wp.tensor_split_dims_mapping
+    return WeightParams(
+        shape=(n,) + tuple(wp.shape),
+        init=wp.init,
+        dtype=wp.dtype,
+        collections=wp.collections,
+        tensor_split_dims_mapping=((None,) + tuple(sdm))
+        if sdm is not None else None)
+
+  return jax.tree_util.tree_map(_Stack, body.VariableSpecs())
+
+
 def StackedInstantiateVariables(body: "BaseLayer", key: jax.Array,
                                 n: int) -> NestedMap:
   """n independently-seeded copies of body's theta, stacked on axis 0.

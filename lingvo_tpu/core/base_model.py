@@ -44,6 +44,13 @@ class BaseTask(base_layer.BaseLayer):
     tp.Define("save_interval_steps", 1000, "Checkpoint every N steps.")
     tp.Define("save_max_to_keep", 10, "Checkpoints kept by GC.")
     tp.Define("summary_interval_steps", 100, "Summary cadence.")
+    tp.Define("early_stop_window", 0,
+              "Stop after this many steps without eval-loss improvement "
+              "(0 = disabled; ref early_stop.EarlyStop).")
+    tp.Define("early_stop_tolerance", 0.0, "Improvement margin.")
+    tp.Define("early_stop_metric", "loss", "Eval metric to watch.")
+    tp.Define("early_stop_program", "eval_test",
+              "Which eval program's results feed the plateau detector.")
     p.Define("train", tp, "Training hyperparams.")
     ep = hyperparams.Params()
     ep.Define("samples_per_summary", 1000, "Max eval examples per run.")

@@ -90,6 +90,10 @@ class EarlyStop:
     p = self.p
     if p.window <= 0 or self.metric_history is None:
       return False
+    # no recorded evals yet -> never stop (a missing history must not read
+    # as 'best was step 0')
+    if not self.metric_history.Read():
+      return False
     best, last = BestStep(self.metric_history.path, p.tolerance, p.minimize)
     step = current_step if current_step is not None else last
     if step < p.min_steps:

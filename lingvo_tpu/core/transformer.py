@@ -123,13 +123,11 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
     p = self.p
     x = self.ln.FProp(theta.ln, query_vec)
     if source_vecs is None:
-      mask = atten_mask
-      if p.is_masked:
-        cm = attention_lib.CausalMask(x.shape[1], jnp.float32)
-        mask = cm if mask is None else mask + cm
+      # causality is passed as a flag (not a materialized mask) so the fused
+      # flash kernel can take over when eligible.
       out, probs = self.atten.FProp(
-          theta.atten, x, paddings=paddings, atten_mask=mask,
-          segment_ids=segment_ids)
+          theta.atten, x, paddings=paddings, atten_mask=atten_mask,
+          segment_ids=segment_ids, causal=p.is_masked)
     else:
       out, probs = self.atten.FProp(
           theta.atten, x, key_vec=source_vecs, value_vec=source_vecs,
@@ -296,6 +294,10 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
       self.FinalizePaths()
     return NestedMap(body=base_layer.StackedInstantiateVariables(
         self.body, key, self.p.num_layers))
+
+  def VariableSpecs(self):
+    return NestedMap(body=base_layer.StackedVariableSpecs(
+        self.body, self.p.num_layers))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
             aux_paddings=None, segment_ids=None):

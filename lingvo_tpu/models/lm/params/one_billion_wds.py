@@ -1,0 +1,61 @@
+"""One-Billion-Words LM configs (ref:
+`tasks/lm/params/one_billion_wds.py:138` WordLevelOneBwdsSimpleSampledSoftmax
+and the transformer variants).
+
+Model shapes at reference parity; input is the synthetic packed generator
+until the native pipeline feeds the real 1B-words shards (the C++ yielder +
+vocab tokenizer in ops/ already handle that format:
+`text:<shards>` + VocabTokenizer over the 793k-word vocab).
+"""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.lm import input_generator
+from lingvo_tpu.models.lm import layers as lm_layers
+
+
+@model_registry.RegisterSingleTaskModel
+class OneBWdsTransformerLm(base_model_params.SingleTaskModelParams):
+  """Word-level transformer LM on 1B-words-scale shapes."""
+
+  VOCAB = 32000  # subword; the ref word-level 793k vocab needs the sampled
+                 # softmax (roadmap)
+  SEQ = 512
+  BATCH = 32
+  MODEL_DIM = 1024
+  NUM_LAYERS = 20
+  NUM_HEADS = 16
+  HIDDEN_DIM = 4096
+
+  def Train(self):
+    return input_generator.SyntheticLmInput.Params().Set(
+        batch_size=self.BATCH, seq_len=self.SEQ, vocab_size=self.VOCAB,
+        packing=True)
+
+  def Test(self):
+    return input_generator.SyntheticLmInput.Params().Set(
+        batch_size=self.BATCH, seq_len=self.SEQ, vocab_size=self.VOCAB,
+        packing=True, seed=7)
+
+  def Task(self):
+    p = lm_layers.TransformerLm.Params()
+    p.name = "one_billion_wds"
+    p.vocab_size = self.VOCAB
+    p.model_dim = self.MODEL_DIM
+    p.num_layers = self.NUM_LAYERS
+    p.num_heads = self.NUM_HEADS
+    p.hidden_dim = self.HIDDEN_DIM
+    p.residual_dropout_prob = 0.1
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params().Set(beta2=0.98),
+        lr_schedule=sched_lib.LinearRampupCosineDecay.Params().Set(
+            warmup_steps=4000, total_steps=500_000),
+        clip_gradient_norm_to_value=1.0)
+    p.train.tpu_steps_per_loop = 100
+    return p

@@ -91,14 +91,14 @@ class DenseLm1B(DenseLmTemplate):
 
 @model_registry.RegisterSingleTaskModel
 class DenseLm8B(DenseLmTemplate):
-  """Ref DenseLm8B2x2 (`synthetic_packed_input.py:161-181`): 32 layers,
-  model_dim 8192, seq 1024."""
+  """Ref DenseLm8B2x2 (`synthetic_packed_input.py:161-181`): 4 transformer
+  blocks, model_dim 8192, ff 65536, 128 heads, seq 1024 (~8B params)."""
 
   SEQUENCE_LENGTH = 1024
   MODEL_DIM = 8192
-  NUM_LAYERS = 32
-  NUM_HEADS = 64
-  HIDDEN_DIM = 32768
+  NUM_LAYERS = 4
+  NUM_HEADS = 128
+  HIDDEN_DIM = 65536
 
 
 @model_registry.RegisterSingleTaskModel
@@ -145,10 +145,11 @@ class MoELm64E(DenseLmTemplate):
 
 @model_registry.RegisterSingleTaskModel
 class DenseLm128B(DenseLmTemplate):
-  """Ref DenseLm128B8x8 (`synthetic_packed_input.py:200-237`)."""
+  """Ref DenseLm128B8x8 (`synthetic_packed_input.py:200-237`): 64 blocks at
+  the 8B dims (~137.7B params per the reference's comment)."""
 
   SEQUENCE_LENGTH = 1024
-  MODEL_DIM = 16384
+  MODEL_DIM = 8192
   NUM_LAYERS = 64
   NUM_HEADS = 128
   HIDDEN_DIM = 65536

@@ -53,6 +53,10 @@ class PipelinedLayer(base_layer.BaseLayer):
     return NestedMap(body=base_layer.StackedInstantiateVariables(
         self.body, key, self.p.num_stages))
 
+  def VariableSpecs(self):
+    return NestedMap(body=base_layer.StackedVariableSpecs(
+        self.body, self.p.num_stages))
+
   def _StageSpec(self, x):
     """PartitionSpec sharding dim 0 (stages) of a buffer."""
     return (self.p.stage_axis,) + (None,) * (x.ndim - 1)

@@ -53,6 +53,17 @@ class ExecutorTpu:
     self._init_seed = init_seed
     self._precompile = precompile
     self._max_steps = tp.max_steps
+    # early stop on eval plateau (ref base_runner._ShouldStop + EarlyStop)
+    self._early_stop = None
+    if getattr(tp, "early_stop_window", 0) > 0:
+      from lingvo_tpu.core import early_stop as early_stop_lib
+      self._metric_history = early_stop_lib.MetricHistory(
+          logdir, "eval", tp.early_stop_metric)
+      self._early_stop = early_stop_lib.EarlyStop(
+          early_stop_lib.EarlyStop.Params().Set(
+              window=tp.early_stop_window,
+              tolerance=tp.early_stop_tolerance,
+              metric_history=self._metric_history))
 
   @property
   def task(self):
@@ -90,6 +101,19 @@ class ExecutorTpu:
       state, results = self._schedule.Run(state)
       step = int(jax.device_get(state.step))
       self._ExportMetrics(step, results)
+      if self._early_stop is not None:
+        tp = self._task.p.train
+        # one designated eval program feeds the plateau detector — mixing
+        # datasets would compare non-comparable losses
+        r = results.get(tp.early_stop_program)
+        if r is not None and tp.early_stop_metric in r:
+          self._metric_history.ConditionalAppend(step,
+                                                 r[tp.early_stop_metric])
+        if self._early_stop.Stop(step):
+          print(f"[executor] early stop at step {step} "
+                f"(no {tp.early_stop_metric} improvement in "
+                f"{tp.early_stop_window} steps)", flush=True)
+          break
     self._checkpointer.Save(step, state, force=True)
     self._checkpointer.Close()
     return state

@@ -18,10 +18,16 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # remote handshake.
 try:
   import jax  # noqa: E402  (may already be imported by sitecustomize)
-  # chex/checkify register lowering rules for the 'tpu' platform at import;
-  # do it BEFORE we strip non-cpu plugin factories or the registration fails.
+  # chex/checkify and pallas register lowering rules for the 'tpu' platform
+  # at import; do it BEFORE we strip non-cpu plugin factories or the
+  # registration fails.
   try:
     import chex  # noqa: E402,F401
+  except ImportError:
+    pass
+  try:
+    import jax.experimental.pallas  # noqa: E402,F401
+    import jax.experimental.pallas.tpu  # noqa: E402,F401
   except ImportError:
     pass
   from jax._src import xla_bridge  # noqa: E402

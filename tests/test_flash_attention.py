@@ -1,0 +1,115 @@
+"""Flash attention kernel tests (interpret mode on CPU): exactness vs plain
+attention, causal masking, gradients through the custom VJP."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.ops import flash_attention
+
+KEY = jax.random.PRNGKey(21)
+
+
+def _ref(q, k, v, causal):
+  b, t, n, h = q.shape
+  s = jnp.einsum("bqnh,bknh->bnqk", q, k) / math.sqrt(h)
+  if causal:
+    mask = jnp.tril(jnp.ones((t, t), jnp.bool_))
+    s = jnp.where(mask[None, None], s, -1e30)
+  p = jax.nn.softmax(s, axis=-1)
+  return jnp.einsum("bnqk,bknh->bqnh", p, v)
+
+
+class TestFlashAttention:
+
+  @pytest.mark.parametrize("causal", [True, False])
+  def test_matches_reference(self, causal):
+    b, t, n, h = 2, 64, 2, 16
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h))
+    out = flash_attention.FlashAttention(
+        q, k, v, causal=causal, block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(q, k, v, causal)), atol=2e-5)
+
+  def test_blocks_do_not_change_result(self):
+    b, t, n, h = 1, 64, 1, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+    out1 = flash_attention.FlashAttention(
+        q, q, q, block_q=64, block_k=64, interpret=True)
+    out2 = flash_attention.FlashAttention(
+        q, q, q, block_q=16, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=2e-5)
+
+  def test_gradients_match_reference(self):
+    b, t, n, h = 1, 32, 2, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h))
+
+    def loss_flash(q, k, v):
+      return jnp.sum(jnp.square(flash_attention.FlashAttention(
+          q, k, v, block_q=16, block_k=16, interpret=True)))
+
+    def loss_ref(q, k, v):
+      return jnp.sum(jnp.square(_ref(q, k, v, True)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+  def test_mha_flash_path_matches_einsum_path(self):
+    from lingvo_tpu.core import attention
+    p = attention.MultiHeadedAttention.Params().Set(
+        name="mha", input_dim=16, hidden_dim=16, num_heads=2,
+        use_flash_attention=True)
+    flash = p.Instantiate()
+    theta = flash.InstantiateVariables(KEY)
+    plain = p.Copy().Set(use_flash_attention=False).Instantiate()
+    x = jax.random.normal(KEY, (2, 32, 16))
+    out_flash, probs = flash.FProp(theta, x, causal=True)
+    assert probs is None  # flash path returns no probability matrix
+    out_plain, _ = plain.FProp(theta, x, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_plain), atol=2e-5)
+    # paddings force the fallback path (still correct, probs returned)
+    pad = jnp.zeros((2, 32)).at[1, 20:].set(1.0)
+    out_f2, probs2 = flash.FProp(theta, x, paddings=pad, causal=True)
+    out_p2, _ = plain.FProp(theta, x, paddings=pad, causal=True)
+    assert probs2 is not None
+    np.testing.assert_allclose(
+        np.asarray(out_f2), np.asarray(out_p2), atol=2e-5)
+
+  def test_nondivisible_by_128_autofits_blocks(self):
+    # Regression: t=160 (multiple of 16, not 128) must not crash.
+    b, t, n, h = 1, 160, 1, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+    out = flash_attention.FlashAttention(q, q, q, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(q, q, q, True)), atol=2e-5)
+
+  def test_local_attention_accepts_causal_kwarg(self):
+    # Regression: atten_tpl overrides must survive the causal= plumbing.
+    from lingvo_tpu.core import attention, transformer
+    p = transformer.TransformerLayer.Params().Set(
+        name="xf", input_dim=16, num_heads=2, hidden_dim=32,
+        mask_self_atten=True)
+    p.tr_atten_tpl.atten_tpl = attention.LocalSelfAttention.Params().Set(
+        block_size=8, left_context=8)
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    out = layer.FProp(theta, jax.random.normal(KEY, (2, 16, 16)))
+    assert out.shape == (2, 16, 16)
+
+  def test_jit_and_bf16(self):
+    b, t, n, h = 1, 32, 1, 8
+    q = jax.random.normal(KEY, (b, t, n, h), jnp.bfloat16)
+    out = jax.jit(lambda q: flash_attention.FlashAttention(
+        q, q, q, block_q=16, block_k=16, interpret=True))(q)
+    assert out.dtype == jnp.bfloat16
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
