@@ -323,6 +323,10 @@ class LocalSelfAttention(MultiHeadedAttention):
     # causality is inherent to the window config (right_context=0); the
     # kwarg exists for signature compatibility with the base class.
     del causal
+    if atten_mask is not None:
+      raise NotImplementedError(
+          "LocalSelfAttention cannot apply a dense [T, T] atten_mask to its "
+          "windowed logits; use segment_ids (packed inputs) or paddings.")
     b, t, d = query_vec.shape
     w = p.block_size
     num_blocks = -(-t // w)
@@ -373,6 +377,20 @@ class LocalSelfAttention(MultiHeadedAttention):
                         constant_values=1.0)[:, 1:]
     kpads = jnp.concatenate([pads_prev, pads_blocked, pads_next], axis=2)
     logits = logits + (kpads[:, :, None, None, :] * _NEG_INF)
+    if segment_ids is not None:
+      # Packed inputs: queries must not see keys of a different segment even
+      # inside the window. Padded positions get segment -1 (matches nothing
+      # unpadded; padding is masked above anyway).
+      seg = jnp.pad(segment_ids.astype(jnp.int32), ((0, 0), (0, pad_t)),
+                    constant_values=-1)
+      seg_blocked = seg.reshape(b, num_blocks, w)
+      seg_prev = jnp.pad(seg_blocked, ((0, 0), (1, 0), (0, 0)),
+                         constant_values=-1)[:, :-1]
+      seg_next = jnp.pad(seg_blocked, ((0, 0), (0, 1), (0, 0)),
+                         constant_values=-1)[:, 1:]
+      kseg = jnp.concatenate([seg_prev, seg_blocked, seg_next], axis=2)
+      same = seg_blocked[:, :, :, None] == kseg[:, :, None, :]  # [B,L,Q,K]
+      logits = jnp.where(same[:, :, None, :, :], logits, _NEG_INF)
     logits = jnp.maximum(logits, _NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -403,6 +421,10 @@ class ChunkwiseSelfAttention(MultiHeadedAttention):
             paddings=None, atten_mask=None, segment_ids=None, causal=False):
     p = self.p
     del causal  # governed by p.causal (within-chunk masking)
+    if atten_mask is not None:
+      raise NotImplementedError(
+          "ChunkwiseSelfAttention cannot apply a dense [T, T] atten_mask to "
+          "its chunked logits; use segment_ids (packed inputs) or paddings.")
     b, t, d = query_vec.shape
     c = p.chunk_size
     num_chunks = -(-t // c)
@@ -432,6 +454,12 @@ class ChunkwiseSelfAttention(MultiHeadedAttention):
       logits = jnp.where(causal[None, None, None], logits, _NEG_INF)
     pads_c = pads.reshape(b, num_chunks, c)
     logits = logits + pads_c[:, :, None, None, :] * _NEG_INF
+    if segment_ids is not None:
+      seg = jnp.pad(segment_ids.astype(jnp.int32), ((0, 0), (0, pad_t)),
+                    constant_values=-1)
+      seg_c = seg.reshape(b, num_chunks, c)
+      same = seg_c[:, :, :, None] == seg_c[:, :, None, :]     # [B,L,Q,K]
+      logits = jnp.where(same[:, :, None, :, :], logits, _NEG_INF)
     logits = jnp.maximum(logits, _NEG_INF)
     probs = jax.nn.softmax(logits, -1).astype(q.dtype)
     ctx = jnp.einsum("BLNQK,BLKNH->BLQNH", probs, vc)

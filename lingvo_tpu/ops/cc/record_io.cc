@@ -51,12 +51,24 @@ class TFRecordIterator : public RecordIterator {
     if (!f_) return false;
     uint64_t len = 0;
     if (fread(&len, sizeof(len), 1, f_) != 1) return false;
+    // A corrupt/truncated file can carry an absurd length; bound it so we
+    // fail cleanly instead of attempting a multi-GB resize (std::bad_alloc).
+    // Callers treat `false` as end-of-stream, so make the corruption visible.
+    if (len > kMaxRecordBytes) {
+      fprintf(stderr,
+              "lingvo_tpu record_io: record length %llu exceeds %llu — "
+              "corrupt TFRecord file; dropping remainder of shard\n",
+              (unsigned long long)len, (unsigned long long)kMaxRecordBytes);
+      return false;
+    }
     if (fseek(f_, 4, SEEK_CUR) != 0) return false;  // length crc
     record->resize(len);
     if (len > 0 && fread(record->data(), 1, len, f_) != len) return false;
     if (fseek(f_, 4, SEEK_CUR) != 0) return false;  // data crc
     return true;
   }
+
+  static constexpr uint64_t kMaxRecordBytes = 1ull << 30;  // 1 GiB
 
  private:
   FILE* f_;
@@ -75,6 +87,12 @@ class RecordIOIterator : public RecordIterator {
     if (!f_) return false;
     uint32_t len = 0;
     if (fread(&len, sizeof(len), 1, f_) != 1) return false;
+    if (len > TFRecordIterator::kMaxRecordBytes) {
+      fprintf(stderr,
+              "lingvo_tpu record_io: record length %u exceeds max — corrupt "
+              "recordio file; dropping remainder of shard\n", len);
+      return false;
+    }
     record->resize(len);
     if (len > 0 && fread(record->data(), 1, len, f_) != len) return false;
     return true;

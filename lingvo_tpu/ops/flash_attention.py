@@ -1,16 +1,30 @@
-"""Flash attention: fused blocked attention as a Pallas TPU kernel.
+"""Flash attention: fused blocked attention as Pallas TPU kernels.
 
 The hot op behind long-context training: never materializes the [T, T]
-probability matrix. Each grid step owns one query block for one (batch, head)
-and streams key/value blocks through VMEM with an online-softmax running
-max/denominator — O(T * BLOCK) memory instead of O(T^2) (the reference's only
-recourse was approximate windowed/chunked attention,
-`batch_major_attention.py:2656,4008`).
+probability matrix. Forward and backward are both Pallas kernels (the
+reference's only recourse was approximate windowed/chunked attention,
+`batch_major_attention.py:2656,4008`; it has no fused exact attention).
 
-Forward is the Pallas kernel; backward (jax.custom_vjp) recomputes attention
-through a blocked, per-block-remat'ed XLA implementation — O(T * block)
-residual memory, compiler-fused matmuls. On CPU the kernel runs in interpret
-mode (used by tests for exactness against plain attention).
+Design (TPU-first):
+- 3D sequential grid `(batch*heads, q_block, k_block)` with K/V streamed
+  through VMEM by BlockSpec — the kernel never holds more than one
+  `[block, head_dim]` tile of K/V, so VMEM use is O(block * h), independent
+  of sequence length. Pallas double-buffers the HBM->VMEM DMAs across grid
+  steps automatically.
+- Online softmax in f32 VMEM scratch (running max `m`, denominator `l`,
+  accumulator `acc`) carried across the innermost (k) grid dimension.
+- Forward also emits the logsumexp `lse = m + log(l)` per query row; the
+  backward kernels recompute probabilities from (q, k, lse) per block —
+  O(T) residual memory instead of O(T^2).
+- Backward = two kernels, matching the standard flash-attention backward:
+  a dK/dV pass (grid over k blocks, streaming q blocks) and a dQ pass
+  (grid over q blocks, streaming k blocks), with
+  `delta = rowsum(dout * out)` precomputed in XLA.
+- Causal masking skips fully-masked blocks via `pl.when` (no FLOPs, no
+  wrong-bound bug when block_q != block_k).
+
+On CPU the kernels run in interpret mode (used by tests for exactness
+against plain attention).
 """
 
 from __future__ import annotations
@@ -21,124 +35,283 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1.0e30
 
 
-def _FlashFwdKernel(q_ref, k_ref, v_ref, out_ref, *, block_k: int,
-                    causal: bool, sm_scale: float):
-  """One (batch*head, q_block) program: stream K/V blocks, online softmax."""
-  q = q_ref[0].astype(jnp.float32) * sm_scale          # [block_q, h]
-  block_q = q.shape[0]
-  t_kv = k_ref.shape[1]
-  q_blk = pl.program_id(1)
-  q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
-      jnp.int32, (block_q, block_k), 0)
+def _ApplyCausalMask(s, q_start, k_start, block_q: int, block_k: int):
+  q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+  k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+  return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
-  num_k_blocks = t_kv // block_k
 
-  def _Body(kb, carry):
-    m_prev, l_prev, acc = carry
-    k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-    v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-    s = q @ k.T                                        # [block_q, block_k]
+def _RecomputePandDs(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     q_start, k_start, *, block_q: int, block_k: int,
+                     causal: bool, sm_scale: float):
+  """Shared backward-block recompute: returns (q, do, p, ds) in f32.
+
+  p = exp(s - lse) reproduces the forward probabilities from the saved
+  logsumexp; ds = p * (dp - delta) * sm_scale is d(loss)/d(q k^T). Both
+  backward kernels must use this same definition or dQ vs dK/dV gradients
+  silently diverge.
+  """
+  q = q_ref[0].astype(jnp.float32)                      # [block_q, h]
+  k = k_ref[0].astype(jnp.float32)                      # [block_k, h]
+  v = v_ref[0].astype(jnp.float32)                      # [block_k, h]
+  do = do_ref[0].astype(jnp.float32)                    # [block_q, h]
+  lse = lse_ref[0]                                      # [block_q]
+  delta = delta_ref[0]                                  # [block_q]
+  s = (q @ k.T) * sm_scale
+  if causal:
+    s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
+  p = jnp.exp(s - lse[:, None])                         # [block_q, block_k]
+  dp = do @ v.T                                         # [block_q, block_k]
+  ds = p * (dp - delta[:, None]) * sm_scale
+  return q, k, do, p, ds
+
+
+def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, block_q: int, block_k: int, nk: int, causal: bool,
+               sm_scale: float):
+  """One (batch*head, q_block, k_block) program step."""
+  qi = pl.program_id(1)
+  kb = pl.program_id(2)
+  q_start = qi * block_q
+  k_start = kb * block_k
+
+  @pl.when(kb == 0)
+  def _Init():
+    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+  # A block contributes unless it is entirely in the causal future:
+  # smallest q position is q_start, largest k position is k_start+block_k-1.
+  def _Accumulate():
+    q = q_ref[0].astype(jnp.float32)                    # [block_q, h]
+    k = k_ref[0].astype(jnp.float32)                    # [block_k, h]
+    v = v_ref[0].astype(jnp.float32)                    # [block_k, h]
+    s = (q @ k.T) * sm_scale                            # [block_q, block_k]
     if causal:
-      k_pos = kb * block_k + jax.lax.broadcasted_iota(
-          jnp.int32, (block_q, block_k), 1)
-      s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+      s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
+    m_prev = m_scr[:]
+    l_prev = l_scr[:]
     m_cur = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m_prev, m_cur)
     p = jnp.exp(s - m_new[:, None])
     alpha = jnp.exp(m_prev - m_new)
-    l_new = alpha * l_prev + jnp.sum(p, axis=-1)
-    acc = acc * alpha[:, None] + p @ v
-    return m_new, l_new, acc
+    m_scr[:] = m_new
+    l_scr[:] = alpha * l_prev + jnp.sum(p, axis=-1)
+    acc_scr[:] = acc_scr[:] * alpha[:, None] + p @ v
 
-  h = q.shape[-1]
-  m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-  l0 = jnp.zeros((block_q,), jnp.float32)
-  acc0 = jnp.zeros((block_q, h), jnp.float32)
   if causal:
-    # only key blocks up to (and including) this query block contribute
-    upper = q_blk + 1
+    pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
   else:
-    upper = num_k_blocks
-  m, l, acc = jax.lax.fori_loop(0, upper, _Body, (m0, l0, acc0))
-  out = acc / jnp.maximum(l, 1e-20)[:, None]
-  out_ref[0] = out.astype(out_ref.dtype)
+    _Accumulate()
+
+  if causal:
+    # last contributing k block covers query position q_start + block_q - 1
+    last_kb = jnp.minimum((q_start + block_q - 1) // block_k, nk - 1)
+    is_last = kb == last_kb
+  else:
+    is_last = kb == nk - 1
+
+  @pl.when(is_last)
+  def _Emit():
+    l = l_scr[:]
+    out_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-20)[:, None]).astype(
+        out_ref.dtype)
+    lse_ref[0] = (m_scr[:] + jnp.log(jnp.maximum(l, 1e-20))).astype(
+        lse_ref.dtype)
 
 
 def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
                   interpret: bool):
-  """q/k/v: [bn, t, h] -> [bn, t, h]."""
+  """q/k/v: [bn, t, h] -> (out [bn, t, h], lse [bn, t])."""
   bn, t, h = q.shape
   sm_scale = 1.0 / math.sqrt(h)
-  grid = (bn, t // block_q)
+  nq, nk = t // block_q, t // block_k
   kernel = functools.partial(
-      _FlashFwdKernel, block_k=block_k, causal=causal, sm_scale=sm_scale)
-  return pl.pallas_call(
+      _FwdKernel, block_q=block_q, block_k=block_k, nk=nk, causal=causal,
+      sm_scale=sm_scale)
+  if causal:
+    # clamp the K/V block index so fully-masked grid steps re-request the
+    # previous block — Pallas elides the DMA (no wasted HBM bandwidth).
+    kv_idx = lambda b, i, j: (
+        b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
+  else:
+    kv_idx = lambda b, i, j: (b, j, 0)
+  out, lse = pl.pallas_call(
       kernel,
-      out_shape=jax.ShapeDtypeStruct((bn, t, h), q.dtype),
-      grid=grid,
-      in_specs=[
-          pl.BlockSpec((1, block_q, h), lambda b, i: (b, i, 0)),
-          pl.BlockSpec((1, t, h), lambda b, i: (b, 0, 0)),
-          pl.BlockSpec((1, t, h), lambda b, i: (b, 0, 0)),
+      out_shape=[
+          jax.ShapeDtypeStruct((bn, t, h), q.dtype),
+          jax.ShapeDtypeStruct((bn, t), jnp.float32),
       ],
-      out_specs=pl.BlockSpec((1, block_q, h), lambda b, i: (b, i, 0)),
+      grid=(bn, nq, nk),
+      in_specs=[
+          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+          pl.BlockSpec((1, block_k, h), kv_idx),
+          pl.BlockSpec((1, block_k, h), kv_idx),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_q,), jnp.float32),
+          pltpu.VMEM((block_q,), jnp.float32),
+          pltpu.VMEM((block_q, h), jnp.float32),
+      ],
       interpret=interpret,
   )(q, k, v)
+  return out, lse
 
 
-def _BlockedReferenceAttention(q, k, v, causal: bool, block_q: int):
-  """Blocked attention in plain XLA: scan over q blocks with per-block remat.
+def _DkDvKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, block_q: int, block_k: int,
+                nq: int, causal: bool, sm_scale: float):
+  """One (batch*head, k_block, q_block) step: accumulate dK, dV."""
+  kb = pl.program_id(1)
+  qi = pl.program_id(2)
+  q_start = qi * block_q
+  k_start = kb * block_k
 
-  Backward through this stores only O(T * block_q) residuals (the scan body
-  is jax.checkpoint'ed, so the [block_q, T] probabilities are recomputed in
-  the backward pass) — the memory contract flash attention promises, kept in
-  the vjp too.
-  """
+  @pl.when(qi == 0)
+  def _Init():
+    dk_scr[:] = jnp.zeros_like(dk_scr)
+    dv_scr[:] = jnp.zeros_like(dv_scr)
+
+  def _Accumulate():
+    q, _, do, p, ds = _RecomputePandDs(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start, k_start,
+        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale)
+    dv_scr[:] = dv_scr[:] + p.T @ do
+    dk_scr[:] = dk_scr[:] + ds.T @ q
+
+  if causal:
+    pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
+  else:
+    _Accumulate()
+
+  @pl.when(qi == nq - 1)
+  def _Emit():
+    dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _DqKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+              dq_scr, *, block_q: int, block_k: int, nk: int, causal: bool,
+              sm_scale: float):
+  """One (batch*head, q_block, k_block) step: accumulate dQ."""
+  qi = pl.program_id(1)
+  kb = pl.program_id(2)
+  q_start = qi * block_q
+  k_start = kb * block_k
+
+  @pl.when(kb == 0)
+  def _Init():
+    dq_scr[:] = jnp.zeros_like(dq_scr)
+
+  def _Accumulate():
+    _, k, _, _, ds = _RecomputePandDs(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start, k_start,
+        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale)
+    dq_scr[:] = dq_scr[:] + ds @ k
+
+  if causal:
+    pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
+  else:
+    _Accumulate()
+
+  @pl.when(kb == nk - 1)
+  def _Emit():
+    dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
+                   causal: bool, interpret: bool):
   bn, t, h = q.shape
-  scale = 1.0 / math.sqrt(h)
-  nq = t // block_q
-  q_blocks = q.reshape(bn, nq, block_q, h).swapaxes(0, 1)  # [nq, bn, bq, h]
+  sm_scale = 1.0 / math.sqrt(h)
+  nq, nk = t // block_q, t // block_k
+  delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                  axis=-1)                              # [bn, t]
+  if causal:
+    kv_idx = lambda b, i, j: (
+        b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
+  else:
+    kv_idx = lambda b, i, j: (b, j, 0)
 
-  @jax.checkpoint
-  def _OneBlock(carry, per):
-    qb, idx = per
-    s = jnp.einsum("bqh,bkh->bqk", qb.astype(jnp.float32) * scale,
-                   k.astype(jnp.float32))
-    if causal:
-      q_pos = idx * block_q + jnp.arange(block_q)[:, None]
-      k_pos = jnp.arange(t)[None, :]
-      s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bqk,bkh->bqh", p, v.astype(jnp.float32))
-    return carry, out.astype(q.dtype)
+  if causal:
+    qi_of = lambda j, i: jnp.maximum(i, (j * block_k) // block_q)
+  else:
+    qi_of = lambda j, i: i
+  q_idx = lambda b, j, i: (b, qi_of(j, i), 0)
+  row_idx = lambda b, j, i: (b, qi_of(j, i))
+  dk, dv = pl.pallas_call(
+      functools.partial(
+          _DkDvKernel, block_q=block_q, block_k=block_k, nq=nq,
+          causal=causal, sm_scale=sm_scale),
+      out_shape=[
+          jax.ShapeDtypeStruct((bn, t, h), k.dtype),
+          jax.ShapeDtypeStruct((bn, t, h), v.dtype),
+      ],
+      grid=(bn, nk, nq),
+      in_specs=[
+          pl.BlockSpec((1, block_q, h), q_idx),                      # q
+          pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # k
+          pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # v
+          pl.BlockSpec((1, block_q, h), q_idx),                      # do
+          pl.BlockSpec((1, block_q), row_idx),                       # lse
+          pl.BlockSpec((1, block_q), row_idx),                       # delta
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),
+          pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_k, h), jnp.float32),
+          pltpu.VMEM((block_k, h), jnp.float32),
+      ],
+      interpret=interpret,
+  )(q, k, v, do, lse, delta)
 
-  _, outs = jax.lax.scan(_OneBlock, (), (q_blocks, jnp.arange(nq)))
-  return outs.swapaxes(0, 1).reshape(bn, t, h)
+  dq = pl.pallas_call(
+      functools.partial(
+          _DqKernel, block_q=block_q, block_k=block_k, nk=nk, causal=causal,
+          sm_scale=sm_scale),
+      out_shape=jax.ShapeDtypeStruct((bn, t, h), q.dtype),
+      grid=(bn, nq, nk),
+      in_specs=[
+          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # q
+          pl.BlockSpec((1, block_k, h), kv_idx),                     # k
+          pl.BlockSpec((1, block_k, h), kv_idx),                     # v
+          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # do
+          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),        # lse
+          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),        # delta
+      ],
+      out_specs=pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+      scratch_shapes=[pltpu.VMEM((block_q, h), jnp.float32)],
+      interpret=interpret,
+  )(q, k, v, do, lse, delta)
+  return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _FlashCore(q, k, v, block_q, block_k, causal, interpret):
-  return _FlashForward(q, k, v, block_q, block_k, causal, interpret)
+  out, _ = _FlashForward(q, k, v, block_q, block_k, causal, interpret)
+  return out
 
 
 def _FlashCoreFwd(q, k, v, block_q, block_k, causal, interpret):
-  out = _FlashForward(q, k, v, block_q, block_k, causal, interpret)
-  return out, (q, k, v)
+  out, lse = _FlashForward(q, k, v, block_q, block_k, causal, interpret)
+  return out, (q, k, v, out, lse)
 
 
 def _FlashCoreBwd(block_q, block_k, causal, interpret, res, g):
-  q, k, v = res
-  # recompute-based blockwise backward: O(T * block_q) residual memory (the
-  # scan body is remat'ed); a full Pallas backward kernel is a later
-  # optimization.
-  _, vjp = jax.vjp(
-      lambda q_, k_, v_: _BlockedReferenceAttention(q_, k_, v_, causal,
-                                                    block_q), q, k, v)
-  return vjp(g)
+  q, k, v, out, lse = res
+  return _FlashBackward(q, k, v, out, lse, g, block_q, block_k, causal,
+                        interpret)
 
 
 _FlashCore.defvjp(_FlashCoreFwd, _FlashCoreBwd)

@@ -9,6 +9,7 @@ Builds the .so on first use (g++, ~2s) and caches it next to the sources.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -22,12 +23,26 @@ _LOCK = threading.Lock()
 
 
 def _BuildIfNeeded():
-  srcs = [f for f in os.listdir(_CC_DIR) if f.endswith((".cc", ".h"))]
-  newest_src = max(
-      os.path.getmtime(os.path.join(_CC_DIR, f)) for f in srcs)
-  if (not os.path.exists(_SO_PATH) or
-      os.path.getmtime(_SO_PATH) < newest_src):
-    subprocess.run(["make", "-C", _CC_DIR, "-s"], check=True)
+  # Rebuild when the source *content* changes — mtimes are arbitrary after a
+  # fresh checkout, so a stale .so could otherwise shadow newer sources.
+  srcs = sorted(
+      f for f in os.listdir(_CC_DIR)
+      if f.endswith((".cc", ".h")) or f == "Makefile")
+  digest = hashlib.sha256()
+  for f in srcs:
+    with open(os.path.join(_CC_DIR, f), "rb") as fh:
+      digest.update(f.encode())
+      digest.update(fh.read())
+  stamp = os.path.join(_CC_DIR, ".build_hash")
+  want = digest.hexdigest()
+  have = None
+  if os.path.exists(stamp):
+    with open(stamp) as fh:
+      have = fh.read().strip()
+  if not os.path.exists(_SO_PATH) or have != want:
+    subprocess.run(["make", "-C", _CC_DIR, "-s", "-B"], check=True)
+    with open(stamp, "w") as fh:
+      fh.write(want)
 
 
 def Lib() -> ctypes.CDLL:

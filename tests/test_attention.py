@@ -147,6 +147,48 @@ class TestLocalAndChunkwise:
                                np.asarray(out2[1, :6]), atol=1e-4)
     np.testing.assert_allclose(np.asarray(out1[1, 6:]), 0.0, atol=1e-6)
 
+  def test_local_segment_ids_block_cross_segment_leak(self):
+    # Regression (ADVICE r1): packed segments used to attend across segment
+    # boundaries within a window. Perturbing segment 1 must not change
+    # segment 2's outputs even though they share a window.
+    pl = attention.LocalSelfAttention.Params().Set(
+        name="local", input_dim=D, hidden_dim=D, num_heads=N,
+        block_size=4, left_context=4, right_context=0)
+    local = pl.Instantiate()
+    theta = local.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (1, T, D))
+    seg = jnp.concatenate(
+        [jnp.full((1, 6), 1, jnp.int32), jnp.full((1, T - 6), 2, jnp.int32)],
+        axis=1)
+    out1, _ = local.FProp(theta, x, segment_ids=seg)
+    x2 = x.at[:, 5].set(77.0)  # last position of segment 1
+    out2, _ = local.FProp(theta, x2, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out1[:, 6:]),
+                               np.asarray(out2[:, 6:]), atol=1e-4)
+    # within segment 1 the perturbation must still propagate
+    assert not np.allclose(out1[:, 5], out2[:, 5], atol=1e-4)
+    # dense atten_mask is not representable in the windowed layout
+    with pytest.raises(NotImplementedError):
+      local.FProp(theta, x, atten_mask=attention.CausalMask(T))
+
+  def test_chunkwise_segment_ids_block_cross_segment_leak(self):
+    pc = attention.ChunkwiseSelfAttention.Params().Set(
+        name="chunk", input_dim=D, hidden_dim=D, num_heads=N, chunk_size=4,
+        causal=False)
+    chunk = pc.Instantiate()
+    theta = chunk.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (1, 8, D))
+    seg = jnp.array([[1, 1, 2, 2, 2, 2, 3, 3]], jnp.int32)
+    out1, _ = chunk.FProp(theta, x, segment_ids=seg)
+    x2 = x.at[:, 1].set(77.0)  # segment 1, chunk 0
+    out2, _ = chunk.FProp(theta, x2, segment_ids=seg)
+    # segment 2 positions in the same chunk (2, 3) must be unaffected
+    np.testing.assert_allclose(np.asarray(out1[:, 2:4]),
+                               np.asarray(out2[:, 2:4]), atol=1e-4)
+    assert not np.allclose(out1[:, 0], out2[:, 0], atol=1e-4)
+    with pytest.raises(NotImplementedError):
+      chunk.FProp(theta, x, atten_mask=attention.CausalMask(8))
+
   def test_chunkwise_no_cross_chunk(self):
     pc = attention.ChunkwiseSelfAttention.Params().Set(
         name="chunk", input_dim=D, hidden_dim=D, num_heads=N, chunk_size=4)

@@ -36,6 +36,41 @@ class TestFlashAttention:
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(_ref(q, k, v, causal)), atol=2e-5)
 
+  @pytest.mark.parametrize("block_q,block_k", [(32, 16), (16, 32)])
+  def test_causal_mismatched_blocks(self, block_q, block_k):
+    # Regression (ADVICE r1): block_q > block_k causal used to skip valid
+    # past key blocks (max abs err ~0.99); both orderings must be exact.
+    b, t, n, h = 2, 64, 1, 16
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(11), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(12), (b, t, n, h))
+    out = flash_attention.FlashAttention(
+        q, k, v, causal=True, block_q=block_q, block_k=block_k,
+        interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(q, k, v, True)), atol=2e-5)
+
+  @pytest.mark.parametrize("causal", [True, False])
+  @pytest.mark.parametrize("block_q,block_k", [(32, 16), (16, 32)])
+  def test_gradients_mismatched_blocks(self, causal, block_q, block_k):
+    b, t, n, h = 1, 64, 1, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h))
+
+    def loss_flash(q, k, v):
+      return jnp.sum(jnp.square(flash_attention.FlashAttention(
+          q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+          interpret=True)))
+
+    def loss_ref(q, k, v):
+      return jnp.sum(jnp.square(_ref(q, k, v, causal)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
   def test_blocks_do_not_change_result(self):
     b, t, n, h = 1, 64, 1, 8
     q = jax.random.normal(KEY, (b, t, n, h))
