@@ -6,15 +6,20 @@ Prints ONE JSON line:
 The flagship path: bf16 TransformerLm (scan-over-layers) full train step
 (fwd+bwd+Adafactor) on synthetic packed input. MFU = model FLOPs / (step
 time * peak FLOPs). Baseline target: 45% MFU (BASELINE.md north star).
+Secondary numbers in "detail": flash-attention vs naive step time (proves
+the Pallas kernel runs on hardware) and a 64-expert MoE step.
 
-Model size auto-scales with the platform: a ~350M-param LM on TPU, a tiny
-one on CPU (so the script always completes).
+Hardened against TPU-backend flakiness (the round-1 failure mode): the TPU
+is probed in a subprocess with a timeout, `jax.devices()` is retried with
+exponential backoff on Unavailable, CPU is the fallback backend, and a valid
+JSON line is emitted even on partial failure.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -39,10 +44,166 @@ def _PeakFlops(device) -> float:
   return float(os.environ.get("BENCH_PEAK_FLOPS", 2e11))  # cpu-ish
 
 
+def _ProbeTpu(timeout_s: float) -> str:
+  """Probe (in a throwaway subprocess) which backend comes up.
+
+  Returns "tpu", "cpu" (definitive: this machine resolves to CPU — don't
+  retry), or "error" (transient init failure/timeout — retry). The axon PJRT
+  plugin can block for minutes inside backend init when its tunnel is down —
+  a subprocess + kill is the only reliable timeout.
+  """
+  code = "import jax; d = jax.devices(); print(d[0].platform)"
+  try:
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout_s)
+  except subprocess.TimeoutExpired:
+    return "error"
+  if proc.returncode != 0:
+    return "error"
+  return "cpu" if "cpu" in proc.stdout else "tpu"
+
+
+def _ForceCpu():
+  """Make this process CPU-only even if a TPU plugin already registered.
+
+  Env vars alone are not enough: a sitecustomize may have imported jax and
+  registered a tunneled PJRT plugin at interpreter start. Same recipe as
+  tests/conftest.py: re-point the config at cpu and strip non-cpu backend
+  factories (importing chex/pallas first — they register 'tpu' lowering
+  rules and fail if the platform is already gone).
+  """
+  os.environ["JAX_PLATFORMS"] = "cpu"
+  os.environ.pop("PYTHONPATH", None)
+  try:
+    import jax
+    try:
+      import chex  # noqa: F401
+    except ImportError:
+      pass
+    try:
+      import jax.experimental.pallas  # noqa: F401
+      import jax.experimental.pallas.tpu  # noqa: F401
+    except ImportError:
+      pass
+    from jax._src import xla_bridge
+    jax.config.update("jax_platforms", "cpu")
+    for name in list(getattr(xla_bridge, "_backend_factories", {})):
+      if name not in ("cpu", "interpreter"):
+        xla_bridge._backend_factories.pop(name, None)
+  except Exception as e:  # noqa: BLE001
+    print(f"bench: cpu fallback setup issue: {e}", file=sys.stderr)
+
+
+def _EnsureBackend():
+  """Pick TPU if reachable (with retries), else CPU. Must run pre-`import jax`."""
+  if os.environ.get("BENCH_FORCE_CPU"):
+    _ForceCpu()
+    return
+  # Retry-with-backoff around TPU probe (ref base_runner.py:399-528 retry
+  # taxonomy: Unavailable during TPU init is transient).
+  delays = [0, 5, 15, 30, 60]
+  for i, delay in enumerate(delays):
+    if delay:
+      time.sleep(delay)
+    status = _ProbeTpu(timeout_s=90)
+    if status == "tpu":
+      return  # leave env alone: real backend resolves to the TPU plugin
+    if status == "cpu":
+      break  # definitive: no TPU plugin on this machine — don't retry
+    print(f"bench: TPU probe {i + 1}/{len(delays)} failed", file=sys.stderr)
+  print("bench: no TPU available, using CPU", file=sys.stderr)
+  _ForceCpu()
+
+
+def _BenchFlashAttention(jax, jnp, on_tpu):
+  """Flash Pallas kernel vs naive einsum attention: fwd+bwd step time."""
+  from lingvo_tpu.ops import flash_attention
+  b, t, n, h = (4, 2048, 8, 128) if on_tpu else (1, 256, 2, 32)
+  q = jax.random.normal(jax.random.PRNGKey(0), (b, t, n, h), jnp.bfloat16)
+  k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h), jnp.bfloat16)
+  v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h), jnp.bfloat16)
+
+  def flash_loss(q, k, v):
+    return jnp.sum(flash_attention.FlashAttention(
+        q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+  def naive_loss(q, k, v):
+    s = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32)
+    s = s / (h ** 0.5)
+    mask = jnp.tril(jnp.ones((t, t), jnp.bool_))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.sum(jnp.einsum("bnqk,bknh->bqnh", p, v).astype(
+        jnp.float32) ** 2)
+
+  def timed(fn):
+    g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+    out = g(q, k, v)
+    jax.block_until_ready(out)
+    reps = 10 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(reps):
+      out = g(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+  flash_t = timed(flash_loss)
+  naive_t = timed(naive_loss)
+  return {
+      "flash_ms": round(flash_t * 1e3, 3),
+      "naive_ms": round(naive_t * 1e3, 3),
+      "flash_speedup": round(naive_t / flash_t, 3),
+      "shape_btnh": [b, t, n, h],
+  }
+
+
+def _BenchMoE(jax, jnp, model_registry, on_tpu):
+  """64-expert MoE LM single-chip train step (VERDICT r1 item 1)."""
+  mp = model_registry.GetParams("lm.synthetic_packed_input.MoELmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  if on_tpu:
+    mp.task.model_dim = 512
+    mp.task.hidden_dim = 2048
+    mp.task.num_heads = 8
+    mp.task.num_layers = 6
+    mp.task.num_experts = 64
+    mp.task.vocab_size = 32768
+    mp.task.input.vocab_size = 32768
+    mp.task.input.seq_len = 1024
+    mp.task.input.batch_size = 8
+  else:
+    mp.task.num_experts = 8
+    mp.task.input.seq_len = 32
+    mp.task.input.batch_size = 2
+  mp.task.fprop_dtype = jnp.bfloat16
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  state = task.CreateTrainState(jax.random.PRNGKey(0))
+  gen = mp.input.Instantiate()
+  batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+  step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
+  state, _ = step_fn(state, batch)
+  jax.block_until_ready(jax.tree_util.tree_leaves(state.theta)[0])
+  reps = 10 if on_tpu else 2
+  t0 = time.perf_counter()
+  for _ in range(reps):
+    state, _ = step_fn(state, batch)
+  jax.block_until_ready(jax.tree_util.tree_leaves(state.theta)[0])
+  step = (time.perf_counter() - t0) / reps
+  ntok = mp.task.input.batch_size * mp.task.input.seq_len
+  return {
+      "num_experts": mp.task.num_experts,
+      "step_time_ms": round(step * 1e3, 2),
+      "tokens_per_sec": round(ntok / step, 1),
+  }
+
+
 def main():
+  _EnsureBackend()
   import jax
   import jax.numpy as jnp
-  import numpy as np
   from lingvo_tpu import model_registry
   import lingvo_tpu.models.all_params  # noqa: F401
 
@@ -105,23 +266,47 @@ def main():
   tokens_per_sec = tokens / step_time
   loss = float(out.metrics.loss[0])
 
+  detail = {
+      "device": str(getattr(dev, "device_kind", dev.platform)),
+      "params_m": round(n_params / 1e6, 1),
+      "step_time_s": round(step_time, 4),
+      "tokens_per_sec": round(tokens_per_sec, 1),
+      "flops_per_step_g": round(flops_per_step / 1e9, 1),
+      "peak_tflops": peak / 1e12,
+      "loss": round(loss, 3),
+  }
+  # Secondary benches: never let them kill the primary number.
+  try:
+    detail["flash_attention"] = _BenchFlashAttention(jax, jnp, on_tpu)
+  except Exception as e:  # noqa: BLE001
+    detail["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+  try:
+    detail["moe"] = _BenchMoE(jax, jnp, model_registry, on_tpu)
+  except Exception as e:  # noqa: BLE001
+    detail["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
   result = {
       "metric": "dense_lm_train_mfu",
       "value": round(mfu, 4),
       "unit": "mfu_fraction",
       "vs_baseline": round(mfu / 0.45, 4),
-      "detail": {
-          "device": str(getattr(dev, "device_kind", dev.platform)),
-          "params_m": round(n_params / 1e6, 1),
-          "step_time_s": round(step_time, 4),
-          "tokens_per_sec": round(tokens_per_sec, 1),
-          "flops_per_step_g": round(flops_per_step / 1e9, 1),
-          "peak_tflops": peak / 1e12,
-          "loss": round(loss, 3),
-      },
+      "detail": detail,
   }
   print(json.dumps(result))
 
 
 if __name__ == "__main__":
-  main()
+  try:
+    main()
+  except Exception as e:  # noqa: BLE001
+    # Partial-result contract: always emit one valid JSON line (rc=0) so the
+    # driver records *something* instead of a traceback (round-1 failure).
+    import traceback
+    traceback.print_exc()
+    print(json.dumps({
+        "metric": "dense_lm_train_mfu",
+        "value": 0.0,
+        "unit": "mfu_fraction",
+        "vs_baseline": 0.0,
+        "detail": {"error": f"{type(e).__name__}: {e}"[:500]},
+    }))

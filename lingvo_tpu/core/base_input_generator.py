@@ -64,6 +64,207 @@ class BaseInputGenerator(base_layer.BaseLayer):
     self._epoch = 0
 
 
+class BaseSequenceInputGenerator(BaseInputGenerator):
+  """Adds tokenization + length-bucketing config (ref
+  `base_input_generator.py:1457` BaseSequenceInputGenerator)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("tokenizer", None, "Tokenizer Params (core.tokenizers).")
+    p.Define("bucket_upper_bound", [], "Bucket length bounds, ascending.")
+    p.Define("bucket_batch_limit", [],
+             "Per-bucket batch sizes (same arity as bucket_upper_bound).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._tokenizer = (self.p.tokenizer.Instantiate()
+                       if self.p.tokenizer is not None else None)
+
+  @property
+  def tokenizer(self):
+    assert self._tokenizer is not None, "p.tokenizer not set"
+    return self._tokenizer
+
+  def StringsToIds(self, texts, max_length: int):
+    """(ids sos-prefixed, labels eos-suffixed, paddings) — ref
+    `base_input_generator.py:1565`."""
+    return self.tokenizer.StringsToIds(texts, max_length)
+
+  def IdsToStrings(self, ids, lens=None):
+    return self.tokenizer.IdsToStrings(ids, lens)
+
+  def infeed_bucket_batch_limit(self):
+    return list(self.p.bucket_batch_limit)
+
+
+class FileBasedSequenceInputGenerator(BaseSequenceInputGenerator):
+  """Real-data path: C++ record yielder -> per-record processor ->
+  length-bucketed batches, prefetched on a host thread.
+
+  The TPU-native re-design of `BaseInputGeneratorFromFiles`
+  (`base_input_generator.py:1216-1456`) + `record_batcher.cc`: records come
+  from the native yielder (sharded glob, shuffle ring, per-host sharding via
+  num_hosts/host_index), `ProcessRecord` (subclass point, ≙ the GenericInput
+  user processor) maps bytes -> example NestedMap with a scalar
+  `bucket_key`, and batches are assembled per length bucket. Batches are
+  padded to [bucket_batch_limit, bound] so every bucket is one static XLA
+  shape; a `weights`-aware consumer sees padded rows as weight 0.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("file_pattern", "", "'type:glob' pattern or list of patterns.")
+    p.Define("file_pattern_weights", None, "Mix weights for pattern lists.")
+    p.Define("shuffle", True, "Shuffle records.")
+    p.Define("shuffle_buffer_size", 10000, "Shuffle ring size.")
+    p.Define("num_reader_threads", 2, "C++ reader threads.")
+    p.Define("max_epochs", 0, "0 = repeat forever.")
+    p.Define("seed", 301, "Yielder seed.")
+    p.Define("prefetch_buffer_size", 4, "Host-side prefetched batches.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._batch_iter = None
+    self._prefetcher = None
+
+  # -- subclass point --------------------------------------------------------
+  def ProcessRecord(self, record: bytes):
+    """bytes -> example NestedMap with scalar `bucket_key`, or None to drop."""
+    raise NotImplementedError
+
+  # --------------------------------------------------------------------------
+  def _MakeSource(self):
+    from lingvo_tpu.core import datasource
+    p = self.p
+    ds = datasource.SimpleDataSource.Params().Set(
+        file_pattern=p.file_pattern,
+        weights=p.file_pattern_weights,
+        shuffle_buffer_size=p.shuffle_buffer_size,
+        num_threads=p.num_reader_threads,
+        max_epochs=p.max_epochs,
+        shuffle=p.shuffle and not p.require_sequential_order,
+        seed=p.seed,
+        shard_index=p.host_index,
+        num_shards=p.num_hosts)
+    return ds.Instantiate()
+
+  def _Batches(self):
+    from lingvo_tpu.core import datasource
+    p = self.p
+    batcher = datasource.SequenceBatcher(
+        self._MakeSource(), self.ProcessRecord,
+        bucket_upper_bound=p.bucket_upper_bound,
+        bucket_batch_limit=p.bucket_batch_limit)
+    for batch, limit in ((b, self._LimitFor(b)) for b in batcher):
+      yield self._PadBatchDim(batch, limit)
+
+  def _LimitFor(self, batch: NestedMap) -> int:
+    # bucket identified by the (padded) time dim of `ids`
+    t = batch.Flatten()[0].shape[1] if batch.Flatten() else 0
+    p = self.p
+    for bound, limit in zip(p.bucket_upper_bound, p.bucket_batch_limit):
+      if t <= bound:
+        return limit
+    return p.bucket_batch_limit[-1]
+
+  def _PadBatchDim(self, batch: NestedMap, limit: int) -> NestedMap:
+    b = batch.Flatten()[0].shape[0]
+    if b >= limit:
+      return batch
+
+    def _Pad(a):
+      pad = [(0, limit - b)] + [(0, 0)] * (a.ndim - 1)
+      return np.pad(a, pad, constant_values=0)
+
+    out = batch.Transform(_Pad)
+    # padded rows are all-padding: paddings=1, weights=0
+    for key, val in out.FlattenItems():
+      leaf = key.split(".")[-1]
+      if leaf == "paddings":
+        val[b:] = 1.0
+      elif leaf == "weights":
+        val[b:] = 0.0
+    return out
+
+  def _InputBatch(self) -> NestedMap:
+    if self._prefetcher is None:
+      self._prefetcher = _Prefetcher(self._Batches(),
+                                     self.p.prefetch_buffer_size)
+    batch = self._prefetcher.Next()
+    if batch is None:
+      raise StopIteration
+    return batch
+
+  def Reset(self):
+    super().Reset()
+    if self._prefetcher is not None:
+      self._prefetcher.Stop()
+      self._prefetcher = None
+
+
+class _Prefetcher:
+  """Background thread filling a bounded batch queue (host/device overlap)."""
+
+  def __init__(self, it, capacity: int):
+    import queue
+    import threading
+    self._queue: "queue.Queue" = queue.Queue(maxsize=max(capacity, 1))
+    self._stop = threading.Event()
+    self._done = False  # latched end-of-stream: Next() must never block on
+                        # an exhausted stream (a second eval cycle would
+                        # deadlock waiting on the dead filler thread)
+    self._error = None  # producer exception, re-raised at the consumer —
+                        # a dead filler must not masquerade as end-of-data
+    self._thread = threading.Thread(target=self._Fill, args=(it,),
+                                    daemon=True)
+    self._thread.start()
+
+  def _Fill(self, it):
+    try:
+      for batch in it:
+        while not self._stop.is_set():
+          try:
+            self._queue.put(batch, timeout=0.2)
+            break
+          except Exception:
+            continue
+        if self._stop.is_set():
+          return
+    except BaseException as e:  # noqa: BLE001
+      self._error = e
+    finally:
+      while not self._stop.is_set():
+        try:
+          self._queue.put(None, timeout=0.2)  # end-of-stream sentinel
+          return
+        except Exception:
+          continue
+
+  def Next(self):
+    if self._done:
+      if self._error is not None:
+        raise self._error
+      return None
+    batch = self._queue.get()
+    if batch is None:
+      self._done = True
+      if self._error is not None:
+        raise self._error
+    return batch
+
+  def Stop(self):
+    self._stop.set()
+    try:
+      while True:
+        self._queue.get_nowait()
+    except Exception:
+      pass
+
+
 class SyntheticInputGenerator(BaseInputGenerator):
   """Deterministic synthetic batches from a spec (testing/benchmarks).
 

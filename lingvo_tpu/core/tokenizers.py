@@ -1,0 +1,187 @@
+"""Tokenizer layer: Params-configured wrappers over the native tokenizers.
+
+Re-designs the reference's tokenizer surface (`lingvo/core/tokenizers.py`
+AsciiTokenizer/VocabFileTokenizer/BpeTokenizer, `wpm_encoder.py` WpmTokenizer,
+backed by the C++ kernels in `ops/tokenizer_ops_kernels.cc`): a tokenizer is
+an instantiable Params object exposing
+
+  StringsToIds(strs, max_length) -> (ids, labels, paddings)
+
+where `ids` is sos-prefixed and `labels` eos-suffixed (teacher forcing
+layout, ref `tokenizers.py` StringsToIds contract), plus
+`IdsToStrings(ids, lens)`. The heavy lifting runs in the C++ library
+(`ops/cc/tokenizer.cc`, `ops/cc/subword.cc`) via ctypes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import hyperparams
+
+
+class BaseTokenizer:
+  """Base: sos/eos framing around a raw text->ids encoder."""
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", "tokenizer", "Name.")
+    p.Define("vocab_size", 0, "Vocabulary size (0 = from vocab file).")
+    p.Define("target_sos_id", 1, "Id prepended to ids.")
+    p.Define("target_eos_id", 2, "Id appended to labels.")
+    p.Define("target_unk_id", 0, "OOV id.")
+    p.Define("append_eos", True, "Whether labels end with eos.")
+    return p
+
+  def __init__(self, params):
+    self.p = params.Copy()
+
+  # -- subclass points -------------------------------------------------------
+  def _EncodeRaw(self, texts, max_len):
+    """-> (ids [b, max_len] int32, lens [b] int32), no sos/eos."""
+    raise NotImplementedError
+
+  def _DecodeRaw(self, ids, lens):
+    raise NotImplementedError
+
+  # -- public API ------------------------------------------------------------
+  def StringsToIds(self, texts, max_length: int):
+    """Teacher-forcing layout: ids=[sos, w...], labels=[w..., eos].
+
+    Returns (ids, labels, paddings), all [b, max_length]; paddings marks
+    positions past each sequence's eos.
+    """
+    p = self.p
+    raw, lens = self._EncodeRaw(texts, max_length - 1)
+    b = len(texts)
+    ids = np.zeros((b, max_length), np.int32)
+    labels = np.zeros((b, max_length), np.int32)
+    paddings = np.ones((b, max_length), np.float32)
+    for i in range(b):
+      n = int(lens[i])
+      ids[i, 0] = p.target_sos_id
+      ids[i, 1:n + 1] = raw[i, :n]
+      labels[i, :n] = raw[i, :n]
+      if p.append_eos:
+        labels[i, n] = p.target_eos_id
+        paddings[i, :n + 1] = 0.0
+      else:
+        paddings[i, :n] = 0.0
+    return ids, labels, paddings
+
+  def IdsToStrings(self, ids, lens=None):
+    ids = np.asarray(ids)
+    if lens is None:
+      lens = np.full((len(ids),), ids.shape[1], np.int32)
+    # strip framing ids before decode
+    p = self.p
+    cleaned, clens = [], []
+    for i in range(len(ids)):
+      row = [t for t in ids[i, :int(lens[i])]
+             if t not in (p.target_sos_id, p.target_eos_id)]
+      cleaned.append(row)
+      clens.append(len(row))
+    width = max(clens) if clens else 1
+    arr = np.zeros((len(ids), max(width, 1)), np.int32)
+    for i, row in enumerate(cleaned):
+      arr[i, :len(row)] = row
+    return self._DecodeRaw(arr, np.asarray(clens, np.int32))
+
+  @property
+  def vocab_size(self) -> int:
+    return self.p.vocab_size
+
+
+def _LensFromPaddings(paddings):
+  return (1.0 - paddings).sum(axis=-1).astype(np.int32)
+
+
+class AsciiTokenizer(BaseTokenizer):
+  """Char-level (ref `ascii_tokenizer.cc` id space; sos=0 eos=1 unk=73)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.vocab_size = 76
+    p.target_sos_id = 0
+    p.target_eos_id = 1
+    p.target_unk_id = 73
+    return p
+
+  def _EncodeRaw(self, texts, max_len):
+    from lingvo_tpu.ops import native
+    ids, paddings = native.AsciiTokenizer().StringsToIds(
+        texts, max_len, append_eos=False)
+    return ids, _LensFromPaddings(paddings)
+
+  def _DecodeRaw(self, ids, lens):
+    from lingvo_tpu.ops import native
+    return native.AsciiTokenizer().IdsToStrings(ids, lens)
+
+
+class _FileBackedTokenizer(BaseTokenizer):
+  """Shared lazy-load plumbing for vocab-file tokenizers."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_filepath", "", "Vocab file (one token per line).")
+    p.Define("unk_token", "<unk>", "OOV token string.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._impl = None
+
+  def _Load(self):
+    raise NotImplementedError
+
+  @property
+  def impl(self):
+    if self._impl is None:
+      self._impl = self._Load()
+    return self._impl
+
+  @property
+  def vocab_size(self) -> int:
+    return self.p.vocab_size or self.impl.vocab_size
+
+  def _EncodeRaw(self, texts, max_len):
+    ids, paddings = self.impl.StringsToIds(texts, max_len)
+    return ids, _LensFromPaddings(paddings)
+
+  def _DecodeRaw(self, ids, lens):
+    return self.impl.IdsToStrings(ids, lens)
+
+
+class VocabFileTokenizer(_FileBackedTokenizer):
+  """Whole-word vocab lookup (ref `simple_vocab.cc` semantics)."""
+
+  def _Load(self):
+    from lingvo_tpu.ops import native
+    return native.VocabTokenizer(self.p.vocab_filepath, self.p.unk_token)
+
+
+class WpmTokenizer(_FileBackedTokenizer):
+  """Greedy longest-match wordpiece (ref `wpm_encoder.py`); auto-detects
+  sentencepiece ▁ or BERT ## marker convention from the vocab file."""
+
+  def _Load(self):
+    from lingvo_tpu.ops import native
+    return native.WpmTokenizer(self.p.vocab_filepath, self.p.unk_token)
+
+
+class BpeTokenizer(_FileBackedTokenizer):
+  """Merge-ops BPE (ref `BpeWordsToIds` kernel: codes + vocab files)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("codes_filepath", "", "BPE merge-operations file.")
+    return p
+
+  def _Load(self):
+    from lingvo_tpu.ops import native
+    return native.BpeTokenizer(self.p.codes_filepath, self.p.vocab_filepath,
+                               self.p.unk_token)

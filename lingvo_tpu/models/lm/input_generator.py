@@ -14,6 +14,87 @@ from lingvo_tpu.core import base_input_generator
 from lingvo_tpu.core.nested_map import NestedMap
 
 
+class TextLmInput(base_input_generator.FileBasedSequenceInputGenerator):
+  """Real-data LM input: text lines -> tokenized (optionally packed) batches.
+
+  The file-backed counterpart of the reference's 1B-words input
+  (`tasks/lm/input_generator.py` LmInput over `text:` files +
+  `pack_ops.cc` packing): each record is one sentence; with packing on,
+  multiple sentences share a row with segment_ids/segment_pos (the GShard
+  LM format), assigned by the native best-fit `PackSequences`.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("seq_len", 512, "Tokens per row.")
+    p.Define("packing", True, "Pack several sentences per row.")
+    p.bucket_upper_bound = [512]
+    p.bucket_batch_limit = [16]
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    if not p.bucket_upper_bound or p.bucket_upper_bound[-1] != p.seq_len:
+      p.bucket_upper_bound = [p.seq_len]
+      p.bucket_batch_limit = p.bucket_batch_limit[-1:] or [16]
+
+  def ProcessRecord(self, record: bytes):
+    text = record.decode("utf-8", errors="replace").strip()
+    if not text:
+      return None
+    ids, labels, paddings = self.StringsToIds([text], self.p.seq_len)
+    n = int((1.0 - paddings[0]).sum())
+    if n <= 1:
+      return None
+    return NestedMap(
+        ids=ids[0], labels=labels[0], paddings=paddings[0],
+        weights=(1.0 - paddings[0]).astype(np.float32),
+        bucket_key=n)
+
+  # -- packed path -----------------------------------------------------------
+  def _Batches(self):
+    if not self.p.packing:
+      yield from super()._Batches()
+      return
+    from lingvo_tpu.ops import native
+    p = self.p
+    rows = p.bucket_batch_limit[-1]
+    t = p.seq_len
+    pending: list[NestedMap] = []
+    source = iter(self._MakeSource())
+    while True:
+      # keep a pool ~2 batches deep so best-fit packing has choices
+      while len(pending) < rows * 8:
+        rec = next(source, None)
+        if rec is None:
+          break
+        ex = self.ProcessRecord(rec)
+        if ex is not None:
+          pending.append(ex)
+      if not pending:
+        return
+      lens = np.asarray([ex.bucket_key for ex in pending], np.int32)
+      row, off = native.PackSequences(lens, rows, t)
+      ids, seg_ids, seg_pos, extras, used = native.ApplyPacking(
+          [ex.ids[:int(ex.bucket_key)] for ex in pending], row, off, rows, t,
+          extra_payloads={
+              "labels": [ex.labels[:int(ex.bucket_key)] for ex in pending]},
+          return_used=True)
+      labels = extras["labels"]
+      if not used:
+        # nothing fit (all sequences longer than t): drop the pool head
+        pending = pending[rows:]
+        continue
+      paddings = (seg_ids == 0).astype(np.float32)
+      yield NestedMap(ids=ids, labels=labels, paddings=paddings,
+                      segment_ids=seg_ids, segment_pos=seg_pos,
+                      weights=(1.0 - paddings).astype(np.float32))
+      used_set = set(used)
+      pending = [ex for i, ex in enumerate(pending) if i not in used_set]
+
+
 class SyntheticLmInput(base_input_generator.BaseInputGenerator):
   """Deterministic synthetic LM batches.
 

@@ -10,13 +10,18 @@ vocab tokenizer in ops/ already handle that format:
 
 from __future__ import annotations
 
+import os
+
 from lingvo_tpu import model_registry
 from lingvo_tpu.core import base_model_params
 from lingvo_tpu.core import learner as learner_lib
 from lingvo_tpu.core import optimizer as opt_lib
 from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.core import tokenizers
 from lingvo_tpu.models.lm import input_generator
 from lingvo_tpu.models.lm import layers as lm_layers
+
+DATA_DIR = os.environ.get("LINGVO_TPU_DATA_DIR", "/tmp/lingvo_tpu_data")
 
 
 @model_registry.RegisterSingleTaskModel
@@ -59,3 +64,34 @@ class OneBWdsTransformerLm(base_model_params.SingleTaskModelParams):
         clip_gradient_norm_to_value=1.0)
     p.train.tpu_steps_per_loop = 100
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class OneBWdsRealData(OneBWdsTransformerLm):
+  """1B-words on real shards through the native pipeline: C++ record yielder
+  over `text:` shards -> WPM tokenizer -> packed rows (ref
+  `tasks/lm/params/one_billion_wds.py` dataset layout; set
+  LINGVO_TPU_DATA_DIR to the corpus root with
+  `1bwds/training-monolingual.tokenized.shuffled/news.en-*` shards and a
+  `1bwds/vocab.wpm.txt` wordpiece vocab)."""
+
+  def _Input(self, pattern: str, seed: int):
+    return input_generator.TextLmInput.Params().Set(
+        file_pattern=f"text:{DATA_DIR}/1bwds/{pattern}",
+        tokenizer=tokenizers.WpmTokenizer.Params().Set(
+            vocab_filepath=f"{DATA_DIR}/1bwds/vocab.wpm.txt",
+            vocab_size=self.VOCAB),
+        seq_len=self.SEQ,
+        bucket_upper_bound=[self.SEQ],
+        bucket_batch_limit=[self.BATCH],
+        packing=True,
+        seed=seed)
+
+  def Train(self):
+    return self._Input("training-monolingual.tokenized.shuffled/news.en-*",
+                       seed=301)
+
+  def Test(self):
+    p = self._Input("heldout-monolingual.tokenized.shuffled/news.en.heldout-*",
+                    seed=7)
+    return p.Set(shuffle=False, max_epochs=1, require_sequential_order=True)

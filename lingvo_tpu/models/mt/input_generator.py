@@ -14,6 +14,49 @@ from lingvo_tpu.core import base_input_generator
 from lingvo_tpu.core.nested_map import NestedMap
 
 
+class TextMtInput(base_input_generator.FileBasedSequenceInputGenerator):
+  """Real-data MT input: tab-separated "source<TAB>target" lines ->
+  length-bucketed src/tgt batches (ref `tasks/mt/input_generator.py`
+  NmtInput over `text_input.proto` records, bucketed by max side length).
+
+  Source ids are eos-terminated (no sos); target follows the teacher-forcing
+  layout (ids sos-prefixed, labels eos-suffixed).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("source_max_length", 64, "Max source tokens (incl eos).")
+    p.Define("target_max_length", 64, "Max target tokens (incl sos/eos).")
+    p.bucket_upper_bound = [16, 32, 64]
+    p.bucket_batch_limit = [32, 16, 8]
+    return p
+
+  def ProcessRecord(self, record: bytes):
+    p = self.p
+    text = record.decode("utf-8", errors="replace").strip()
+    if "\t" not in text:
+      return None
+    src_text, tgt_text = text.split("\t", 1)
+    # source: [w..., eos] = the labels row of the tokenizer layout
+    _, src_ids, src_pad = self.StringsToIds([src_text], p.source_max_length)
+    tgt_ids, tgt_labels, tgt_pad = self.StringsToIds([tgt_text],
+                                                     p.target_max_length)
+    src_len = int((1.0 - src_pad[0]).sum())
+    tgt_len = int((1.0 - tgt_pad[0]).sum())
+    if src_len <= 1 or tgt_len <= 1:
+      return None
+    bound = max(src_len, tgt_len)
+    return NestedMap(
+        src=NestedMap(ids=src_ids[0][:src_len],
+                      paddings=src_pad[0][:src_len]),
+        tgt=NestedMap(ids=tgt_ids[0][:tgt_len],
+                      labels=tgt_labels[0][:tgt_len],
+                      paddings=tgt_pad[0][:tgt_len],
+                      weights=np.ones(tgt_len, np.float32)),
+        bucket_key=bound)
+
+
 class SyntheticMtInput(base_input_generator.BaseInputGenerator):
 
   @classmethod

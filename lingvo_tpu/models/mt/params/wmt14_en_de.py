@@ -89,3 +89,35 @@ class WmtEnDeTransformerTiny(WmtEnDeTransformerBase):
     p.train.learner.lr_schedule = sched_lib.Constant.Params()
     p.train.tpu_steps_per_loop = 20
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeTransformerBpe(WmtEnDeTransformerBase):
+  """Real-data WMT'14 through the native pipeline: tab-separated
+  "en<TAB>de" text shards -> shared BPE (ref `wmt14_en_de.py` wordpiece
+  datasets + `BpeWordsToIds` kernels; set LINGVO_TPU_DATA_DIR to a root
+  with `wmt14/train.en-de.tsv*`, `wmt14/bpe.codes` and `wmt14/bpe.vocab`)."""
+
+  def _Input(self, pattern: str, seed: int):
+    import os
+    from lingvo_tpu.core import tokenizers
+    data_dir = os.environ.get("LINGVO_TPU_DATA_DIR", "/tmp/lingvo_tpu_data")
+    return input_generator.TextMtInput.Params().Set(
+        file_pattern=f"text:{data_dir}/wmt14/{pattern}",
+        tokenizer=tokenizers.BpeTokenizer.Params().Set(
+            codes_filepath=f"{data_dir}/wmt14/bpe.codes",
+            vocab_filepath=f"{data_dir}/wmt14/bpe.vocab",
+            vocab_size=self.VOCAB),
+        source_max_length=self.SRC_LEN,
+        target_max_length=self.TGT_LEN,
+        bucket_upper_bound=[24, 48, 96],
+        bucket_batch_limit=[4 * self.BATCH_SIZE, 2 * self.BATCH_SIZE,
+                            self.BATCH_SIZE],
+        seed=seed)
+
+  def Train(self):
+    return self._Input("train.en-de.tsv*", seed=301)
+
+  def Test(self):
+    p = self._Input("newstest2014.en-de.tsv", seed=7)
+    return p.Set(shuffle=False, max_epochs=1, require_sequential_order=True)

@@ -1,0 +1,290 @@
+// Subword tokenizers: WPM (wordpiece) and BPE (merge-ops).
+//
+// Re-implements the semantics of the reference's subword tokenization
+// (lingvo/core/wpm_encoder.py greedy wordpiece; BpeWordsToIds /
+// BpeIdsToWords C++ kernels registered in x_ops.cc:613-860 which consume a
+// merge-codes file + a subword-vocab file) as a from-scratch C++ library
+// with a C ABI for ctypes.
+//
+// WPM: vocab file, one piece per line. Two marker conventions are
+// auto-detected:
+//   - sentencepiece style: word-initial pieces start with "\xe2\x96\x81" (▁)
+//   - BERT style: continuation pieces start with "##"
+// Encoding is greedy longest-match-first per whitespace word; a word with
+// no decomposition maps to <unk>.
+//
+// BPE: codes file of "left right" merge operations in priority order
+// (optionally with a leading "#version" line), vocab file of one subword
+// per line (id = line number). Words end with the "</w>" marker before
+// merging, matching the classic subword-nmt scheme the reference's BPE
+// files use.
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace lingvo_tpu {
+namespace {
+
+const char kSpmMarker[] = "\xe2\x96\x81";  // ▁
+
+struct SubwordVocab {
+  std::unordered_map<std::string, int32_t> token_to_id;
+  std::vector<std::string> id_to_token;
+  int32_t unk_id = 0;
+  bool spm_style = false;   // word-start marker ▁
+  bool bert_style = false;  // continuation marker ##
+
+  int32_t Lookup(const std::string& tok) const {
+    auto it = token_to_id.find(tok);
+    return it == token_to_id.end() ? -1 : it->second;
+  }
+};
+
+SubwordVocab* LoadVocab(const char* path, const char* unk_token) {
+  std::ifstream f(path);
+  if (!f) return nullptr;
+  auto v = std::make_unique<SubwordVocab>();
+  std::string line;
+  while (std::getline(f, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    // vocab lines may be "token" or "token<TAB>count"
+    auto tab = line.find('\t');
+    if (tab != std::string::npos) line = line.substr(0, tab);
+    if (line.rfind(kSpmMarker, 0) == 0) v->spm_style = true;
+    if (line.rfind("##", 0) == 0) v->bert_style = true;
+    v->token_to_id.emplace(line, static_cast<int32_t>(v->id_to_token.size()));
+    v->id_to_token.push_back(line);
+  }
+  auto it = v->token_to_id.find(unk_token);
+  v->unk_id = (it == v->token_to_id.end()) ? 0 : it->second;
+  return v.release();
+}
+
+// Splits text on whitespace.
+std::vector<std::string> SplitWords(const char* text, int32_t len) {
+  std::vector<std::string> words;
+  int32_t i = 0;
+  while (i < len) {
+    while (i < len && (text[i] == ' ' || text[i] == '\t' || text[i] == '\n' ||
+                       text[i] == '\r'))
+      ++i;
+    int32_t start = i;
+    while (i < len && !(text[i] == ' ' || text[i] == '\t' ||
+                        text[i] == '\n' || text[i] == '\r'))
+      ++i;
+    if (i > start) words.emplace_back(text + start, i - start);
+  }
+  return words;
+}
+
+// Greedy longest-match wordpiece of one word. Returns false -> <unk>.
+bool WpmSegmentWord(const SubwordVocab& v, const std::string& word,
+                    std::vector<int32_t>* out) {
+  std::string w = v.spm_style ? (kSpmMarker + word) : word;
+  size_t start = 0;
+  std::vector<int32_t> pieces;
+  while (start < w.size()) {
+    size_t end = w.size();
+    int32_t found = -1;
+    while (end > start) {
+      std::string piece = w.substr(start, end - start);
+      if (v.bert_style && start > 0) piece = "##" + piece;
+      found = v.Lookup(piece);
+      if (found >= 0) break;
+      --end;
+    }
+    if (found < 0) return false;
+    pieces.push_back(found);
+    start = end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+  return true;
+}
+
+struct Bpe {
+  SubwordVocab* vocab = nullptr;
+  // merge rank of "left right" pair (lower = applied first)
+  std::unordered_map<std::string, int32_t> merge_rank;
+  ~Bpe() { delete vocab; }
+
+  int32_t Rank(const std::string& a, const std::string& b) const {
+    auto it = merge_rank.find(a + " " + b);
+    return it == merge_rank.end() ? INT32_MAX : it->second;
+  }
+};
+
+// Splits a UTF-8 string into code points (as byte strings).
+std::vector<std::string> Utf8Chars(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < s.size()) {
+    unsigned char c = s[i];
+    size_t n = (c < 0x80) ? 1 : (c < 0xE0) ? 2 : (c < 0xF0) ? 3 : 4;
+    if (i + n > s.size()) n = 1;  // malformed: take the byte
+    out.push_back(s.substr(i, n));
+    i += n;
+  }
+  return out;
+}
+
+// Classic BPE: chars + "</w>" on the last char, merge best-ranked pair
+// until no merge applies.
+std::vector<std::string> BpeSegmentWord(const Bpe& bpe,
+                                        const std::string& word) {
+  std::vector<std::string> parts = Utf8Chars(word);
+  if (parts.empty()) return parts;
+  parts.back() += "</w>";
+  while (parts.size() > 1) {
+    int best = -1;
+    int32_t best_rank = INT32_MAX;
+    for (size_t i = 0; i + 1 < parts.size(); ++i) {
+      int32_t r = bpe.Rank(parts[i], parts[i + 1]);
+      if (r < best_rank) {
+        best_rank = r;
+        best = static_cast<int>(i);
+      }
+    }
+    if (best < 0) break;
+    parts[best] += parts[best + 1];
+    parts.erase(parts.begin() + best + 1);
+  }
+  return parts;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- WPM ------------------------------------------------------------------
+
+void* LTWpmLoad(const char* vocab_path, const char* unk_token) {
+  return LoadVocab(vocab_path, unk_token);
+}
+
+void LTWpmFree(void* h) { delete static_cast<SubwordVocab*>(h); }
+
+int32_t LTWpmSize(void* h) {
+  return static_cast<int32_t>(
+      static_cast<SubwordVocab*>(h)->id_to_token.size());
+}
+
+// Encodes text; returns number of ids emitted (<= max_len).
+int32_t LTWpmEncode(void* h, const char* text, int32_t text_len,
+                    int32_t* out_ids, int32_t max_len) {
+  auto* v = static_cast<SubwordVocab*>(h);
+  std::vector<int32_t> ids;
+  for (const auto& word : SplitWords(text, text_len)) {
+    if (!WpmSegmentWord(*v, word, &ids)) ids.push_back(v->unk_id);
+  }
+  int32_t n = static_cast<int32_t>(ids.size());
+  if (n > max_len) n = max_len;
+  std::memcpy(out_ids, ids.data(), n * sizeof(int32_t));
+  return n;
+}
+
+// Decodes ids to text; reverses the marker convention. Returns length.
+int32_t LTWpmDecode(void* h, const int32_t* ids, int32_t n, char* out_text,
+                    int32_t max_len) {
+  auto* v = static_cast<SubwordVocab*>(h);
+  std::string out;
+  for (int32_t i = 0; i < n; ++i) {
+    if (ids[i] < 0 || ids[i] >= static_cast<int32_t>(v->id_to_token.size()))
+      continue;
+    std::string tok = v->id_to_token[ids[i]];
+    if (v->spm_style) {
+      if (tok.rfind(kSpmMarker, 0) == 0) {
+        if (!out.empty()) out += ' ';
+        tok = tok.substr(sizeof(kSpmMarker) - 1);
+      }
+      out += tok;
+    } else if (v->bert_style) {
+      if (tok.rfind("##", 0) == 0) {
+        out += tok.substr(2);
+      } else {
+        if (!out.empty()) out += ' ';
+        out += tok;
+      }
+    } else {
+      if (!out.empty()) out += ' ';
+      out += tok;
+    }
+  }
+  int32_t m = static_cast<int32_t>(out.size());
+  if (m > max_len) m = max_len;
+  std::memcpy(out_text, out.data(), m);
+  return m;
+}
+
+// ---- BPE ------------------------------------------------------------------
+
+void* LTBpeLoad(const char* codes_path, const char* vocab_path,
+                const char* unk_token) {
+  std::ifstream codes(codes_path);
+  if (!codes) return nullptr;
+  auto bpe = std::make_unique<Bpe>();
+  bpe->vocab = LoadVocab(vocab_path, unk_token);
+  if (!bpe->vocab) return nullptr;
+  std::string line;
+  int32_t rank = 0;
+  while (std::getline(codes, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line.empty() || line[0] == '#') continue;  // "#version" header
+    bpe->merge_rank.emplace(line, rank++);
+  }
+  return bpe.release();
+}
+
+void LTBpeFree(void* h) { delete static_cast<Bpe*>(h); }
+
+int32_t LTBpeSize(void* h) {
+  return static_cast<int32_t>(
+      static_cast<Bpe*>(h)->vocab->id_to_token.size());
+}
+
+int32_t LTBpeEncode(void* h, const char* text, int32_t text_len,
+                    int32_t* out_ids, int32_t max_len) {
+  auto* bpe = static_cast<Bpe*>(h);
+  std::vector<int32_t> ids;
+  for (const auto& word : SplitWords(text, text_len)) {
+    for (const auto& piece : BpeSegmentWord(*bpe, word)) {
+      int32_t id = bpe->vocab->Lookup(piece);
+      ids.push_back(id < 0 ? bpe->vocab->unk_id : id);
+    }
+  }
+  int32_t n = static_cast<int32_t>(ids.size());
+  if (n > max_len) n = max_len;
+  std::memcpy(out_ids, ids.data(), n * sizeof(int32_t));
+  return n;
+}
+
+int32_t LTBpeDecode(void* h, const int32_t* ids, int32_t n, char* out_text,
+                    int32_t max_len) {
+  auto* bpe = static_cast<Bpe*>(h);
+  std::string out;
+  for (int32_t i = 0; i < n; ++i) {
+    const auto& toks = bpe->vocab->id_to_token;
+    if (ids[i] < 0 || ids[i] >= static_cast<int32_t>(toks.size())) continue;
+    std::string tok = toks[ids[i]];
+    auto endw = tok.find("</w>");
+    if (endw != std::string::npos) {
+      out += tok.substr(0, endw);
+      out += ' ';
+    } else {
+      out += tok;
+    }
+  }
+  while (!out.empty() && out.back() == ' ') out.pop_back();
+  int32_t m = static_cast<int32_t>(out.size());
+  if (m > max_len) m = max_len;
+  std::memcpy(out_text, out.data(), m);
+  return m;
+}
+
+}  // extern "C"
+
+}  // namespace lingvo_tpu

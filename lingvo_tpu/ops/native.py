@@ -96,6 +96,28 @@ def Lib() -> ctypes.CDLL:
           ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
           ctypes.POINTER(ctypes.c_int32), ctypes.c_int32
       ]
+      for prefix in ("LTWpm", "LTBpe"):
+        load = getattr(lib, prefix + "Load")
+        load.restype = ctypes.c_void_p
+        load.argtypes = ([ctypes.c_char_p, ctypes.c_char_p] if prefix ==
+                         "LTWpm" else
+                         [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p])
+        getattr(lib, prefix + "Free").argtypes = [ctypes.c_void_p]
+        size = getattr(lib, prefix + "Size")
+        size.restype = ctypes.c_int32
+        size.argtypes = [ctypes.c_void_p]
+        enc = getattr(lib, prefix + "Encode")
+        enc.restype = ctypes.c_int32
+        enc.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32
+        ]
+        dec = getattr(lib, prefix + "Decode")
+        dec.restype = ctypes.c_int32
+        dec.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32
+        ]
       lib.LTVocabToText.restype = ctypes.c_int32
       lib.LTVocabToText.argtypes = [
           ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
@@ -178,12 +200,22 @@ def PackSequences(lens, num_rows: int, time: int,
   return row, off
 
 
-def ApplyPacking(sequences, row, offset, num_rows, time, pad_value=0):
-  """Materializes packed ids/segment_ids/segment_pos from an assignment."""
+def ApplyPacking(sequences, row, offset, num_rows, time, pad_value=0,
+                 extra_payloads=None, return_used=False):
+  """Materializes packed ids/segment_ids/segment_pos from an assignment.
+
+  `extra_payloads`: optional dict {name: list-of-arrays} packed with the same
+  assignment (e.g. labels alongside ids); returned as a dict after seg_pos.
+  `return_used`: also return the list of sequence indices that were placed
+  (row >= 0) — callers keeping a pending pool drop exactly these.
+  """
   ids = np.full((num_rows, time), pad_value, np.int32)
+  extras = {name: np.full((num_rows, time), pad_value, np.int32)
+            for name in (extra_payloads or {})}
   seg_ids = np.zeros((num_rows, time), np.int32)
   seg_pos = np.zeros((num_rows, time), np.int32)
   seg_counter = np.zeros(num_rows, np.int32)
+  used = []
   for i, seq in enumerate(sequences):
     r = int(row[i])
     if r < 0:
@@ -191,10 +223,18 @@ def ApplyPacking(sequences, row, offset, num_rows, time, pad_value=0):
     o = int(offset[i])
     L = len(seq)
     ids[r, o:o + L] = seq
+    for name, payload in (extra_payloads or {}).items():
+      extras[name][r, o:o + L] = payload[i][:L]
     seg_counter[r] += 1
     seg_ids[r, o:o + L] = seg_counter[r]
     seg_pos[r, o:o + L] = np.arange(L)
-  return ids, seg_ids, seg_pos
+    used.append(i)
+  out = (ids, seg_ids, seg_pos)
+  if extra_payloads is not None:
+    out = out + (extras,)
+  if return_used:
+    out = out + (used,)
+  return out
 
 
 class AsciiTokenizer:
@@ -282,3 +322,87 @@ class VocabTokenizer:
         self._lib.LTVocabFree(self._handle)
     except Exception:
       pass
+
+class _SubwordTokenizerBase:
+  """Shared encode/decode surface for the C++ subword tokenizers."""
+
+  _PREFIX = ""
+
+  def __init__(self):
+    self._lib = Lib()
+    self._handle = None
+
+  def _Fn(self, name):
+    return getattr(self._lib, self._PREFIX + name)
+
+  @property
+  def vocab_size(self) -> int:
+    return self._Fn("Size")(self._handle)
+
+  def StringsToIds(self, texts, max_len: int):
+    b = len(texts)
+    ids = np.zeros((b, max_len), np.int32)
+    lens = np.zeros(b, np.int32)
+    for i, text in enumerate(texts):
+      data = text.encode() if isinstance(text, str) else bytes(text)
+      out = np.zeros(max_len, np.int32)
+      n = self._Fn("Encode")(
+          self._handle, data, len(data),
+          out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), max_len)
+      ids[i, :n] = out[:n]
+      lens[i] = n
+    paddings = (np.arange(max_len)[None, :] >= lens[:, None]).astype(
+        np.float32)
+    return ids, paddings
+
+  def IdsToStrings(self, ids, lens=None):
+    out = []
+    for i in range(len(ids)):
+      row = np.ascontiguousarray(ids[i], np.int32)
+      n = int(lens[i]) if lens is not None else len(row)
+      buf = ctypes.create_string_buffer(64 * max(n, 1))
+      m = self._Fn("Decode")(
+          self._handle, row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+          n, buf, len(buf))
+      out.append(buf.raw[:m].decode("utf-8", errors="replace"))
+    return out
+
+  def __del__(self):
+    try:
+      if self._handle:
+        self._Fn("Free")(self._handle)
+    except Exception:
+      pass
+
+
+class WpmTokenizer(_SubwordTokenizerBase):
+  """Greedy longest-match wordpiece (ref wpm_encoder.py semantics).
+
+  Auto-detects the marker convention from the vocab file: sentencepiece
+  word-start "▁" or BERT continuation "##".
+  """
+
+  _PREFIX = "LTWpm"
+
+  def __init__(self, vocab_path: str, unk_token: str = "<unk>"):
+    super().__init__()
+    self._handle = self._lib.LTWpmLoad(vocab_path.encode(),
+                                       unk_token.encode())
+    if not self._handle:
+      raise FileNotFoundError(vocab_path)
+
+
+class BpeTokenizer(_SubwordTokenizerBase):
+  """Merge-ops BPE (ref BpeWordsToIds kernel semantics: codes file of merge
+  operations in priority order + subword vocab file, "</w>" end-of-word)."""
+
+  _PREFIX = "LTBpe"
+
+  def __init__(self, codes_path: str, vocab_path: str,
+               unk_token: str = "<unk>"):
+    super().__init__()
+    self._handle = self._lib.LTBpeLoad(codes_path.encode(),
+                                       vocab_path.encode(),
+                                       unk_token.encode())
+    if not self._handle:
+      raise FileNotFoundError(f"{codes_path} / {vocab_path}")

@@ -225,6 +225,7 @@ class EvalProgram(BaseProgram):
         if n >= max_batches:
           break
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
+    _MaybeResetFiniteStream(gen)
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)
     return state, result
@@ -270,9 +271,18 @@ class DecodeProgram(BaseProgram):
         if n >= self.p.steps_per_loop:
           break
     result = self._task.DecodeFinalize(dec_metrics)
+    _MaybeResetFiniteStream(gen)
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)
     return state, result
+
+
+def _MaybeResetFiniteStream(gen):
+  """Finite (max_epochs-bounded) file streams must be re-read from the start
+  on the next eval round (ref EvalProgram infeed-until-OutOfRange re-setup,
+  `program.py:995`); infinite streams keep their position."""
+  if getattr(getattr(gen, "p", None), "max_epochs", 0):
+    gen.Reset()
 
 
 def _TakeN(gen, n):
