@@ -183,7 +183,9 @@ class TransformerLayer(base_layer.BaseLayer):
             hidden_dim=p.hidden_dim or 4 * p.input_dim))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
-            aux_paddings=None, atten_mask=None, segment_ids=None):
+            aux_paddings=None, atten_mask=None, segment_ids=None,
+            token_ids=None):
+    del token_ids  # only MoE layers with hash gating consume ids
     x, _ = self.self_atten.FProp(
         theta.self_atten, inputs, paddings=paddings, atten_mask=atten_mask,
         segment_ids=segment_ids)
@@ -237,11 +239,11 @@ class StackedTransformerLayers(base_layer.BaseLayer):
               input_dim=p.input_dim or tpl.input_dim))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
-            aux_paddings=None, segment_ids=None):
+            aux_paddings=None, segment_ids=None, token_ids=None):
     x = inputs
     for i, layer in enumerate(self.x_layers):
       x = layer.FProp(theta.x_layers[i], x, paddings, aux_vecs, aux_paddings,
-                      segment_ids=segment_ids)
+                      segment_ids=segment_ids, token_ids=token_ids)
     if self.p.final_ln:
       x = self.final_ln.FProp(theta.final_ln, x)
     return x
@@ -300,7 +302,7 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
         self.body, self.p.num_layers))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
-            aux_paddings=None, segment_ids=None):
+            aux_paddings=None, segment_ids=None, token_ids=None):
     p = self.p
     aux_flag = py_utils.NewAuxFlag()
 
@@ -309,7 +311,8 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
       # own dropout masks even though FProp is traced once.
       with py_utils.StepSeedSalt(idx):
         return self.body.FProp(theta_i, carry, paddings, aux_vecs,
-                               aux_paddings, segment_ids=segment_ids)
+                               aux_paddings, segment_ids=segment_ids,
+                               token_ids=token_ids)
 
     wrapped = py_utils.CollectAuxLosses(_BodyInner, aux_flag)
 

@@ -51,6 +51,8 @@ class TransformerLm(base_model.BaseTask):
     p.Define("moe_capacity_factor", 2.0, "Expert capacity factor.")
     p.Define("moe_aux_loss_weight", 0.01, "Load-balance loss weight.")
     p.Define("moe_second_expert_policy", "all", "'all' or 'random'.")
+    p.Define("moe_gating_policy", "top2",
+             "'top2' (learned) or 'hash' (route by token-id hash).")
     return p
 
   def __init__(self, params):
@@ -94,6 +96,7 @@ class TransformerLm(base_model.BaseTask):
           capacity_factor=p.moe_capacity_factor,
           aux_loss_weight=p.moe_aux_loss_weight,
           second_expert_policy=p.moe_second_expert_policy,
+          gating_policy=p.moe_gating_policy,
           residual_dropout_prob=p.residual_dropout_prob)
       block = gshard.DenseMoEBlock.Params().Set(
           input_dim=p.model_dim, num_heads=p.num_heads,
@@ -132,7 +135,7 @@ class TransformerLm(base_model.BaseTask):
       x = x + pe.astype(x.dtype)
     seg_ids = input_batch.Get("segment_ids")
     x = self.stack.FProp(theta.stack, x, paddings=input_batch.paddings,
-                         segment_ids=seg_ids)
+                         segment_ids=seg_ids, token_ids=ids)
     x = self.final_ln.FProp(theta.final_ln, x)
     logits = self.emb.Logits(theta.emb, x)
     return NestedMap(logits=logits)

@@ -35,6 +35,24 @@ from lingvo_tpu.core.py_utils import WeightInit, WeightParams
 from lingvo_tpu.parallel import mesh as mesh_lib
 
 
+def _DeriveCapacity(s: int, e: int, capacity_factor: float,
+                    capacity: int | None) -> int:
+  """Per-expert capacity = ceil(tokens/experts * factor) unless overridden."""
+  if capacity is not None:
+    return capacity
+  return max(1, int(math.ceil(s / e * capacity_factor)))
+
+
+def _PositionInExpert(mask: jax.Array, c: int, offset=0):
+  """Cumsum position-in-expert with capacity truncation.
+
+  mask [G,S,E] one-hot-ish -> (truncated mask, per-token position [G,S]).
+  """
+  pos = jnp.cumsum(mask, axis=1) - mask + offset
+  mask = mask * (pos < c)
+  return mask, jnp.sum(pos * mask, axis=-1)
+
+
 def Top2Gating(logits: jax.Array,
                paddings: jax.Array | None,
                capacity_factor: float = 2.0,
@@ -47,9 +65,7 @@ def Top2Gating(logits: jax.Array,
   aux_loss scalar).
   """
   g, s, e = logits.shape
-  if capacity is None:
-    capacity = max(1, int(math.ceil(s / e * capacity_factor)))
-  c = capacity
+  c = _DeriveCapacity(s, e, capacity_factor, capacity)
   raw_gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G,S,E]
 
   nonpad = (1.0 - paddings) if paddings is not None else jnp.ones(
@@ -84,14 +100,10 @@ def Top2Gating(logits: jax.Array,
     gate_2 = gate_2 * keep_2.astype(gate_2.dtype)
 
   # --- capacity assignment via cumsum position-in-expert ---
-  pos_1 = jnp.cumsum(mask_1, axis=1) - mask_1                    # [G,S,E]
-  mask_1 = mask_1 * (pos_1 < c)
-  pos_1_tok = jnp.sum(pos_1 * mask_1, axis=-1)                   # [G,S]
+  mask_1, pos_1_tok = _PositionInExpert(mask_1, c)
   # expert-1 counts offset expert-2 positions
   count_1 = jnp.sum(mask_1, axis=1, keepdims=True)               # [G,1,E]
-  pos_2 = jnp.cumsum(mask_2, axis=1) - mask_2 + count_1
-  mask_2 = mask_2 * (pos_2 < c)
-  pos_2_tok = jnp.sum(pos_2 * mask_2, axis=-1)
+  mask_2, pos_2_tok = _PositionInExpert(mask_2, c, offset=count_1)
 
   # renormalize surviving gates
   mask_1_flat = jnp.sum(mask_1, axis=-1)                         # [G,S]
@@ -114,6 +126,54 @@ def Top2Gating(logits: jax.Array,
       combine_tensor=combine, dispatch_tensor=dispatch, aux_loss=aux_loss)
 
 
+def HashGating(token_ids: jax.Array,
+               num_experts: int,
+               paddings: jax.Array | None,
+               capacity_factor: float = 2.0,
+               capacity: int | None = None):
+  """Hash-based top-1 routing (ref `gshard_layers.py` HashGatingOnLogits:2367).
+
+  Routes each token to `hash(token_id) % E` with gate weight 1 — no learned
+  router, no aux loss. token_ids: [G, S] int32.
+  """
+  g, s = token_ids.shape
+  e = num_experts
+  c = _DeriveCapacity(s, e, capacity_factor, capacity)
+  # Knuth multiplicative hash, good enough for id-bucket spreading.
+  hashed = (token_ids.astype(jnp.uint32) * jnp.uint32(2654435761)) % e
+  mask = jax.nn.one_hot(hashed.astype(jnp.int32), e, dtype=jnp.float32)
+  if paddings is not None:
+    mask = mask * (1.0 - paddings)[..., None]
+  mask, pos_tok = _PositionInExpert(mask, c)
+  onehot_c = jax.nn.one_hot(pos_tok.astype(jnp.int32), c, dtype=jnp.float32)
+  combine = mask[..., None] * onehot_c[:, :, None, :]
+  dispatch = combine > 0.0
+  return NestedMap(combine_tensor=combine, dispatch_tensor=dispatch,
+                   aux_loss=jnp.zeros((), jnp.float32))
+
+
+def TokenShufflePerm(shape, prng_key):
+  """Random within-group token shuffle (ref `gshard_layers.py:2496`:
+  capacity truncation by cumsum position biases early tokens; shuffling
+  makes the drops uniform).
+
+  Returns (perm, inv_perm) [G, S]; the caller permutes its gating inputs,
+  gates, then inverse-permutes the gating tensors.
+  """
+  g, s = shape
+  perm = jax.vmap(lambda k: jax.random.permutation(k, s))(
+      jax.random.split(prng_key, g))                             # [G,S]
+  inv = jnp.argsort(perm, axis=-1)
+  return perm, inv
+
+
+def _TakeAlongS(x, perm):
+  """Applies a per-group permutation along the S (token) axis of [G,S,...]."""
+  idx = perm.reshape(perm.shape + (1,) * (x.ndim - 2))
+  return jnp.take_along_axis(x, jnp.broadcast_to(
+      idx, perm.shape + x.shape[2:]), axis=1)
+
+
 class MoEFeedForwardLayer(base_layer.BaseLayer):
   """Expert-parallel MoE FFN block (pre-LN, residual), GShard-style.
 
@@ -129,11 +189,25 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     p.Define("input_dim", 0, "Model dim D.")
     p.Define("hidden_dim", 0, "Expert FFN hidden dim H.")
     p.Define("num_experts", 8, "E.")
-    p.Define("num_groups", 1,
-             "G: gating groups per batch (ref num_groups; tokens compete for "
-             "capacity within a group).")
+    p.Define("num_groups", 0,
+             "G: gating groups per batch (tokens compete for capacity within "
+             "a group). 0 = auto: the 'expert' axis size of the active mesh "
+             "(groups shard over that axis), falling back to the 'data' "
+             "axis then min(batch, 8) — keeps the dispatch tensor "
+             "[G, S/G, E, C] bounded instead of [1, B*T, E, C].")
     p.Define("capacity_factor", 2.0, "Per-expert capacity factor.")
     p.Define("activation", "RELU", "Expert FFN activation.")
+    p.Define("gating_policy", "top2",
+             "'top2' (learned router) or 'hash' (id-hash top-1, ref "
+             "HashGatingOnLogits:2367; requires token_ids at FProp).")
+    p.Define("shuffle_tokens", False,
+             "Randomly permute tokens within each group before capacity "
+             "truncation (ref gshard_layers.py:2496) so drops are unbiased; "
+             "train-time only.")
+    p.Define("dispatch_via_shard_map", False,
+             "Dispatch/combine through shard_map with an explicit "
+             "jax.lax.all_to_all over the 'expert' axis instead of relying "
+             "on GSPMD inferring one from the einsum resharding.")
     p.Define("second_expert_policy", "all", "'all' or 'random'.")
     p.Define("aux_loss_weight", 0.01, "Aux load-balancing loss weight.")
     p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
@@ -161,47 +235,100 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
                      tensor_split_dims_mapping=("expert", "model", None)))
     self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
 
-  def FProp(self, theta, inputs, paddings=None):
-    """inputs [B, T, D] -> [B, T, D]; aux loss emitted via AddAuxLoss."""
+  def _NumGroups(self, b: int, t: int) -> int:
+    """p.num_groups, or auto = the mesh's 'expert' (else 'data') axis size,
+    clamped to a divisor of the token count."""
+    p = self.p
+    g = p.num_groups
+    if g <= 0:
+      g = (mesh_lib.CurrentMeshAxisSize("expert")
+           or mesh_lib.CurrentMeshAxisSize("data") or min(b, 8))
+    g = min(g, b * t)
+    while (b * t) % g != 0:  # largest divisor of b*t not above the target
+      g -= 1
+    return max(g, 1)
+
+  def FProp(self, theta, inputs, paddings=None, token_ids=None):
+    """inputs [B, T, D] -> [B, T, D]; aux loss emitted via AddAuxLoss.
+
+    token_ids [B, T] (int) is required for p.gating_policy='hash'.
+    """
     p = self.p
     th = self.CastTheta(theta)
     b, t, d = inputs.shape
     x = self.ln.FProp(theta.ln, inputs)
-    g = p.num_groups
-    assert (b * t) % g == 0, (b, t, g)
+    g = self._NumGroups(b, t)
     s = b * t // g
     xg = x.reshape(g, s, d)
     pg = (paddings.reshape(g, s) if paddings is not None else None)
 
-    logits = jnp.einsum("GSD,DE->GSE", xg, th.gating.astype(xg.dtype))
-    # 'random' second-expert sampling is a TRAIN-time policy; eval/decode
-    # (no step seed) falls back to deterministic top-2 (ref: the reference
-    # disables sampling at inference).
-    policy = p.second_expert_policy
-    prng_key = None
-    if policy == "random":
-      if py_utils.DoEval() or not py_utils.HasStepSeed():
-        policy = "all"
-      else:
-        prng_key = py_utils.StepSeed(f"{self.path}/gating")
-    gating = Top2Gating(
-        logits, pg, p.capacity_factor, policy, prng_key,
-        capacity=p.expert_capacity or None)
+    # Optional within-group token shuffle before capacity truncation so the
+    # cumsum-position drops don't bias early positions (train-time only).
+    perm = inv_perm = None
+    if p.shuffle_tokens and not py_utils.DoEval() and py_utils.HasStepSeed():
+      perm, inv_perm = TokenShufflePerm(
+          (g, s), py_utils.StepSeed(f"{self.path}/shuffle"))
+      xg_gate = _TakeAlongS(xg, perm)
+      pg_gate = _TakeAlongS(pg[..., None], perm)[..., 0] if pg is not None \
+          else None
+    else:
+      xg_gate, pg_gate = xg, pg
+
+    if p.gating_policy == "hash":
+      assert token_ids is not None, "hash gating needs token_ids"
+      idg = token_ids.reshape(g, s)
+      if perm is not None:
+        idg = _TakeAlongS(idg[..., None], perm)[..., 0]
+      gating = HashGating(idg, p.num_experts, pg_gate, p.capacity_factor,
+                          capacity=p.expert_capacity or None)
+    else:
+      logits = jnp.einsum("GSD,DE->GSE", xg_gate,
+                          th.gating.astype(xg.dtype))
+      # 'random' second-expert sampling is a TRAIN-time policy; eval/decode
+      # (no step seed) falls back to deterministic top-2 (ref: the reference
+      # disables sampling at inference).
+      policy = p.second_expert_policy
+      prng_key = None
+      if policy == "random":
+        if py_utils.DoEval() or not py_utils.HasStepSeed():
+          policy = "all"
+        else:
+          prng_key = py_utils.StepSeed(f"{self.path}/gating")
+      gating = Top2Gating(
+          logits, pg_gate, p.capacity_factor, policy, prng_key,
+          capacity=p.expert_capacity or None)
 
     dispatch = gating.dispatch_tensor.astype(xg.dtype)    # [G,S,E,C]
     combine = gating.combine_tensor.astype(xg.dtype)
-    # data-major -> expert-major (XLA inserts all-to-all over 'expert')
-    expert_in = jnp.einsum("GSEC,GSD->EGCD", dispatch, xg)
-    expert_in = mesh_lib.WithShardingConstraint(
-        expert_in, ("expert", None, None, None))
-    h = jnp.einsum("EGCD,EDH->EGCH", expert_in, th.wi)
-    from lingvo_tpu.core import activations
-    h = activations.GetFn(p.activation)(h)
-    expert_out = jnp.einsum("EGCH,EHD->EGCD", h, th.wo)
-    expert_out = mesh_lib.WithShardingConstraint(
-        expert_out, ("expert", None, None, None))
-    # expert-major -> data-major combine
-    out = jnp.einsum("GSEC,EGCD->GSD", combine, expert_out)
+    if inv_perm is not None:
+      # gating ran in shuffled token order: restore data order on S
+      dispatch = _TakeAlongS(dispatch, inv_perm)
+      combine = _TakeAlongS(combine, inv_perm)
+
+    if p.dispatch_via_shard_map and mesh_lib.CurrentMeshAxisSize("expert"):
+      out = self._DispatchShardMap(th, xg, dispatch, combine)
+    else:
+      # GShard layout: token GROUPS shard over the same devices as experts
+      # (G over 'expert' axis). The dispatch einsum output is constrained
+      # expert-major, so GSPMD must move tokens G-sharded -> E-sharded:
+      # that resharding IS the all-to-all (asserted by
+      # test_compiled_hlo_contains_all_to_all — without the group-major
+      # constraints below GSPMD falls back to all-gathers).
+      xg = mesh_lib.WithShardingConstraint(xg, ("expert", None, None))
+      dispatch = mesh_lib.WithShardingConstraint(
+          dispatch, ("expert", None, None, None))
+      combine = mesh_lib.WithShardingConstraint(
+          combine, ("expert", None, None, None))
+      # group-major -> expert-major (XLA inserts all-to-all over 'expert')
+      expert_in = jnp.einsum("GSEC,GSD->EGCD", dispatch, xg)
+      expert_in = mesh_lib.WithShardingConstraint(
+          expert_in, ("expert", None, None, None))
+      h = self._ExpertFfn(th, expert_in)
+      expert_out = mesh_lib.WithShardingConstraint(
+          h, ("expert", None, None, None))
+      # expert-major -> group-major combine (second all-to-all)
+      out = jnp.einsum("GSEC,EGCD->GSD", combine, expert_out)
+      out = mesh_lib.WithShardingConstraint(out, ("expert", None, None))
     out = out.reshape(b, t, d)
     if p.residual_dropout_prob > 0:
       out = self.dropout.FProp(
@@ -212,6 +339,69 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     aux = gating.aux_loss * p.aux_loss_weight
     py_utils.AddAuxLoss(f"{self.path}/aux_loss", aux)
     return inputs + out
+
+  def _ExpertFfn(self, th, expert_in):
+    """[E, G, C, D] -> [E, G, C, D]: the per-expert FFN as one batched matmul."""
+    from lingvo_tpu.core import activations
+    h = jnp.einsum("EGCD,EDH->EGCH", expert_in, th.wi)
+    h = activations.GetFn(self.p.activation)(h)
+    return jnp.einsum("EGCH,EHD->EGCD", h, th.wo)
+
+  def _DispatchShardMap(self, th, xg, dispatch, combine):
+    """Explicit all-to-all dispatch via shard_map over the 'expert' axis.
+
+    The einsum formulation relies on GSPMD noticing that `expert_in` flips
+    from group-major to expert-major sharding and inserting an all-to-all;
+    when it mis-infers (an all-gather instead), this path states the
+    collective outright (ref FeedForwardNetworksApplyGating:2992 — same
+    math, the collective made explicit):
+
+      per device: local groups -> [E, g_loc, C, D]
+      all_to_all over 'expert': split E, concat g -> [e_loc, G, C, D]
+      local expert FFN (each device owns its experts' weights)
+      all_to_all back: split g, concat E -> [E, g_loc, C, D]
+      local combine
+    """
+    try:
+      from jax import shard_map  # jax >= 0.8
+    except ImportError:
+      from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh = jax.sharding.get_abstract_mesh()
+    n_exp = mesh_lib.CurrentMeshAxisSize("expert")
+    g, s, d = xg.shape
+    e = self.p.num_experts
+    assert g % n_exp == 0, (
+        f"shard_map dispatch needs groups ({g}) divisible by the expert "
+        f"axis ({n_exp})")
+    assert e % n_exp == 0, (e, n_exp)
+
+    # Respect the weights' declared tensor-parallel sharding: wi is
+    # ('expert', None, 'model'), wo ('expert', 'model', None). Inside the
+    # shard_map each device holds an H-shard of its experts; the wo
+    # contraction over H is completed with a psum over 'model'.
+    has_model_tp = bool(mesh_lib.CurrentMeshAxisSize("model"))
+
+    def _Local(xg_l, disp_l, comb_l, wi_l, wo_l):
+      # xg_l [g_loc, S, D]; disp_l [g_loc, S, E, C]; wi_l [e_loc, D, H_loc]
+      expert_in = jnp.einsum("gSEC,gSD->EgCD", disp_l, xg_l)
+      # split E over devices, gather all group shards: [e_loc, G, C, D]
+      expert_in = jax.lax.all_to_all(
+          expert_in, "expert", split_axis=0, concat_axis=1, tiled=True)
+      h = self._ExpertFfn(NestedMap(wi=wi_l, wo=wo_l), expert_in)
+      if has_model_tp:
+        h = jax.lax.psum(h, "model")  # complete the H contraction
+      # back: split G, concat E -> [E, g_loc, C, D]
+      h = jax.lax.all_to_all(
+          h, "expert", split_axis=1, concat_axis=0, tiled=True)
+      return jnp.einsum("gSEC,EgCD->gSD", comb_l, h)
+
+    model_ax = "model" if has_model_tp else None
+    return shard_map(
+        _Local, mesh=mesh,
+        in_specs=(P("expert"), P("expert"), P("expert"),
+                  P("expert", None, model_ax), P("expert", model_ax, None)),
+        out_specs=P("expert"))(xg, dispatch, combine, th.wi, th.wo)
 
 
 class DenseMoEBlock(base_layer.BaseLayer):
@@ -245,13 +435,15 @@ class DenseMoEBlock(base_layer.BaseLayer):
         moe_tpl.Copy().Set(input_dim=p.input_dim, num_heads=p.num_heads))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
-            aux_paddings=None, atten_mask=None, segment_ids=None):
+            aux_paddings=None, atten_mask=None, segment_ids=None,
+            token_ids=None):
     x = self.dense.FProp(theta.dense, inputs, paddings, aux_vecs,
                          aux_paddings, atten_mask=atten_mask,
                          segment_ids=segment_ids)
     return self.moe_layer.FProp(theta.moe_layer, x, paddings,
                                 atten_mask=atten_mask,
-                                segment_ids=segment_ids)
+                                segment_ids=segment_ids,
+                                token_ids=token_ids)
 
 
 class MoETransformerLayer(base_layer.BaseLayer):
@@ -282,11 +474,12 @@ class MoETransformerLayer(base_layer.BaseLayer):
         "moe", p.moe_tpl.Copy().Set(input_dim=p.input_dim))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
-            aux_paddings=None, atten_mask=None, segment_ids=None):
+            aux_paddings=None, atten_mask=None, segment_ids=None,
+            token_ids=None):
     assert aux_vecs is None, (
         "MoETransformerLayer has no cross-attention; use a TransformerLayer "
         "with has_aux_atten=True for encoder-decoder stacks")
     x, _ = self.self_atten.FProp(
         theta.self_atten, inputs, paddings=paddings, atten_mask=atten_mask,
         segment_ids=segment_ids)
-    return self.moe.FProp(theta.moe, x, paddings)
+    return self.moe.FProp(theta.moe, x, paddings, token_ids=token_ids)

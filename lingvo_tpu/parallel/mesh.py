@@ -230,3 +230,15 @@ def WithShardingConstraint(x, spec_or_names):
     filtered.append(names if len(names) > 1 else (
         names[0] if names else None))
   return jax.lax.with_sharding_constraint(x, PartitionSpec(*filtered))
+
+
+def CurrentMeshAxisSize(name: str):
+  """Size of axis `name` in the ambient mesh, or None if no such axis."""
+  try:
+    from jax.sharding import get_abstract_mesh
+    m = get_abstract_mesh()
+    if m is None or name not in tuple(m.axis_names):
+      return None
+    return int(m.shape[name])
+  except Exception:
+    return None
