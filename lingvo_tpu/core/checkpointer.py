@@ -100,11 +100,14 @@ class Checkpointer:
     target = step if step is not None else self._mgr.latest_step()
     if target is None:
       return state_template, 0
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
-        if not isinstance(x, jax.Array) else
-        jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
-        dict(state_template))
+    def _Abstract(x):
+      if isinstance(x, jax.ShapeDtypeStruct):
+        return x  # already abstract (e.g. a jax.eval_shape'd template)
+      if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+      return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+
+    abstract = jax.tree_util.tree_map(_Abstract, dict(state_template))
     restored = self._mgr.restore(
         target, args=ocp.args.StandardRestore(abstract))
     state = jax.tree_util.tree_unflatten(

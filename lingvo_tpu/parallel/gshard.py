@@ -237,12 +237,17 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
 
   def _NumGroups(self, b: int, t: int) -> int:
     """p.num_groups, or auto = the mesh's 'expert' (else 'data') axis size,
-    clamped to a divisor of the token count."""
+    clamped to a divisor of the token count. An explicit num_groups that
+    does not divide the tokens fails loudly (silently changing G would
+    change per-group capacity semantics)."""
     p = self.p
     g = p.num_groups
-    if g <= 0:
-      g = (mesh_lib.CurrentMeshAxisSize("expert")
-           or mesh_lib.CurrentMeshAxisSize("data") or min(b, 8))
+    if g > 0:
+      assert (b * t) % g == 0, (
+          f"num_groups={g} must divide batch*time={b * t}")
+      return g
+    g = (mesh_lib.CurrentMeshAxisSize("expert")
+         or mesh_lib.CurrentMeshAxisSize("data") or min(b, 8))
     g = min(g, b * t)
     while (b * t) % g != 0:  # largest divisor of b*t not above the target
       g -= 1

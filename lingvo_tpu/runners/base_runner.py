@@ -1,0 +1,94 @@
+"""Checkpoint-polling runner: eval/decode jobs that follow a training run.
+
+Re-designs the reference's polling Evaler/Decoder machinery
+(`base_runner.py:224-298`: `_FindNewCheckpoint` + `_RunOnLatestCheckpoints`,
+driven by `runners.py` Evaler:860 / Decoder:1105): a separate job watches the
+trainer's checkpoint directory, and each time a new checkpoint appears it
+restores the weights and runs its programs (eval or decode) against it,
+writing summaries tagged with the checkpoint's global step. The job exits
+when a checkpoint at/after the task's max_steps has been processed, or when
+no new checkpoint appears within `timeout_secs`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Sequence
+
+import jax
+
+from lingvo_tpu.core import checkpointer as checkpointer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class CheckpointPollingRunner:
+  """Runs programs against every new checkpoint in a training directory."""
+
+  def __init__(self, task, programs: Sequence, train_dir: str,
+               poll_interval_secs: float = 10.0,
+               timeout_secs: float = 3600.0,
+               init_seed: int = 1234):
+    self._task = task
+    self._programs = list(programs)
+    self._train_dir = train_dir
+    self._checkpointer = checkpointer_lib.Checkpointer(train_dir)
+    self._poll_interval = poll_interval_secs
+    self._timeout = timeout_secs
+    self._init_seed = init_seed
+    self._last_evaled_step = -1
+    # abstract restore template, built ONCE without running initializers
+    # (eval_shape traces CreateTrainState into ShapeDtypeStructs)
+    self._template = jax.eval_shape(
+        self._task.CreateTrainState, jax.random.PRNGKey(self._init_seed))
+
+  def _FindNewCheckpoint(self) -> int | None:
+    """Latest unseen checkpoint step, or None (ref _FindNewCheckpoint:224)."""
+    latest = self._checkpointer.LatestStep()
+    if latest is None or latest <= self._last_evaled_step:
+      return None
+    return latest
+
+  def RunOnce(self, step: int) -> dict:
+    """Restores checkpoint `step` and runs all programs against it."""
+    state, restored_step = self._checkpointer.Restore(self._template,
+                                                      step=step)
+    results = {}
+    for prog in self._programs:
+      _, r = prog.Run(state)
+      results[prog.p.name] = r
+    self._last_evaled_step = restored_step
+    return results
+
+  def _TrainFinished(self) -> bool:
+    return os.path.exists(os.path.join(self._train_dir, "FINISHED"))
+
+  def Run(self, on_results: Callable[[int, dict], None] | None = None):
+    """Polls until the final checkpoint is processed or timeout expires."""
+    max_steps = self._task.p.train.max_steps
+    last_new = time.time()
+    try:
+      while True:
+        step = self._FindNewCheckpoint()
+        if step is not None:
+          results = self.RunOnce(step)
+          last_new = time.time()
+          print(f"[poller] evaluated checkpoint @ step {step}", flush=True)
+          if on_results is not None:
+            on_results(step, results)
+          if step >= max_steps or self._TrainFinished():
+            return  # training finished and its last checkpoint is processed
+        elif self._TrainFinished():
+          # trainer ended (e.g. early stop) and nothing new remains
+          print("[poller] trainer FINISHED marker seen; exiting", flush=True)
+          return
+        elif time.time() - last_new > self._timeout:
+          print(f"[poller] no new checkpoint in {self._timeout:.0f}s; "
+                "exiting", flush=True)
+          return
+        else:
+          time.sleep(self._poll_interval)
+    finally:
+      # orbax keeps non-daemon worker threads: without Close() the evaler
+      # process never exits
+      self._checkpointer.Close()

@@ -24,32 +24,47 @@ from lingvo_tpu.core.nested_map import NestedMap
 class ExecutorTpu:
 
   def __init__(self, model_params, logdir: str, schedule=None, task=None,
-               init_seed: int = 1234, precompile: bool = False):
+               init_seed: int = 1234, precompile: bool = False,
+               max_train_retries: int = 3):
     """model_params: SingleTaskModel-style params (task + input attached).
 
     If `task` is given (e.g. the instance shared with the program schedule),
-    it is used directly instead of instantiating a duplicate model.
+    it is used directly instead of instantiating a duplicate model. For a
+    multi-task schedule (one exposing CreateTrainState/tasks) `task` may be
+    None. `max_train_retries`: consecutive transient failures tolerated
+    before giving up (each retry restores the last checkpoint — ref
+    `base_runner._RunLoop:399-528` taxonomy).
     """
     self._logdir = logdir
     os.makedirs(logdir, exist_ok=True)
+    self._max_train_retries = max_train_retries
     if task is not None:
       self._task = task
+    elif schedule is not None and hasattr(schedule, "tasks"):
+      self._task = None  # multi-task: schedule owns the task set
     else:
       self._model = model_params.Instantiate()
       self._task = self._model.GetTask()
-    self._task.FinalizePaths()
+    if self._task is not None:
+      self._task.FinalizePaths()
+    else:
+      for t in schedule.tasks.values():
+        t.FinalizePaths()
     # Serialize the full experiment config for reproducibility
     # (ref executor.py:233-237 trainer_params.txt).
-    with open(os.path.join(logdir, "trainer_params.txt"), "w") as f:
-      f.write(model_params.ToText())
+    if model_params is not None:
+      with open(os.path.join(logdir, "trainer_params.txt"), "w") as f:
+        f.write(model_params.ToText())
+    self._schedule = schedule
     self._WriteModelAnalysis()
 
-    tp = self._task.p.train
+    ref_task = (self._task if self._task is not None
+                else next(iter(schedule.tasks.values())))
+    tp = ref_task.p.train
     self._checkpointer = checkpointer_lib.Checkpointer(
         os.path.join(logdir, "train"),
         save_interval_steps=tp.save_interval_steps,
         max_to_keep=tp.save_max_to_keep)
-    self._schedule = schedule
     self._init_seed = init_seed
     self._precompile = precompile
     self._max_steps = tp.max_steps
@@ -75,33 +90,69 @@ class ExecutorTpu:
 
   def _WriteModelAnalysis(self):
     """Param-count report (ref summary_utils.ModelAnalysis:432)."""
+    import numpy as np
+    tasks = ({"": self._task} if self._task is not None
+             else self._schedule.tasks)
     lines = []
     total = 0
-    for path, wp in self._task.VariableSpecs().FlattenItems():
-      import numpy as np
-      n = int(np.prod(wp.shape)) if wp.shape else 1
-      total += n
-      lines.append(f"{path:<60} {str(tuple(wp.shape)):<20} {n}")
+    for tname, task in sorted(tasks.items()):
+      prefix = f"{tname}." if tname else ""
+      for path, wp in task.VariableSpecs().FlattenItems():
+        n = int(np.prod(wp.shape)) if wp.shape else 1
+        total += n
+        lines.append(f"{prefix}{path:<60} {str(tuple(wp.shape)):<20} {n}")
     lines.append(f"{'TOTAL':<60} {'':<20} {total}")
     with open(os.path.join(self._logdir, "model_analysis.txt"), "w") as f:
       f.write("\n".join(lines) + "\n")
 
+  def _CreateTrainState(self) -> NestedMap:
+    key = jax.random.PRNGKey(self._init_seed)
+    if self._task is None or hasattr(self._schedule, "CreateTrainState"):
+      return self._schedule.CreateTrainState(key)
+    return self._task.CreateTrainState(key)
+
   def Start(self) -> NestedMap:
-    """Runs the main loop until max_steps; returns the final state."""
-    state = self._task.CreateTrainState(jax.random.PRNGKey(self._init_seed))
+    """Runs the main loop until max_steps; returns the final state.
+
+    Failure taxonomy (ref `base_runner._RunLoop:399-528`): a transient
+    infrastructure error (Unavailable/Aborted/deadline — a preempted chip or
+    dropped tunnel) restores the last checkpoint and continues, up to
+    `max_train_retries` consecutive failures; anything else (compile errors,
+    OOM, shape bugs) is fatal immediately.
+    """
+    state = self._CreateTrainState()
     state, start_step = self._checkpointer.Restore(state)
     if self._precompile and self._schedule is not None:
       for prog in self._schedule.programs:
         prog.Compile(state)
 
+    from lingvo_tpu.core import retry as retry_lib
     step = start_step
+    consecutive_failures = 0
     while step < self._max_steps:
       if self._checkpointer.ShouldSave(step):
         self._checkpointer.Save(step, state)
-      state, results = self._schedule.Run(state)
+      try:
+        state, results = self._schedule.Run(state)
+        consecutive_failures = 0
+      except BaseException as e:  # noqa: BLE001
+        if (not retry_lib.IsTransient(e) or
+            consecutive_failures >= self._max_train_retries):
+          raise
+        consecutive_failures += 1
+        delay = min(2.0 ** consecutive_failures, 30.0)
+        print(f"[executor] transient failure ({type(e).__name__}: {e}); "
+              f"restoring last checkpoint and retrying "
+              f"({consecutive_failures}/{self._max_train_retries}) "
+              f"in {delay:.0f}s", flush=True)
+        time.sleep(delay)
+        # rebuild device state from the last checkpoint (ref: cleanup +
+        # rebuild session + resume from checkpoint)
+        state, step = self._checkpointer.Restore(self._CreateTrainState())
+        continue
       step = int(jax.device_get(state.step))
       self._ExportMetrics(step, results)
-      if self._early_stop is not None:
+      if self._early_stop is not None and self._task is not None:
         tp = self._task.p.train
         # one designated eval program feeds the plateau detector — mixing
         # datasets would compare non-comparable losses
@@ -116,6 +167,11 @@ class ExecutorTpu:
           break
     self._checkpointer.Save(step, state, force=True)
     self._checkpointer.Close()
+    # marker for follower jobs (evaler/decoder pollers): training is over —
+    # process the final checkpoint and exit instead of idling to timeout
+    with open(os.path.join(self._checkpointer.train_dir, "FINISHED"),
+              "w") as f:
+      f.write(str(step))
     return state
 
   def _ExportMetrics(self, step: int, results: dict[str, Any]):

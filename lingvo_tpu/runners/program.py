@@ -341,3 +341,104 @@ class SimpleProgramSchedule:
       state, r = ep.Run(state)
       results[ep.p.name] = r
     return state, results
+
+
+class MultiTaskProgramSchedule:
+  """Per-task train programs driven by a sampling TaskScheduler.
+
+  The executor-side expansion of a MultiTaskModel (ref
+  `executor.py:67-153` GetExecutorParams + the per-cycle
+  `task_scheduler.Sample` at `executor.py:573`, and `SampleTask` in
+  `base_model.py:1480`): each cycle samples one task name and runs that
+  task's TrainProgram for its steps_per_loop. The combined train state is
+  NestedMap(tasks={name: per-task state}, step=total steps) so a single
+  checkpointer handles save/restore for the whole model.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", "multitask_schedule", "Name.")
+    p.Define("task_schedule", None, "TaskScheduler params.")
+    p.Define("train_programs", None,
+             "Params holding one TrainProgram params per task name.")
+    p.Define("eval_programs", [], "Eval/decode program params (any task).")
+    p.Define("train_executions_per_eval", 1,
+             "Train cycles between eval rounds (ref "
+             "SimpleProgramSchedule.train_executions_per_eval).")
+    return p
+
+  def __init__(self, params, tasks: dict | None = None,
+               input_generators: dict | None = None, task=None):
+    """tasks: {task_name: task instance} (instantiated from each train
+    program's task params when omitted — the trainer CLI path);
+    input_generators: {(task_name, dataset_name): generator}, or
+    {dataset_name: generator} applied to every task. `task` is accepted for
+    SimpleProgramSchedule constructor compatibility and ignored when `tasks`
+    is given."""
+    del task  # the multi-task schedule owns its task set
+    self.p = params.Copy()
+    input_generators = input_generators or {}
+    if tasks is None:
+      tasks = {}
+      for name, tp in self.p.train_programs.IterParams():
+        tasks[name] = tp.task.Instantiate()
+        tasks[name].FinalizePaths()
+    self._tasks = dict(tasks)
+    self._scheduler = self.p.task_schedule.Instantiate()
+    self._runs_since_eval = 0
+
+    def _GenFor(name, dataset):
+      if (name, dataset) in input_generators:
+        return input_generators[(name, dataset)]
+      return input_generators.get(dataset)
+
+    self.train_programs = {}
+    for name, tp in self.p.train_programs.IterParams():
+      self.train_programs[name] = tp.cls(
+          tp, task=tasks[name],
+          input_generator=_GenFor(name, tp.dataset_name))
+    self.eval_programs = []
+    for ep in self.p.eval_programs:
+      task_name = getattr(ep, "task_name", None) or next(iter(tasks))
+      self.eval_programs.append(
+          ep.cls(ep, task=tasks[task_name],
+                 input_generator=_GenFor(task_name, ep.dataset_name)))
+
+  @property
+  def programs(self):
+    return list(self.train_programs.values()) + list(self.eval_programs)
+
+  @property
+  def tasks(self):
+    return dict(self._tasks)
+
+  def CreateTrainState(self, key) -> NestedMap:
+    import jax
+    states = NestedMap()
+    keys = jax.random.split(key, len(self._tasks))
+    for k, name in zip(keys, sorted(self._tasks)):
+      states.Set(name, self._tasks[name].CreateTrainState(k))
+    return NestedMap(tasks=states, step=jnp.zeros((), jnp.int32))
+
+  def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, Any]]:
+    import jax
+    total_step = int(jax.device_get(state.step))
+    name = self._scheduler.Sample(total_step)
+    task_state = state.tasks.GetItem(name)
+    task_state, result = self.train_programs[name].Run(task_state)
+    state.tasks.Set(name, task_state)
+    state.step = jnp.asarray(
+        sum(int(jax.device_get(state.tasks.GetItem(n).step))
+            for n in sorted(self._tasks)), jnp.int32)
+    results = {f"train_{name}": result, "sampled_task": name}
+    self._runs_since_eval += 1
+    if self._runs_since_eval >= max(1, self.p.train_executions_per_eval):
+      self._runs_since_eval = 0
+      for ep in self.eval_programs:
+        task_name = (getattr(ep.p, "task_name", None)
+                     or next(iter(self._tasks)))
+        st, r = ep.Run(state.tasks.GetItem(task_name))
+        state.tasks.Set(task_name, st)
+        results[ep.p.name] = r
+    return state, results

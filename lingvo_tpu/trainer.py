@@ -16,6 +16,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from lingvo_tpu import model_registry
@@ -73,7 +74,14 @@ def main(argv=None):
                       choices=["train", "eval", "decode", "inspect_model",
                                "inspect_params"],
                       help="What to run.")
-  parser.add_argument("--job", default="executor_tpu", help="Parity flag.")
+  parser.add_argument("--job", default="executor_tpu",
+                      help="executor_tpu (train), or evaler/decoder "
+                           "(checkpoint-polling follower jobs).")
+  parser.add_argument("--poll_interval_secs", type=float, default=10.0)
+  parser.add_argument("--poll_timeout_secs", type=float, default=3600.0,
+                      help="Follower jobs exit after this long without a "
+                           "new checkpoint (also exit early when the "
+                           "trainer's FINISHED marker appears).")
   parser.add_argument("--max_steps", type=int, default=None,
                       help="Override task max_steps.")
   parser.add_argument("--train_executions_per_eval", type=int, default=1)
@@ -109,23 +117,38 @@ def main(argv=None):
     print(f"{'TOTAL':<60} {'':<20} {total}")
     return 0
 
-  from lingvo_tpu.runners import executor as executor_lib
   schedule, task = _BuildSchedule(model_params, args)
-  execu = executor_lib.ExecutorTpu(model_params, args.logdir,
-                                   schedule=schedule, task=task)
   if args.mode == "train":
+    from lingvo_tpu.runners import executor as executor_lib
+    execu = executor_lib.ExecutorTpu(model_params, args.logdir,
+                                     schedule=schedule, task=task)
     execu.Start()
     return 0
   if args.mode in ("eval", "decode"):
-    import jax
-    state = task.CreateTrainState(jax.random.PRNGKey(1234))
-    state, step = execu.checkpointer.Restore(state)
+    # follower jobs never construct an executor: the trainer owns
+    # trainer_params.txt / model_analysis.txt and the save-side manager
     progs = [pr for pr in schedule.programs
              if (args.mode == "eval" and "eval" in pr.p.name) or
              (args.mode == "decode" and "decode" in pr.p.name)]
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+    from lingvo_tpu.runners import base_runner
+    if args.job in ("evaler", "decoder"):
+      # checkpoint-following job (ref base_runner.py:224-298): keep polling
+      # the trainer's dir and score every new checkpoint until training ends
+      poller = base_runner.CheckpointPollingRunner(
+          task, progs, os.path.join(args.logdir, "train"),
+          poll_interval_secs=args.poll_interval_secs,
+          timeout_secs=args.poll_timeout_secs)
+      poller.Run()
+      return 0
+    import jax
+    ckpt = checkpointer_lib.Checkpointer(os.path.join(args.logdir, "train"))
+    state = task.CreateTrainState(jax.random.PRNGKey(1234))
+    state, step = ckpt.Restore(state)
     for prog in progs:
       _, results = prog.Run(state)
       print(f"[{prog.p.name}] step={step} {results}")
+    ckpt.Close()
     return 0
   return 1
 
