@@ -252,6 +252,72 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     ctx, probs = self._Atten(theta, q, k, v, mask)
     return self._PostProj(theta, ctx), probs
 
+  # -- chunk streaming (ref conformer streaming / stream_step_test_base) -----
+
+  def InitStreamStates(self, batch_size: int, left_context: int) -> NestedMap:
+    """Sliding-window streaming state: the last left_context-1 source frames'
+    K/V (cached PRE-rotary — rotary attention depends only on relative
+    position, so each chunk re-rotates with local positions) + paddings."""
+    n, h = self.p.num_heads, self._dim_per_head
+    ctx = max(left_context - 1, 0)
+    dtype = self.fprop_dtype
+    return NestedMap(
+        key=jnp.zeros((batch_size, ctx, n, h), dtype),
+        value=jnp.zeros((batch_size, ctx, n, h), dtype),
+        paddings=jnp.ones((batch_size, ctx), jnp.float32),
+        left_context=left_context)
+
+  def StreamStep(self, theta, inputs, paddings, cached_states):
+    """One chunk of causal sliding-window attention.
+
+    inputs [B, C, D], paddings [B, C] -> (out [B, C, D], new states).
+    Equivalent to offline LocalSelfAttention(left_context, right_context=0)
+    consumed chunk by chunk (asserted by streaming-equivalence tests).
+    """
+    p = self.p
+    assert p.rel_pos_emb_dim <= 0, (
+        "StreamStep computes chunk-local query indices; the T5 relative "
+        "bias would use wrong buckets (needs a ctx_len offset)")
+    left = cached_states.left_context
+    ctx_len = cached_states.key.shape[1]
+    b, c, _ = inputs.shape
+    q = self._HeadsProj(theta, "query", inputs)
+    k_new = self._HeadsProj(theta, "key", inputs)
+    v_new = self._HeadsProj(theta, "value", inputs)
+    k_cat = jnp.concatenate(
+        [cached_states.key, k_new.astype(cached_states.key.dtype)], axis=1)
+    v_cat = jnp.concatenate(
+        [cached_states.value, v_new.astype(cached_states.value.dtype)],
+        axis=1)
+    pad_cat = jnp.concatenate([cached_states.paddings, paddings], axis=1)
+    if p.use_rotary_position_emb:
+      rt = self.ChildTheta(theta, "rotary")
+      s = ctx_len + c
+      pos_k = jnp.arange(s, dtype=jnp.float32)[None]
+      pos_q = pos_k[:, ctx_len:]
+      q = self.rotary.FProp(rt, q, position=pos_q)
+      k_rot = self.rotary.FProp(rt, k_cat, position=pos_k)
+    else:
+      k_rot = k_cat
+    q = self._ScaleQuery(theta, q)
+    # window mask: query i (global ctx_len+i) sees j with
+    # 0 <= (ctx_len+i) - j <= left-1
+    qpos = ctx_len + jnp.arange(c)[:, None]
+    jpos = jnp.arange(ctx_len + c)[None, :]
+    visible = (qpos >= jpos) & (qpos - jpos <= left - 1)
+    mask = jnp.where(visible, 0.0, _NEG_INF)[None, None]
+    mask = mask + PaddingsToMask(pad_cat)
+    ctx_vec, _ = self._Atten(theta, q, k_rot, v_cat, mask)
+    out = self._PostProj(theta, ctx_vec)
+    out = py_utils.ApplyPadding(paddings, out)
+    keep = ctx_len  # buffer length stays fixed
+    new_states = NestedMap(
+        key=k_cat[:, c:] if keep else k_cat[:, :0],
+        value=v_cat[:, c:] if keep else v_cat[:, :0],
+        paddings=pad_cat[:, c:] if keep else pad_cat[:, :0],
+        left_context=left)
+    return out, new_states
+
   # -- incremental decode ----------------------------------------------------
 
   def InitStates(self, theta, batch_size: int, max_len: int) -> NestedMap:

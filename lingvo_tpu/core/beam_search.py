@@ -67,15 +67,23 @@ class BeamSearchHelper:
     p.Define("valid_eos_max_logit_delta", 5.0,
              "EOS only allowed when within delta of the best logit "
              "(ref x_ops.cc BeamSearchStep semantics).")
+    p.Define("coverage_penalty", 0.0,
+             "GNMT coverage penalty beta (ref x_ops.cc BeamSearchStep "
+             "coverage scoring): beta * sum_t log(min(cum_atten_t, 1)). "
+             "Needs a step_fn returning (log_probs, new_states, "
+             "atten_probs).")
     return p
 
   def __init__(self, params):
     self.p = params.Copy()
 
   def Search(self, batch_size: int, init_states: NestedMap,
-             step_fn: Callable) -> NestedMap:
+             step_fn: Callable, src_len: int = 1,
+             src_paddings=None) -> NestedMap:
     """Runs beam search; returns NestedMap(topk_ids [B,K,T], topk_lens,
-    topk_scores [B,K]) sorted best-first."""
+    topk_scores [B,K]) sorted best-first. `src_len` sizes the coverage
+    accumulator when coverage_penalty > 0 (step_fn must then return
+    attention probs [B*K, src_len] as a third output)."""
     p = self.p
     k = p.num_hyps_per_beam
     t_max = p.target_seq_len
@@ -87,8 +95,13 @@ class BeamSearchHelper:
     init_ids = jnp.full((bk,), p.target_sos_id, jnp.int32)
 
     def _Step(carry, t):
-      states, last_ids, scores, done, ids_so_far, lens = carry
-      log_probs, new_states = step_fn(states, last_ids[:, None])
+      states, last_ids, scores, done, ids_so_far, lens, coverage = carry
+      step_out = step_fn(states, last_ids[:, None])
+      if len(step_out) == 3:
+        log_probs, new_states, atten_probs = step_out
+      else:
+        log_probs, new_states = step_out
+        atten_probs = None
       vocab = log_probs.shape[-1]
       log_probs = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
 
@@ -112,6 +125,11 @@ class BeamSearchHelper:
       new_states = _GatherBeams(new_states, parent, batch_size, k)
       ids_so_far = _GatherBeams(ids_so_far, parent, batch_size, k)
       lens = _GatherBeams(lens, parent, batch_size, k)
+      if atten_probs is not None:
+        # accumulate source coverage for live hyps, then follow the parents
+        coverage = _GatherBeams(
+            coverage + atten_probs * (1.0 - done[:, None].astype(jnp.float32)),
+            parent, batch_size, k)
       done = _GatherBeams(done, parent, batch_size, k)
 
       token_flat = token.reshape(bk)
@@ -120,18 +138,29 @@ class BeamSearchHelper:
           jnp.where(done, p.target_eos_id, token_flat))
       lens = lens + (1 - done.astype(jnp.int32))
       return (new_states, token_flat, new_scores.reshape(bk), new_done,
-              ids_so_far, lens), ()
+              ids_so_far, lens, coverage), ()
 
     ids0 = jnp.full((bk, t_max), p.target_eos_id, jnp.int32)
     lens0 = jnp.zeros((bk,), jnp.int32)
     done0 = jnp.zeros((bk,), jnp.bool_)
-    carry = (init_states, init_ids, init_scores, done0, ids0, lens0)
-    (states, _, scores, done, ids, lens), _ = jax.lax.scan(
+    cov0 = jnp.zeros((bk, src_len), jnp.float32)
+    carry = (init_states, init_ids, init_scores, done0, ids0, lens0, cov0)
+    (states, _, scores, done, ids, lens, coverage), _ = jax.lax.scan(
         _Step, carry, jnp.arange(t_max))
 
     # normalized scores + best-first ordering
     norm_scores = scores / LengthNorm(jnp.maximum(lens, 1),
                                       p.length_normalization)
+    if p.coverage_penalty > 0.0:
+      # GNMT: beta * sum_t log(min(coverage_t, 1)) over real source positions
+      cp_terms = jnp.log(jnp.clip(coverage, 1e-10, 1.0))
+      if src_paddings is not None:
+        nonpad = (1.0 - src_paddings)[:, None, :]          # [B, 1, T]
+        nonpad = jnp.broadcast_to(nonpad,
+                                  (batch_size, k, src_len)).reshape(bk,
+                                                                    src_len)
+        cp_terms = cp_terms * nonpad
+      norm_scores = norm_scores + p.coverage_penalty * jnp.sum(cp_terms, -1)
     norm_scores = norm_scores.reshape(batch_size, k)
     order = jnp.argsort(-norm_scores, axis=-1)
     topk_scores = jnp.take_along_axis(norm_scores, order, axis=1)

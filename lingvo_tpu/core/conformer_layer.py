@@ -79,6 +79,43 @@ class LConvLayer(base_layer.BaseLayer):
       x = py_utils.ApplyPadding(paddings, x)
     return inputs + x
 
+  # -- chunk streaming -------------------------------------------------------
+
+  def InitStreamStates(self, batch_size: int) -> NestedMap:
+    """Causal-conv ring buffer: the last kernel-1 post-GLU frames."""
+    p = self.p
+    assert p.causal, "streaming LConv requires causal=True"
+    assert p.conv_norm == "ln", (
+        "streaming LConv requires conv_norm='ln' (BatchNorm pools over time)")
+    return NestedMap(conv_input=jnp.zeros(
+        (batch_size, p.kernel_size - 1, p.input_dim), self.fprop_dtype))
+
+  def StreamStep(self, theta, inputs, paddings, cached_states):
+    """inputs [B, C, D] -> (out [B, C, D], new states); equals causal FProp
+    consumed chunk by chunk (offline==streaming equivalence)."""
+    p = self.p
+    th = self.CastTheta(theta)
+    x = self.ln.FProp(theta.ln, inputs)
+    gated = jnp.einsum("btd,de->bte", x, th.pw_in)
+    a, b_ = jnp.split(gated, 2, axis=-1)
+    x = a * jax.nn.sigmoid(b_)  # GLU
+    if paddings is not None:
+      x = py_utils.ApplyPadding(paddings, x)
+    xc = jnp.concatenate(
+        [cached_states.conv_input.astype(x.dtype), x], axis=1)
+    y = jax.lax.conv_general_dilated(
+        xc, th.dw[:, None, :], window_strides=(1,), padding="VALID",
+        feature_group_count=p.input_dim,
+        dimension_numbers=("NHC", "HIO", "NHC"))
+    y = self.norm.FProp(theta.norm, y)
+    y = jax.nn.silu(y)
+    y = jnp.einsum("btd,de->bte", y, th.pw_out)
+    if paddings is not None:
+      y = py_utils.ApplyPadding(paddings, y)
+    c = inputs.shape[1]
+    new_states = NestedMap(conv_input=xc[:, c:])
+    return inputs + y, new_states
+
 
 class ConformerLayer(base_layer.BaseLayer):
   """Macaron conformer block (ref ConformerLayer:471)."""
@@ -149,3 +186,33 @@ class ConformerLayer(base_layer.BaseLayer):
     if paddings is not None:
       x = py_utils.ApplyPadding(paddings, x)
     return x
+
+  # -- chunk streaming (ref conformer StreamStep + stream_step_test_base) ----
+
+  def InitStreamStates(self, batch_size: int) -> NestedMap:
+    p = self.p
+    assert p.causal and p.atten_left_context > 0, (
+        "streaming conformer requires causal=True and a finite "
+        "atten_left_context window")
+    assert p.atten_right_context == 0, (
+        "streaming is strictly causal; atten_right_context > 0 would "
+        "silently diverge from offline FProp")
+    return NestedMap(
+        atten=self.atten.InitStreamStates(batch_size, p.atten_left_context),
+        lconv=self.lconv.InitStreamStates(batch_size))
+
+  def StreamStep(self, theta, inputs, paddings, cached_states):
+    """inputs [B, C, D] -> (out [B, C, D], new states); equals offline FProp
+    (causal + windowed attention) consumed chunk by chunk."""
+    x = inputs + 0.5 * self.ffn_start.FProp(theta.ffn_start, inputs, paddings)
+    a = self.atten_ln.FProp(theta.atten_ln, x)
+    atten_out, new_atten = self.atten.StreamStep(theta.atten, a, paddings,
+                                                 cached_states.atten)
+    x = x + atten_out
+    x, new_lconv = self.lconv.StreamStep(theta.lconv, x, paddings,
+                                         cached_states.lconv)
+    x = x + 0.5 * self.ffn_end.FProp(theta.ffn_end, x, paddings)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    if paddings is not None:
+      x = py_utils.ApplyPadding(paddings, x)
+    return x, NestedMap(atten=new_atten, lconv=new_lconv)

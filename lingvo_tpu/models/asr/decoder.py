@@ -1,0 +1,151 @@
+"""LAS attention decoder (ref `lingvo/tasks/asr/decoder.py`
+AsrDecoderBase/Decoder: embed previous label, stacked LSTMs where layer 0
+consumes [emb, context], per-step seq attention over the encoder, logits
+from [rnn_out, context]).
+
+TPU-first shape: teacher forcing is one `lax.scan` over target time (the
+reference's `recurrent.Recurrent` custom-gradient while-loop collapses into
+scan + autodiff); beam-search decode reuses the same per-step function
+through the flat BeamSearchHelper with coverage penalty.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import beam_search as beam_search_lib
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import rnn_cell
+from lingvo_tpu.core import seq_attention
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class LasDecoder(base_layer.BaseLayer):
+  """Attention decoder over encoder outputs."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 77, "Output vocab (sos/eos included).")
+    p.Define("emb_dim", 96, "Label embedding dim.")
+    p.Define("rnn_cell_dim", 256, "LSTM hidden dim.")
+    p.Define("num_rnn_layers", 2, "Stacked LSTM depth.")
+    p.Define("rnn_cell_tpl", rnn_cell.LSTMCellSimple.Params(),
+             "Decoder cell template.")
+    p.Define("attention", seq_attention.LocationSensitiveAttention.Params(),
+             "Seq attention template (ref LocationSensitiveAttention:2334).")
+    p.Define("source_dim", 256, "Encoder output dim.")
+    p.Define("label_smoothing", 0.1, "Label smoothing epsilon.")
+    p.Define("target_sos_id", 1, "SOS.")
+    p.Define("target_eos_id", 2, "EOS.")
+    p.Define("beam_search", beam_search_lib.BeamSearchHelper.Params().Set(
+        num_hyps_per_beam=8, coverage_penalty=0.2), "Beam search.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "emb",
+        layers_lib.SimpleEmbeddingLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.emb_dim))
+    cells = []
+    for i in range(p.num_rnn_layers):
+      in_dim = (p.emb_dim + p.source_dim) if i == 0 else p.rnn_cell_dim
+      cells.append(p.rnn_cell_tpl.Copy().Set(
+          num_input_nodes=in_dim, num_output_nodes=p.rnn_cell_dim))
+    self.CreateChildren("rnn", cells)
+    self.CreateChild(
+        "atten",
+        p.attention.Copy().Set(
+            source_dim=p.source_dim, query_dim=p.rnn_cell_dim,
+            hidden_dim=p.attention.hidden_dim or p.rnn_cell_dim))
+    self.CreateChild(
+        "softmax",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.rnn_cell_dim + p.source_dim,
+            output_dim=p.vocab_size))
+
+  # -- per-step core ---------------------------------------------------------
+  def _InitStates(self, theta, batch_size: int, src_len: int) -> NestedMap:
+    p = self.p
+    return NestedMap(
+        rnn=[c.InitState(batch_size) for c in self.rnn],
+        atten=self.atten.ZeroAttentionState(batch_size, src_len),
+        context=jnp.zeros((batch_size, p.source_dim), self.fprop_dtype))
+
+  def _Step(self, theta, packed, prev_ids, states):
+    """One decode step: prev_ids [B] -> (logits [B, V], probs, new states)."""
+    emb = self.emb.EmbLookup(self.ChildTheta(theta, "emb"), prev_ids[:, None])
+    emb = emb[:, 0]                                       # [B, E]
+    x = jnp.concatenate([emb, states.context.astype(emb.dtype)], axis=-1)
+    new_rnn = []
+    for i, cell in enumerate(self.rnn):
+      st = cell.FProp(theta.rnn[i], states.rnn[i], x)
+      new_rnn.append(st)
+      x = cell.GetOutput(st)
+    query = x                                             # [B, H]
+    ctx, probs, new_atten = self.atten.ComputeContextVector(
+        self.ChildTheta(theta, "atten"), packed, query, states.atten)
+    logits = self.softmax.FProp(
+        theta.softmax,
+        jnp.concatenate([query, ctx.astype(query.dtype)], axis=-1))
+    new_states = NestedMap(rnn=new_rnn, atten=new_atten, context=ctx)
+    return logits, probs, new_states
+
+  # -- training --------------------------------------------------------------
+  def ComputeLogits(self, theta, encoded, enc_paddings, tgt_ids):
+    """Teacher forcing: tgt_ids [B, T] (sos-prefixed) -> logits [B, T, V]."""
+    b, t = tgt_ids.shape
+    packed = self.atten.PackSource(
+        self.ChildTheta(theta, "atten"), encoded, enc_paddings)
+    states0 = self._InitStates(theta, b, encoded.shape[1])
+
+    def _Body(states, ids_t):
+      logits, _, new_states = self._Step(theta, packed, ids_t, states)
+      return new_states, logits
+
+    _, logits = jax.lax.scan(_Body, states0, tgt_ids.swapaxes(0, 1))
+    return logits.swapaxes(0, 1)                          # [B, T, V]
+
+  def ComputeLoss(self, theta, logits, tgt):
+    """Smoothed xent against tgt.labels with tgt.paddings weighting."""
+    p = self.p
+    xent = layers_lib.XentLossFromLogits(
+        logits, p.vocab_size, class_ids=tgt.labels,
+        label_smoothing=p.label_smoothing).per_example_xent
+    weights = 1.0 - tgt.paddings
+    tot = jnp.maximum(jnp.sum(weights), 1e-8)
+    loss = jnp.sum(xent * weights) / tot
+    acc = jnp.sum(
+        (jnp.argmax(logits, -1) == tgt.labels) * weights) / tot
+    return loss, acc, tot
+
+  # -- decoding --------------------------------------------------------------
+  def BeamSearchDecode(self, theta, encoded, enc_paddings) -> NestedMap:
+    p = self.p
+    b, src_len = encoded.shape[0], encoded.shape[1]
+    k = p.beam_search.num_hyps_per_beam
+    helper = p.beam_search.Copy().Set(
+        target_sos_id=p.target_sos_id,
+        target_eos_id=p.target_eos_id).Instantiate()
+
+    def _Tile(x):
+      return jnp.repeat(x, k, axis=0)
+
+    # pack ONCE on [B, T, D], then tile the packed projections to the beams
+    packed = self.atten.PackSource(
+        self.ChildTheta(theta, "atten"), encoded, enc_paddings)
+    packed = jax.tree_util.tree_map(_Tile, packed)
+    init = self._InitStates(theta, b * k, src_len)
+
+    def _StepFn(states, ids):
+      logits, probs, new_states = self._Step(theta, packed, ids[:, 0],
+                                             states)
+      return logits, new_states, probs
+
+    return helper.Search(b, init, _StepFn, src_len=src_len,
+                         src_paddings=enc_paddings)

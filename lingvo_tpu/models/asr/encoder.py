@@ -1,0 +1,89 @@
+"""ASR encoder: mel frontend + SpecAugment + conv subsampling + conformer.
+
+The shared acoustic encoder behind both the CTC and LAS tasks (ref
+`lingvo/tasks/asr/encoder.py` — the reference's CNN+BiLSTM encoder family;
+here the modern conformer stack, which the reference also provides via
+`conformer_layer.py`, is the default and the BiLSTM variant is available
+through `rnn_layers`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import conformer_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import spectrum_augmenter
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.asr import frontend as frontend_lib
+
+
+class AsrConformerEncoder(base_layer.BaseLayer):
+  """Features/waveform -> (encoded [b, t', model_dim], paddings [b, t'])."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("frontend", frontend_lib.MelAsrFrontend.Params(),
+             "Waveform frontend (unused when features are fed directly).")
+    p.Define("specaug", spectrum_augmenter.SpectrumAugmenter.Params(),
+             "SpecAugment.")
+    p.Define("input_dim", 80, "Feature dim.")
+    p.Define("model_dim", 256, "Conformer dim.")
+    p.Define("num_layers", 16, "Conformer depth.")
+    p.Define("num_heads", 4, "Attention heads.")
+    p.Define("kernel_size", 32, "LConv kernel.")
+    p.Define("dropout_prob", 0.0, "Dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild("frontend", p.frontend)
+    self.CreateChild("specaug", p.specaug)
+    # conv subsampling: two stride-2 convs over time (4x subsampling)
+    self.CreateChild(
+        "sub1",
+        layers_lib.Conv2DLayer.Params().Set(
+            filter_shape=(3, 3, 1, 32), filter_stride=(2, 2),
+            activation="RELU", batch_norm=False, has_bias=True))
+    self.CreateChild(
+        "sub2",
+        layers_lib.Conv2DLayer.Params().Set(
+            filter_shape=(3, 3, 32, 32), filter_stride=(2, 2),
+            activation="RELU", batch_norm=False, has_bias=True))
+    # two SAME stride-2 convs: freq -> ceil(ceil(f/2)/2)
+    sub_freq = (p.input_dim + 1) // 2
+    sub_freq = (sub_freq + 1) // 2
+    self.CreateChild(
+        "input_proj",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=32 * sub_freq, output_dim=p.model_dim))
+    blocks = []
+    for _ in range(p.num_layers):
+      blocks.append(conformer_layer.ConformerLayer.Params().Set(
+          input_dim=p.model_dim, atten_num_heads=p.num_heads,
+          kernel_size=p.kernel_size, dropout_prob=p.dropout_prob))
+    self.CreateChildren("conformer", blocks)
+
+  def FProp(self, theta, input_batch: NestedMap):
+    if "features" in input_batch:
+      feats = input_batch.features
+      fpad = input_batch.Get("feature_paddings")
+      if fpad is None:
+        fpad = jnp.zeros(feats.shape[:2], jnp.float32)
+    else:
+      feats, fpad = self.frontend.FProp(
+          self.ChildTheta(theta, "frontend"), input_batch.waveform,
+          input_batch.Get("paddings"))
+    feats = self.specaug.FProp(self.ChildTheta(theta, "specaug"), feats,
+                               fpad)
+    x = feats[..., None]                     # [b, t, f, 1]
+    x, fpad = self.sub1.FProp(theta.sub1, x, fpad)
+    x, fpad = self.sub2.FProp(theta.sub2, x, fpad)
+    b, t = x.shape[0], x.shape[1]
+    x = x.reshape(b, t, -1)
+    x = self.input_proj.FProp(theta.input_proj, x)
+    for i, block in enumerate(self.conformer):
+      x = block.FProp(theta.conformer[i], x, fpad)
+    return x, fpad

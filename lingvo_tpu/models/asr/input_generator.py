@@ -25,6 +25,11 @@ class SyntheticAsrInput(base_input_generator.BaseInputGenerator):
     p.Define("vocab_size", 30, "Token vocab (blank=0 excluded from labels).")
     p.Define("noise", 0.2, "Feature noise stddev.")
     p.Define("seed", 0, "Seed.")
+    p.Define("teacher_forcing", False,
+             "LAS layout: tgt.ids sos-prefixed + tgt.labels eos-suffixed "
+             "(content ids 3..vocab); else CTC layout (ids >= 1).")
+    p.Define("sos_id", 1, "SOS (teacher_forcing).")
+    p.Define("eos_id", 2, "EOS (teacher_forcing).")
     return p
 
   def __init__(self, params):
@@ -46,17 +51,29 @@ class SyntheticAsrInput(base_input_generator.BaseInputGenerator):
     fpad = np.ones((b, max_frames), np.float32)
     ids = np.zeros((b, p.max_label_len), np.int32)
     lpad = np.ones((b, p.max_label_len), np.float32)
+    labels = np.zeros((b, p.max_label_len), np.int32)
     for i in range(b):
-      n = rng.randint(2, p.max_label_len + 1)
-      toks = rng.randint(1, p.vocab_size, n)  # 0 reserved for blank
-      ids[i, :n] = toks
-      lpad[i, :n] = 0.0
+      if p.teacher_forcing:
+        # LAS layout: content ids 3.. ; ids=[sos, w...], labels=[w..., eos]
+        n = rng.randint(2, p.max_label_len)
+        toks = rng.randint(3, p.vocab_size, n)
+        ids[i, 0] = p.sos_id
+        ids[i, 1:n + 1] = toks
+        labels[i, :n] = toks
+        labels[i, n] = p.eos_id
+        lpad[i, :n + 1] = 0.0
+      else:
+        n = rng.randint(2, p.max_label_len + 1)
+        toks = rng.randint(1, p.vocab_size, n)  # 0 reserved for blank
+        ids[i, :n] = toks
+        lpad[i, :n] = 0.0
       for j, tok in enumerate(toks):
         s = j * p.frames_per_token
         feats[i, s:s + p.frames_per_token] = self._protos[tok]
       t = n * p.frames_per_token
       feats[i, :t] += p.noise * rng.randn(t, p.num_bins)
       fpad[i, :t] = 0.0
-    return NestedMap(
-        features=feats, feature_paddings=fpad,
-        tgt=NestedMap(ids=ids, paddings=lpad))
+    tgt = NestedMap(ids=ids, paddings=lpad)
+    if p.teacher_forcing:
+      tgt.labels = labels
+    return NestedMap(features=feats, feature_paddings=fpad, tgt=tgt)

@@ -1,7 +1,9 @@
-"""ASR task: Conformer-CTC (ref: lingvo/tasks/asr encoder/decoder stack).
+"""ASR tasks: Conformer-CTC and LAS (ref: lingvo/tasks/asr).
 
 Pipeline: (waveform -> log-mel | precomputed features) -> SpecAugment ->
-conv subsampling -> conformer stack -> CTC loss; greedy CTC decode + WER.
+conv subsampling -> conformer stack -> {CTC head | LAS attention decoder};
+greedy CTC / beam-search LAS decode + WER (ref `tasks/asr/model.py`,
+`tasks/asr/decoder.py`, `decoder_metrics.py`).
 """
 
 from __future__ import annotations
@@ -11,16 +13,40 @@ import jax.numpy as jnp
 import numpy as np
 
 from lingvo_tpu.core import base_model
-from lingvo_tpu.core import conformer_layer
 from lingvo_tpu.core import layers as layers_lib
-from lingvo_tpu.core import py_utils
-from lingvo_tpu.core import spectrum_augmenter
 from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.asr import decoder as las_decoder
 from lingvo_tpu.models.asr import decoder_metrics as dm
-from lingvo_tpu.models.asr import frontend as frontend_lib
+from lingvo_tpu.models.asr import encoder as encoder_lib
 
 
-class CtcAsrModel(base_model.BaseTask):
+class _AsrTaskBase(base_model.BaseTask):
+  """Shared encoder construction + WER decode metrics."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("encoder", encoder_lib.AsrConformerEncoder.Params(),
+             "Acoustic encoder.")
+    p.Define("vocab_size", 77, "Output vocab.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("encoder", self.p.encoder)
+
+  def _Encode(self, theta, input_batch):
+    return self.encoder.FProp(self.ChildTheta(theta, "encoder"), input_batch)
+
+  def CreateDecoderMetrics(self):
+    return {"wer": dm.WerMetric()}
+
+  def DecodeFinalize(self, decoder_metrics):
+    return {"wer": decoder_metrics["wer"].value,
+            "num_utterances": float(decoder_metrics["wer"].num_utterances)}
+
+
+class CtcAsrModel(_AsrTaskBase):
   """Conformer encoder + CTC head.
 
   Input batch: either waveform [b, samples] (+paddings) or features
@@ -28,83 +54,17 @@ class CtcAsrModel(base_model.BaseTask):
   tgt.paddings. Blank id = 0; label ids must be >= 1.
   """
 
-  @classmethod
-  def Params(cls):
-    p = super().Params()
-    p.Define("frontend", frontend_lib.MelAsrFrontend.Params(),
-             "Waveform frontend (unused when features are fed directly).")
-    p.Define("specaug", spectrum_augmenter.SpectrumAugmenter.Params(),
-             "SpecAugment.")
-    p.Define("input_dim", 80, "Feature dim.")
-    p.Define("model_dim", 256, "Conformer dim.")
-    p.Define("num_layers", 16, "Conformer depth.")
-    p.Define("num_heads", 4, "Attention heads.")
-    p.Define("kernel_size", 32, "LConv kernel.")
-    p.Define("vocab_size", 77, "Output vocab incl. blank at 0.")
-    p.Define("subsample_factor", 4, "Time subsampling (2 conv stride-2).")
-    p.Define("dropout_prob", 0.0, "Dropout.")
-    return p
-
   def __init__(self, params):
     super().__init__(params)
-    p = self.p
-    self.CreateChild("frontend", p.frontend)
-    self.CreateChild("specaug", p.specaug)
-    # conv subsampling: two stride-2 convs over time
-    self.CreateChild(
-        "sub1",
-        layers_lib.Conv2DLayer.Params().Set(
-            filter_shape=(3, 3, 1, 32), filter_stride=(2, 2),
-            activation="RELU", batch_norm=False, has_bias=True))
-    self.CreateChild(
-        "sub2",
-        layers_lib.Conv2DLayer.Params().Set(
-            filter_shape=(3, 3, 32, 32), filter_stride=(2, 2),
-            activation="RELU", batch_norm=False, has_bias=True))
-    # two SAME stride-2 convs: freq -> ceil(ceil(f/2)/2)
-    sub_freq = (p.input_dim + 1) // 2
-    sub_freq = (sub_freq + 1) // 2
-    self.CreateChild(
-        "input_proj",
-        layers_lib.ProjectionLayer.Params().Set(
-            input_dim=32 * sub_freq, output_dim=p.model_dim))
-    blocks = []
-    for _ in range(p.num_layers):
-      blocks.append(conformer_layer.ConformerLayer.Params().Set(
-          input_dim=p.model_dim, atten_num_heads=p.num_heads,
-          kernel_size=p.kernel_size, dropout_prob=p.dropout_prob))
-    self.CreateChildren("conformer", blocks)
     self.CreateChild(
         "ctc_proj",
         layers_lib.ProjectionLayer.Params().Set(
-            input_dim=p.model_dim, output_dim=p.vocab_size))
-
-  def _Encode(self, theta, input_batch):
-    p = self.p
-    if "features" in input_batch:
-      feats = input_batch.features
-      fpad = input_batch.Get("feature_paddings")
-      if fpad is None:
-        fpad = jnp.zeros(feats.shape[:2], jnp.float32)
-    else:
-      feats, fpad = self.frontend.FProp(
-          self.ChildTheta(theta, "frontend"), input_batch.waveform,
-          input_batch.Get("paddings"))
-    feats = self.specaug.FProp(self.ChildTheta(theta, "specaug"), feats,
-                               fpad)
-    x = feats[..., None]                     # [b, t, f, 1]
-    x, fpad = self.sub1.FProp(theta.sub1, x, fpad)
-    x, fpad = self.sub2.FProp(theta.sub2, x, fpad)
-    b, t = x.shape[0], x.shape[1]
-    x = x.reshape(b, t, -1)
-    x = self.input_proj.FProp(theta.input_proj, x)
-    for i, block in enumerate(self.conformer):
-      x = block.FProp(theta.conformer[i], x, fpad)
-    logits = self.ctc_proj.FProp(theta.ctc_proj, x)
-    return logits, fpad
+            input_dim=self.p.encoder.model_dim,
+            output_dim=self.p.vocab_size))
 
   def ComputePredictions(self, theta, input_batch):
-    logits, out_paddings = self._Encode(theta, input_batch)
+    x, out_paddings = self._Encode(theta, input_batch)
+    logits = self.ctc_proj.FProp(theta.ctc_proj, x)
     return NestedMap(logits=logits, paddings=out_paddings)
 
   def ComputeLoss(self, theta, predictions, input_batch):
@@ -122,17 +82,14 @@ class CtcAsrModel(base_model.BaseTask):
     return metrics, NestedMap(ctc=per_seq)
 
   def Decode(self, theta, input_batch):
-    logits, out_paddings = self._Encode(theta, input_batch)
+    predictions = self.ComputePredictions(theta, input_batch)
     # greedy CTC: argmax frames (blank=0), collapse repeats, drop blanks
-    frame_ids = jnp.argmax(logits, axis=-1)
-    frame_ids = jnp.where(out_paddings > 0.5, 0, frame_ids)
+    frame_ids = jnp.argmax(predictions.logits, axis=-1)
+    frame_ids = jnp.where(predictions.paddings > 0.5, 0, frame_ids)
     return NestedMap(
         frame_ids=frame_ids,
         target_ids=input_batch.tgt.ids,
         target_paddings=input_batch.tgt.paddings)
-
-  def CreateDecoderMetrics(self):
-    return {"wer": dm.WerMetric()}
 
   def PostProcessDecodeOut(self, decode_out, decoder_metrics):
     frames = np.asarray(decode_out.frame_ids)
@@ -149,6 +106,61 @@ class CtcAsrModel(base_model.BaseTask):
       ref = [int(x) for x in labels[i, :ref_len]]
       decoder_metrics["wer"].Update(ref, hyp)
 
-  def DecodeFinalize(self, decoder_metrics):
-    return {"wer": decoder_metrics["wer"].value,
-            "num_utterances": float(decoder_metrics["wer"].num_utterances)}
+
+class LasAsrModel(_AsrTaskBase):
+  """Conformer encoder + LAS attention decoder (ref `tasks/asr/decoder.py`;
+  the reference's Librispeech configs are LAS, `librispeech.py:156,239`).
+
+  Targets follow the teacher-forcing layout: tgt.ids sos-prefixed,
+  tgt.labels eos-suffixed, tgt.paddings over labels.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("decoder", las_decoder.LasDecoder.Params(), "LAS decoder.")
+    return p
+
+  def __init__(self, params):
+    p = params
+    p.decoder.vocab_size = p.vocab_size
+    p.decoder.source_dim = p.encoder.model_dim
+    super().__init__(p)
+    self.CreateChild("decoder", self.p.decoder)
+
+  def ComputePredictions(self, theta, input_batch):
+    encoded, enc_paddings = self._Encode(theta, input_batch)
+    logits = self.decoder.ComputeLogits(
+        self.ChildTheta(theta, "decoder"), encoded, enc_paddings,
+        input_batch.tgt.ids)
+    return NestedMap(logits=logits)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    loss, acc, tot = self.decoder.ComputeLoss(
+        self.ChildTheta(theta, "decoder"), predictions.logits,
+        input_batch.tgt)
+    num_seqs = float(input_batch.tgt.ids.shape[0])
+    metrics = NestedMap(loss=(loss, num_seqs), accuracy=(acc, tot))
+    return metrics, NestedMap()
+
+  def Decode(self, theta, input_batch):
+    encoded, enc_paddings = self._Encode(theta, input_batch)
+    hyps = self.decoder.BeamSearchDecode(
+        self.ChildTheta(theta, "decoder"), encoded, enc_paddings)
+    return NestedMap(
+        topk_ids=hyps.topk_ids, topk_lens=hyps.topk_lens,
+        topk_scores=hyps.topk_scores,
+        target_labels=input_batch.tgt.labels,
+        target_paddings=input_batch.tgt.paddings)
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    eos = self.p.decoder.target_eos_id
+    best = np.asarray(decode_out.topk_ids)[:, 0]          # [B, T]
+    lens = np.asarray(decode_out.topk_lens)[:, 0]
+    labels = np.asarray(decode_out.target_labels)
+    lpads = np.asarray(decode_out.target_paddings)
+    for i in range(best.shape[0]):
+      hyp = [int(x) for x in best[i, :int(lens[i])] if x != eos]
+      ref_len = int((1.0 - lpads[i]).sum())
+      ref = [int(x) for x in labels[i, :ref_len] if x != eos]
+      decoder_metrics["wer"].Update(ref, hyp)

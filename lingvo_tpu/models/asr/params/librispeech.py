@@ -38,12 +38,12 @@ class Librispeech960ConformerCtc(base_model_params.SingleTaskModelParams):
   def Task(self):
     p = asr_model.CtcAsrModel.Params()
     p.name = "librispeech_ctc"
-    p.input_dim = self.NUM_BINS
-    p.model_dim = self.MODEL_DIM
-    p.num_layers = self.NUM_LAYERS
-    p.num_heads = self.NUM_HEADS
+    p.encoder.input_dim = self.NUM_BINS
+    p.encoder.model_dim = self.MODEL_DIM
+    p.encoder.num_layers = self.NUM_LAYERS
+    p.encoder.num_heads = self.NUM_HEADS
     p.vocab_size = self.VOCAB
-    p.dropout_prob = 0.1
+    p.encoder.dropout_prob = 0.1
     p.train.learner = learner_lib.Learner.Params().Set(
         learning_rate=2.0,
         optimizer=opt_lib.AdamW.Params().Set(beta2=0.98, weight_decay=1e-6),
@@ -67,10 +67,82 @@ class LibrispeechConformerCtcTiny(Librispeech960ConformerCtc):
 
   def Task(self):
     p = super().Task()
-    p.kernel_size = 8
-    p.dropout_prob = 0.0
-    p.specaug.freq_mask_max_bins = 4
-    p.specaug.time_mask_max_frames = 8
+    p.encoder.kernel_size = 8
+    p.encoder.dropout_prob = 0.0
+    p.encoder.specaug.freq_mask_max_bins = 4
+    p.encoder.specaug.time_mask_max_frames = 8
+    p.train.learner.learning_rate = 2e-3
+    p.train.learner.lr_schedule = sched_lib.Constant.Params()
+    p.train.tpu_steps_per_loop = 20
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class Librispeech960GraphemeLas(base_model_params.SingleTaskModelParams):
+  """Grapheme LAS (ref `librispeech.py:156` Librispeech960Grapheme — the
+  reference's Librispeech configs are LAS attention models; conformer
+  encoder + location-sensitive-attention LSTM decoder here)."""
+
+  BATCH_SIZE = 16
+  NUM_BINS = 80
+  MODEL_DIM = 256
+  NUM_LAYERS = 16
+  NUM_HEADS = 4
+  VOCAB = 77
+
+  def Train(self):
+    return input_generator.SyntheticAsrInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_bins=self.NUM_BINS,
+        vocab_size=min(self.VOCAB, 30), teacher_forcing=True)
+
+  def Test(self):
+    return input_generator.SyntheticAsrInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_bins=self.NUM_BINS,
+        vocab_size=min(self.VOCAB, 30), teacher_forcing=True, seed=99)
+
+  def Task(self):
+    p = asr_model.LasAsrModel.Params()
+    p.name = "librispeech_las"
+    p.vocab_size = self.VOCAB
+    p.encoder.input_dim = self.NUM_BINS
+    p.encoder.model_dim = self.MODEL_DIM
+    p.encoder.num_layers = self.NUM_LAYERS
+    p.encoder.num_heads = self.NUM_HEADS
+    p.encoder.dropout_prob = 0.1
+    p.decoder.rnn_cell_dim = self.MODEL_DIM
+    p.decoder.beam_search.target_seq_len = 24
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=2.0,
+        optimizer=opt_lib.AdamW.Params().Set(beta2=0.98, weight_decay=1e-6),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=10000, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=1.0)
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class LibrispeechLasTiny(Librispeech960GraphemeLas):
+  """Smoke-test scale LAS."""
+
+  BATCH_SIZE = 4
+  NUM_BINS = 16
+  MODEL_DIM = 32
+  NUM_LAYERS = 2
+  NUM_HEADS = 2
+  VOCAB = 30
+
+  def Task(self):
+    p = super().Task()
+    p.encoder.kernel_size = 8
+    p.encoder.dropout_prob = 0.0
+    p.encoder.specaug.freq_mask_max_bins = 4
+    p.encoder.specaug.time_mask_max_frames = 8
+    p.decoder.emb_dim = 32
+    p.decoder.num_rnn_layers = 1
+    p.decoder.attention.hidden_dim = 32
+    p.decoder.beam_search.target_seq_len = 14
+    p.decoder.beam_search.num_hyps_per_beam = 4
     p.train.learner.learning_rate = 2e-3
     p.train.learner.lr_schedule = sched_lib.Constant.Params()
     p.train.tpu_steps_per_loop = 20

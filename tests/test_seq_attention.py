@@ -1,0 +1,191 @@
+"""Seq2seq attention family + LAS decoder tests (VERDICT r1 item 5; ref
+attention.py:547/1015/2334/2900/3267/3608 and tasks/asr/decoder.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import beam_search as beam_search_lib
+from lingvo_tpu.core import seq_attention
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(3)
+B, T, DS, DQ, H = 2, 10, 12, 8, 16
+
+
+def _packed(atten, theta, paddings=None):
+  src = jax.random.normal(KEY, (B, T, DS))
+  pads = paddings if paddings is not None else jnp.zeros((B, T))
+  return atten.PackSource(theta, src, pads), src
+
+
+def _make(cls, **kw):
+  p = cls.Params().Set(name="att", source_dim=DS, query_dim=DQ, hidden_dim=H,
+                       **kw)
+  att = p.Instantiate()
+  return att, att.InstantiateVariables(KEY)
+
+
+class TestAttentionFamily:
+
+  @pytest.mark.parametrize("cls", [
+      seq_attention.AdditiveAttention,
+      seq_attention.DotProductAttention,
+      seq_attention.LocationSensitiveAttention,
+      seq_attention.MonotonicAttention,
+      seq_attention.GmmMonotonicAttention,
+  ])
+  def test_shapes_and_prob_simplex(self, cls):
+    att, theta = _make(cls)
+    packed, _ = _packed(att, theta)
+    state = att.ZeroAttentionState(B, T)
+    q = jax.random.normal(KEY, (B, DQ))
+    ctx, probs, state2 = att.ComputeContextVector(theta, packed, q, state)
+    assert ctx.shape == (B, DS)
+    assert probs.shape == (B, T)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, atol=1e-4)
+    # state must be scan-compatible: same structure and shapes
+    assert jax.tree_util.tree_structure(state) == \
+        jax.tree_util.tree_structure(state2)
+
+  @pytest.mark.parametrize("cls", [
+      seq_attention.AdditiveAttention,
+      seq_attention.LocationSensitiveAttention,
+  ])
+  def test_respects_source_paddings(self, cls):
+    att, theta = _make(cls)
+    pads = jnp.zeros((B, T)).at[:, 6:].set(1.0)
+    packed, _ = _packed(att, theta, paddings=pads)
+    state = att.ZeroAttentionState(B, T)
+    q = jax.random.normal(KEY, (B, DQ))
+    _, probs, _ = att.ComputeContextVector(theta, packed, q, state)
+    np.testing.assert_allclose(np.asarray(probs[:, 6:]).sum(), 0.0,
+                               atol=1e-6)
+
+  def test_location_sensitive_state_advances(self):
+    att, theta = _make(seq_attention.LocationSensitiveAttention)
+    packed, _ = _packed(att, theta)
+    state = att.ZeroAttentionState(B, T)
+    q = jax.random.normal(KEY, (B, DQ))
+    _, probs1, state = att.ComputeContextVector(theta, packed, q, state)
+    # the conv features see probs1 now — state must carry them
+    np.testing.assert_allclose(np.asarray(state.prev_probs),
+                               np.asarray(probs1), atol=1e-6)
+    assert float(state.cum_probs.sum()) > float(probs1.sum()) - 1e-4
+
+  def test_monotonic_alignment_moves_forward(self):
+    att, theta = _make(seq_attention.MonotonicAttention)
+    packed, _ = _packed(att, theta)
+    state = att.ZeroAttentionState(B, T)
+    pos = jnp.arange(T, dtype=jnp.float32)[None, :]
+    centers = []
+    q = jax.random.normal(KEY, (B, DQ))
+    for _ in range(4):
+      _, probs, state = att.ComputeContextVector(theta, packed, q, state)
+      centers.append(float((probs * pos).sum(-1).mean()))
+    # expected position is non-decreasing (monotonicity)
+    assert all(b >= a - 1e-4 for a, b in zip(centers, centers[1:])), centers
+
+  def test_gmm_means_move_forward(self):
+    att, theta = _make(seq_attention.GmmMonotonicAttention)
+    packed, _ = _packed(att, theta)
+    state = att.ZeroAttentionState(B, T)
+    q = jax.random.normal(KEY, (B, DQ))
+    _, _, s1 = att.ComputeContextVector(theta, packed, q, state)
+    _, _, s2 = att.ComputeContextVector(theta, packed, q, s1)
+    assert np.all(np.asarray(s2.mu) > np.asarray(s1.mu) - 1e-6)
+
+  def test_merger_ops(self):
+    ctxs = [jnp.ones((B, 4)), 3.0 * jnp.ones((B, 4))]
+    for op, expect in [("mean", 2.0), ("sum", 4.0)]:
+      m = seq_attention.MergerLayer.Params().Set(
+          name="m", merger_op=op).Instantiate()
+      out = m.FProp(NestedMap(), ctxs)
+      np.testing.assert_allclose(np.asarray(out), expect, atol=1e-6)
+    m = seq_attention.MergerLayer.Params().Set(
+        name="m", merger_op="concat").Instantiate()
+    assert m.FProp(NestedMap(), ctxs).shape == (B, 8)
+    p = seq_attention.MergerLayer.Params().Set(
+        name="m", merger_op="weighted_sum", num_sources=2, source_dim=4)
+    m = p.Instantiate()
+    theta = m.InstantiateVariables(KEY)
+    out = m.FProp(theta, ctxs)
+    np.testing.assert_allclose(np.asarray(out), 2.0, atol=1e-5)  # equal init
+
+  def test_multi_source_attention(self):
+    p = seq_attention.MultiSourceAttention.Params().Set(name="ms")
+    p.source_atten_tpls = [
+        ("audio", seq_attention.AdditiveAttention.Params().Set(
+            source_dim=DS, query_dim=DQ, hidden_dim=H)),
+        ("video", seq_attention.DotProductAttention.Params().Set(
+            source_dim=DS, query_dim=DQ, hidden_dim=H)),
+    ]
+    ms = p.Instantiate()
+    theta = ms.InstantiateVariables(KEY)
+    sources = NestedMap(audio=jax.random.normal(KEY, (B, T, DS)),
+                        video=jax.random.normal(KEY, (B, 6, DS)))
+    pads = NestedMap(audio=jnp.zeros((B, T)), video=jnp.zeros((B, 6)))
+    packed = ms.PackSource(theta, sources, pads)
+    state = ms.ZeroAttentionState(B, {"audio": T, "video": 6})
+    ctx, probs, state2 = ms.ComputeContextVector(
+        theta, packed, jax.random.normal(KEY, (B, DQ)), state)
+    assert ctx.shape == (B, DS)
+    assert probs.shape == (B, T)
+
+
+class TestCoveragePenalty:
+
+  def test_coverage_penalty_changes_ranking_inputs(self):
+    """Beam search accepts a 3-output step_fn and applies the penalty."""
+    vocab, src_len = 8, 5
+
+    def _step(states, ids):
+      b = ids.shape[0]
+      logits = jnp.tile(
+          jnp.log(jnp.arange(1, vocab + 1, dtype=jnp.float32))[None],
+          (b, 1))
+      # attention always on frame 0 -> poor coverage
+      atten = jnp.zeros((b, src_len)).at[:, 0].set(1.0)
+      return logits, states, atten
+
+    p = beam_search_lib.BeamSearchHelper.Params().Set(
+        num_hyps_per_beam=2, target_seq_len=4, coverage_penalty=0.0)
+    res0 = p.Instantiate().Search(
+        1, NestedMap(x=jnp.zeros((2, 1))), _step, src_len=src_len)
+    p2 = p.Copy().Set(coverage_penalty=0.5)
+    res1 = p2.Instantiate().Search(
+        1, NestedMap(x=jnp.zeros((2, 1))), _step, src_len=src_len,
+        src_paddings=jnp.zeros((1, src_len)))
+    # same ids, but scores now include the (negative) coverage term
+    np.testing.assert_array_equal(np.asarray(res0.topk_ids),
+                                  np.asarray(res1.topk_ids))
+    assert float(res1.topk_scores[0, 0]) < float(res0.topk_scores[0, 0])
+
+
+class TestLasModel:
+
+  def test_las_trains_and_decodes_wer(self):
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    mp = model_registry.GetParams("asr.librispeech.LibrispeechLasTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    step = jax.jit(task.TrainStep)
+    losses = []
+    for _ in range(15):
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    assert dec.topk_ids.shape[1] == 4  # beam width
+    metrics = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(
+        jax.tree_util.tree_map(np.asarray, dec), metrics)
+    result = task.DecodeFinalize(metrics)
+    assert "wer" in result and result["num_utterances"] == 4.0
