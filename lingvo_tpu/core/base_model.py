@@ -51,6 +51,14 @@ class BaseTask(base_layer.BaseLayer):
     tp.Define("early_stop_metric", "loss", "Eval metric to watch.")
     tp.Define("early_stop_program", "eval_test",
               "Which eval program's results feed the plateau detector.")
+    tp.Define("init_from_checkpoint_rules", {},
+              "Warm start (ref checkpointer.py:214): "
+              "{ckpt_train_dir: [(target_var_regex, source_var_template), "
+              "...]} — on fresh init, theta leaves whose path matches a "
+              "target regex are loaded from the source checkpoint's var at "
+              "re.sub(target_regex, source_template, path), with dtype "
+              "casting (ref bfloat16_variables.py). Applied only when no "
+              "checkpoint exists in the run's own train dir.")
     p.Define("train", tp, "Training hyperparams.")
     ep = hyperparams.Params()
     ep.Define("samples_per_summary", 1000, "Max eval examples per run.")

@@ -100,6 +100,10 @@ class BeamSearchHelper:
       if len(step_out) == 3:
         log_probs, new_states, atten_probs = step_out
       else:
+        assert p.coverage_penalty == 0.0, (
+            "coverage_penalty > 0 needs a step_fn returning "
+            "(log_probs, new_states, atten_probs); got a 2-tuple — the "
+            "penalty would silently corrupt every hyp score")
         log_probs, new_states = step_out
         atten_probs = None
       vocab = log_probs.shape[-1]

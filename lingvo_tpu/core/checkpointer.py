@@ -121,3 +121,63 @@ class Checkpointer:
   def Close(self) -> None:
     self._mgr.wait_until_finished()
     self._mgr.close()
+
+
+def ApplyInitFromCheckpointRules(state: NestedMap, rules: dict) -> NestedMap:
+  """Warm-start partial restore with regex var mapping + dtype casting.
+
+  Re-designs `_BuildInitFromCheckpointRules` (ref `checkpointer.py:214`) +
+  `bfloat16_variables.py`: `rules` maps a source checkpoint's *train dir*
+  to a list of (target_regex, source_template) pairs. Every `state.theta`
+  leaf whose path fully matches a target regex is replaced by the source
+  checkpoint's variable at `re.sub(target_regex, source_template, path)`,
+  cast to the target dtype. Shapes must match; a matching rule whose source
+  variable is missing raises (silent partial warm starts hide config bugs).
+
+  Returns the updated state (step untouched — warm start is initialization,
+  not resumption).
+  """
+  import re
+
+  import jax.numpy as jnp
+  import orbax.checkpoint as ocp
+
+  def _ToNested(node):
+    if isinstance(node, dict):
+      return NestedMap({k: _ToNested(v) for k, v in node.items()})
+    return node
+
+  for ckpt_dir, pairs in rules.items():
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    try:
+      src_step = mgr.latest_step()
+      if src_step is None:
+        raise FileNotFoundError(
+            f"init_from_checkpoint_rules: no checkpoint in {ckpt_dir}")
+      restored = mgr.restore(src_step)  # numpy tree, as saved
+      src_theta = _ToNested(dict(restored)["theta"])
+      src_flat = dict(src_theta.FlattenItems())
+      n_loaded = 0
+      for path, value in state.theta.FlattenItems():
+        for target_regex, source_tpl in pairs:
+          if re.fullmatch(target_regex, path):
+            src_path = re.sub(target_regex, source_tpl, path)
+            if src_path not in src_flat:
+              raise KeyError(
+                  f"init_from_checkpoint_rules: {path!r} matched "
+                  f"{target_regex!r} but source var {src_path!r} is not in "
+                  f"{ckpt_dir} (has {len(src_flat)} vars)")
+            src_val = src_flat[src_path]
+            if tuple(np.shape(src_val)) != tuple(np.shape(value)):
+              raise ValueError(
+                  f"init_from_checkpoint_rules: shape mismatch for {path}: "
+                  f"{np.shape(value)} vs source {np.shape(src_val)}")
+            state.theta.Set(
+                path, jnp.asarray(src_val, dtype=value.dtype))
+            n_loaded += 1
+            break  # first matching rule wins
+      print(f"[checkpointer] warm start: {n_loaded} vars from {ckpt_dir} "
+            f"@ step {src_step}", flush=True)
+    finally:
+      mgr.close()
+  return state

@@ -1,0 +1,138 @@
+"""Summaries: TensorBoard event files, attention images, step-rate tracking.
+
+Re-designs `lingvo/core/summary_utils.py` (job-gated scalar/histogram/image
+summaries, `AddAttentionSummary:157`, `StepRateTracker:393`) for the JAX
+stack: a thin writer over tensorboardX event files (always paired with the
+machine-readable JSONL the programs already emit), image summaries rendered
+from attention probability tensors without a plotting dependency, and a
+steps/sec + examples/sec tracker.
+
+Summary writing is gated by the cluster role (ref `cluster.add_summary`,
+`cluster.py:144-146`): follower eval/decode jobs write to their own
+subdirectories, so one TensorBoard run shows train + eval curves side by
+side.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class SummaryWriter:
+  """Event-file writer; falls back to no-op when tensorboardX is missing."""
+
+  def __init__(self, logdir: str, enabled: bool = True):
+    self._writer = None
+    self._enabled = enabled
+    self._logdir = logdir
+    if not enabled:
+      return
+    try:
+      from tensorboardX import SummaryWriter as TbWriter
+      self._writer = TbWriter(logdir=logdir)
+    except Exception:  # pragma: no cover - tensorboardX present in CI
+      self._writer = None
+
+  @property
+  def enabled(self) -> bool:
+    return self._writer is not None
+
+  def Scalar(self, tag: str, value, step: int):
+    if self._writer is not None:
+      self._writer.add_scalar(tag, float(value), step)
+
+  def Scalars(self, values: dict, step: int, prefix: str = ""):
+    for k, v in values.items():
+      if isinstance(v, (int, float, np.floating, np.integer)):
+        self.Scalar(f"{prefix}{k}" if prefix else k, v, step)
+
+  def Histogram(self, tag: str, values, step: int):
+    if self._writer is not None:
+      self._writer.add_histogram(tag, np.asarray(values), step)
+
+  def Image(self, tag: str, image_hwc, step: int):
+    """image_hwc: [H, W, C] float in [0, 1] or uint8."""
+    if self._writer is not None:
+      img = np.asarray(image_hwc)
+      if img.dtype != np.uint8:
+        img = (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+      self._writer.add_image(tag, img, step, dataformats="HWC")
+
+  def Text(self, tag: str, text: str, step: int):
+    if self._writer is not None:
+      self._writer.add_text(tag, text, step)
+
+  def Flush(self):
+    if self._writer is not None:
+      self._writer.flush()
+
+  def Close(self):
+    if self._writer is not None:
+      self._writer.close()
+      self._writer = None
+
+
+def AttentionProbsToImage(probs) -> np.ndarray:
+  """[T_query, T_source] probs -> [T_query, T_source, 3] heatmap in [0,1].
+
+  Dependency-free rendering (ref `AddAttentionSummary:157` / `plot.py`, which
+  route through matplotlib): intensity-normalized viridis-ish ramp.
+  """
+  p = np.asarray(probs, np.float32)
+  p = p / max(float(p.max()), 1e-8)
+  # simple two-anchor color ramp: dark blue -> yellow
+  lo = np.array([0.07, 0.0, 0.33], np.float32)
+  hi = np.array([0.99, 0.91, 0.14], np.float32)
+  return lo[None, None] + p[..., None] * (hi - lo)[None, None]
+
+
+def AddAttentionSummary(writer: SummaryWriter, name: str, probs, step: int,
+                        max_entries: int = 4):
+  """Writes attention-prob images (ref summary_utils.AddAttentionSummary:157).
+
+  probs: [B, T, S] or [B, N, T, S] (first head is rendered).
+  """
+  if not writer.enabled:
+    return
+  p = np.asarray(probs)
+  if p.ndim == 4:
+    p = p[:, 0]
+  for i in range(min(p.shape[0], max_entries)):
+    writer.Image(f"{name}/{i}", AttentionProbsToImage(p[i]), step)
+
+
+class StepRateTracker:
+  """steps/sec + examples/sec with decaying window (ref StepRateTracker:393)."""
+
+  def __init__(self):
+    self._start = None
+    self._last_step = 0
+    self._rate = 0.0
+    self._example_rate = 0.0
+
+  def Update(self, step: int, examples_per_step: float = 0.0):
+    now = time.time()
+    if self._start is None:
+      self._start = now
+      self._last_step = step
+      return self._rate
+    dt = max(now - self._start, 1e-6)
+    steps = step - self._last_step
+    inst = steps / dt
+    # exponential decay toward the instantaneous rate (windowed smoothing)
+    blend = 0.5 if self._rate else 1.0
+    self._rate = blend * inst + (1 - blend) * self._rate
+    self._example_rate = self._rate * examples_per_step
+    self._start = now
+    self._last_step = step
+    return self._rate
+
+  @property
+  def steps_per_second(self) -> float:
+    return self._rate
+
+  @property
+  def examples_per_second(self) -> float:
+    return self._example_rate

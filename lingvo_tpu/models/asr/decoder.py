@@ -98,18 +98,19 @@ class LasDecoder(base_layer.BaseLayer):
 
   # -- training --------------------------------------------------------------
   def ComputeLogits(self, theta, encoded, enc_paddings, tgt_ids):
-    """Teacher forcing: tgt_ids [B, T] (sos-prefixed) -> logits [B, T, V]."""
+    """Teacher forcing: tgt_ids [B, T] (sos-prefixed) ->
+    (logits [B, T, V], atten_probs [B, T, T_src])."""
     b, t = tgt_ids.shape
     packed = self.atten.PackSource(
         self.ChildTheta(theta, "atten"), encoded, enc_paddings)
     states0 = self._InitStates(theta, b, encoded.shape[1])
 
     def _Body(states, ids_t):
-      logits, _, new_states = self._Step(theta, packed, ids_t, states)
-      return new_states, logits
+      logits, probs, new_states = self._Step(theta, packed, ids_t, states)
+      return new_states, (logits, probs)
 
-    _, logits = jax.lax.scan(_Body, states0, tgt_ids.swapaxes(0, 1))
-    return logits.swapaxes(0, 1)                          # [B, T, V]
+    _, (logits, probs) = jax.lax.scan(_Body, states0, tgt_ids.swapaxes(0, 1))
+    return logits.swapaxes(0, 1), probs.swapaxes(0, 1)    # [B,T,V], [B,T,S]
 
   def ComputeLoss(self, theta, logits, tgt):
     """Smoothed xent against tgt.labels with tgt.paddings weighting."""

@@ -119,6 +119,10 @@ class LasAsrModel(_AsrTaskBase):
   def Params(cls):
     p = super().Params()
     p.Define("decoder", las_decoder.LasDecoder.Params(), "LAS decoder.")
+    p.Define("alignment_summaries", False,
+             "Also compute forced-alignment attention during Decode "
+             "(rendered as images by DecodeProgram). Costs one extra "
+             "teacher-forcing scan per decode batch — diagnostics only.")
     return p
 
   def __init__(self, params):
@@ -130,10 +134,10 @@ class LasAsrModel(_AsrTaskBase):
 
   def ComputePredictions(self, theta, input_batch):
     encoded, enc_paddings = self._Encode(theta, input_batch)
-    logits = self.decoder.ComputeLogits(
+    logits, atten_probs = self.decoder.ComputeLogits(
         self.ChildTheta(theta, "decoder"), encoded, enc_paddings,
         input_batch.tgt.ids)
-    return NestedMap(logits=logits)
+    return NestedMap(logits=logits, atten_probs=atten_probs)
 
   def ComputeLoss(self, theta, predictions, input_batch):
     loss, acc, tot = self.decoder.ComputeLoss(
@@ -147,11 +151,18 @@ class LasAsrModel(_AsrTaskBase):
     encoded, enc_paddings = self._Encode(theta, input_batch)
     hyps = self.decoder.BeamSearchDecode(
         self.ChildTheta(theta, "decoder"), encoded, enc_paddings)
-    return NestedMap(
+    out = NestedMap(
         topk_ids=hyps.topk_ids, topk_lens=hyps.topk_lens,
         topk_scores=hyps.topk_scores,
         target_labels=input_batch.tgt.labels,
         target_paddings=input_batch.tgt.paddings)
+    if self.p.alignment_summaries:
+      # forced-alignment attention on the reference targets: the classic
+      # LAS alignment diagnostic (rendered as images by DecodeProgram)
+      _, out.atten_probs = self.decoder.ComputeLogits(
+          self.ChildTheta(theta, "decoder"), encoded, enc_paddings,
+          input_batch.tgt.ids)
+    return out
 
   def PostProcessDecodeOut(self, decode_out, decoder_metrics):
     eos = self.p.decoder.target_eos_id

@@ -122,6 +122,12 @@ class ExecutorTpu:
     """
     state = self._CreateTrainState()
     state, start_step = self._checkpointer.Restore(state)
+    if start_step == 0 and self._task is not None:
+      rules = getattr(self._task.p.train, "init_from_checkpoint_rules", None)
+      if rules:
+        # fresh run: warm-start matching vars from other checkpoints
+        # (ref checkpointer.py:214); resumed runs skip this.
+        state = checkpointer_lib.ApplyInitFromCheckpointRules(state, rules)
     if self._precompile and self._schedule is not None:
       for prog in self._schedule.programs:
         prog.Compile(state)

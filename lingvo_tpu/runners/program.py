@@ -42,6 +42,12 @@ class BaseProgram:
     p.Define("input_sharding", None, "PartitionSpec for input batches.")
     p.Define("state_sharding_fn", None,
              "fn(state_template)->sharding pytree (pjit).")
+    p.Define("write_tensorboard", True,
+             "Write TensorBoard event files next to the JSONL summaries.")
+    p.Define("profiler_capture_every_n_runs", 0,
+             "If >0, wrap every Nth Run() in a jax.profiler trace written "
+             "to <program_dir>/plugins/profile (SURVEY §5: profiling is "
+             "first-class; view in XProf/TensorBoard).")
     return p
 
   def __init__(self, params, task=None, input_generator=None):
@@ -52,6 +58,11 @@ class BaseProgram:
                                      self.p.name or type(self).__name__)
     os.makedirs(self._program_dir, exist_ok=True)
     self._step_fn = None
+    self._run_count = 0
+    from lingvo_tpu.core import summary_utils
+    self._tb = summary_utils.SummaryWriter(
+        self._program_dir, enabled=self.p.write_tensorboard)
+    self._rate_tracker = summary_utils.StepRateTracker()
 
   @property
   def task(self):
@@ -107,6 +118,17 @@ class BaseProgram:
     path = os.path.join(self._program_dir, "summaries.jsonl")
     with open(path, "a") as f:
       f.write(json.dumps({"step": step, **values}) + "\n")
+    self._tb.Scalars(values, step)
+    self._tb.Flush()
+
+  def _ProfilerScope(self):
+    """jax.profiler trace around every Nth Run (program option)."""
+    import contextlib
+    n = self.p.profiler_capture_every_n_runs
+    self._run_count += 1
+    if n > 0 and self._run_count % n == 0:
+      return jax.profiler.trace(self._program_dir)
+    return contextlib.nullcontext()
 
 
 class TrainProgram(BaseProgram):
@@ -149,7 +171,7 @@ class TrainProgram(BaseProgram):
     acc = None
     stats_acc = None
     t0 = time.time()
-    with self._MeshScope():
+    with self._MeshScope(), self._ProfilerScope():
       for _ in range(p.steps_per_loop):
         batch = self._PutBatch(
             self.input_generator.GetPreprocessedInputBatch())
@@ -158,8 +180,9 @@ class TrainProgram(BaseProgram):
         stats_pairs = NestedMap(
             {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
         stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
-    # One host sync per loop (ref: one session.run per steps_per_loop).
-    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+      # One host sync per loop (ref: one session.run per steps_per_loop);
+      # inside the profiler scope so traces capture the device work.
+      jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
     wall = time.time() - t0
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     if stats_acc:
@@ -168,6 +191,9 @@ class TrainProgram(BaseProgram):
     result["examples_per_second"] = (
         p.steps_per_loop * self.input_generator.GlobalBatchSize() / wall)
     step = int(jax.device_get(state.step))
+    # smoothed cross-Run rate incl. eval gaps (ref StepRateTracker:393)
+    result["global_steps_per_second"] = self._rate_tracker.Update(
+        step, self.input_generator.GlobalBatchSize())
     self.WriteSummaries(step, result)
     return state, result
 
@@ -217,7 +243,7 @@ class EvalProgram(BaseProgram):
     batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
                else _TakeN(gen, max_batches))
     n = 0
-    with self._MeshScope():
+    with self._MeshScope(), self._ProfilerScope():
       for batch in batches:
         out = fn(theta, self._PutBatch(batch))
         acc = metrics_lib.AccumulateMetrics(acc, out)
@@ -262,10 +288,17 @@ class DecodeProgram(BaseProgram):
     batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
                else _TakeN(gen, self.p.steps_per_loop))
     n = 0
-    with self._MeshScope():
+    with self._MeshScope(), self._ProfilerScope():
       for batch in batches:
         out = fn(theta, self._PutBatch(batch))
         host_out = jax.tree_util.tree_map(np.asarray, out)
+        if n == 0 and isinstance(host_out, NestedMap):
+          probs = host_out.Get("atten_probs")
+          if probs is not None:
+            from lingvo_tpu.core import summary_utils
+            summary_utils.AddAttentionSummary(
+                self._tb, f"{self.p.name}/atten", probs,
+                int(jax.device_get(state.step)))
         self._task.PostProcessDecodeOut(host_out, dec_metrics)
         n += 1
         if n >= self.p.steps_per_loop:

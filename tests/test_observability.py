@@ -1,0 +1,180 @@
+"""TensorBoard summaries, profiler capture, warm-start rules (VERDICT r1
+items 7 & 8; ref summary_utils.py, jax.profiler, checkpointer.py:214)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import checkpointer as checkpointer_lib
+from lingvo_tpu.core import summary_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TestSummaryWriter:
+
+  def test_event_files_written(self, tmp_path):
+    w = summary_utils.SummaryWriter(str(tmp_path))
+    assert w.enabled
+    w.Scalar("loss", 1.25, step=10)
+    w.Scalars({"a": 1.0, "b": 2}, step=20, prefix="train/")
+    w.Histogram("weights", np.random.randn(100), step=10)
+    w.Image("img", np.random.rand(8, 8, 3), step=10)
+    w.Text("note", "hello", step=10)
+    w.Close()
+    events = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert events, os.listdir(tmp_path)
+    assert os.path.getsize(events[0]) > 100
+
+  def test_attention_summary(self, tmp_path):
+    w = summary_utils.SummaryWriter(str(tmp_path))
+    probs = jax.nn.softmax(jnp.ones((3, 2, 6, 9)), axis=-1)  # [B,N,T,S]
+    summary_utils.AddAttentionSummary(w, "atten", probs, step=5)
+    w.Close()
+    assert glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    img = summary_utils.AttentionProbsToImage(np.asarray(probs[0, 0]))
+    assert img.shape == (6, 9, 3)
+    assert img.min() >= 0.0 and img.max() <= 1.0
+
+  def test_step_rate_tracker(self):
+    tracker = summary_utils.StepRateTracker()
+    tracker.Update(0)
+    import time
+    time.sleep(0.05)
+    rate = tracker.Update(10, examples_per_step=32)
+    assert rate > 0
+    assert tracker.examples_per_second > rate  # 32x examples per step
+
+
+class TestProgramObservability:
+
+  def _run(self, tmp_path, **program_overrides):
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    from lingvo_tpu.runners import program as program_lib
+
+    mp = model_registry.GetParams("image.mnist.LeNet5", "Train")
+    mp.task.input = mp.input
+    mp.task.input.batch_size = 8
+    mp.task.input.num_samples = 64
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    tp = program_lib.TrainProgram.Params().Set(
+        task=mp.task, logdir=str(tmp_path), steps_per_loop=3,
+        **program_overrides)
+    prog = program_lib.TrainProgram(tp, task=task,
+                                    input_generator=mp.input.Instantiate())
+    state, result = prog.Run(state)
+    return result
+
+  def test_train_program_writes_tensorboard(self, tmp_path):
+    result = self._run(tmp_path)
+    assert "loss" in result
+    assert glob.glob(str(tmp_path / "train" / "events.out.tfevents.*"))
+
+  def test_profiler_capture(self, tmp_path):
+    self._run(tmp_path, profiler_capture_every_n_runs=1)
+    # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (+ .xplane.pb)
+    traces = glob.glob(
+        str(tmp_path / "train" / "plugins" / "profile" / "*" / "*"))
+    assert traces, "no profiler trace captured"
+
+
+class TestWarmStartRules:
+
+  def test_regex_mapped_partial_restore(self, tmp_path):
+    """Restore an LM's embedding into a differently-named target by rule."""
+    src_dir = tmp_path / "src" / "train"
+    # source "model": theta with two vars
+    src_state = NestedMap(
+        theta=NestedMap(
+            emb=NestedMap(w=jnp.arange(12, dtype=jnp.float32).reshape(3, 4)),
+            head=NestedMap(w=jnp.ones((4, 2)))),
+        step=jnp.asarray(7, jnp.int32))
+    ckpt = checkpointer_lib.Checkpointer(str(src_dir))
+    ckpt.Save(7, src_state, force=True)
+    ckpt.Close()
+
+    # target model: same embedding under another path, bf16 dtype
+    target = NestedMap(
+        theta=NestedMap(
+            encoder=NestedMap(
+                tok_emb=NestedMap(
+                    w=jnp.zeros((3, 4), jnp.bfloat16))),
+            other=NestedMap(w=jnp.full((2, 2), 5.0))),
+        step=jnp.asarray(0, jnp.int32))
+    rules = {str(src_dir): [(r"encoder\.tok_emb\.(.*)", r"emb.\1")]}
+    out = checkpointer_lib.ApplyInitFromCheckpointRules(target, rules)
+    got = np.asarray(out.theta.encoder.tok_emb.w, np.float32)
+    np.testing.assert_allclose(got, np.arange(12).reshape(3, 4), atol=1e-2)
+    assert out.theta.encoder.tok_emb.w.dtype == jnp.bfloat16  # dtype cast
+    np.testing.assert_allclose(np.asarray(out.theta.other.w), 5.0)  # untouched
+    assert int(out.step) == 0  # warm start is not resumption
+
+  def test_missing_source_var_raises(self, tmp_path):
+    src_dir = tmp_path / "src" / "train"
+    ckpt = checkpointer_lib.Checkpointer(str(src_dir))
+    ckpt.Save(1, NestedMap(theta=NestedMap(a=jnp.zeros(2)),
+                           step=jnp.asarray(1)), force=True)
+    ckpt.Close()
+    target = NestedMap(theta=NestedMap(b=jnp.zeros(2)), step=jnp.asarray(0))
+    with pytest.raises(KeyError):
+      checkpointer_lib.ApplyInitFromCheckpointRules(
+          target, {str(src_dir): [(r"b", r"zzz")]})
+
+  def test_shape_mismatch_raises(self, tmp_path):
+    src_dir = tmp_path / "src" / "train"
+    ckpt = checkpointer_lib.Checkpointer(str(src_dir))
+    ckpt.Save(1, NestedMap(theta=NestedMap(a=jnp.zeros((2, 3))),
+                           step=jnp.asarray(1)), force=True)
+    ckpt.Close()
+    target = NestedMap(theta=NestedMap(a=jnp.zeros((4, 4))),
+                       step=jnp.asarray(0))
+    with pytest.raises(ValueError, match="shape mismatch"):
+      checkpointer_lib.ApplyInitFromCheckpointRules(
+          target, {str(src_dir): [(r"a", r"a")]})
+
+  def test_executor_applies_rules_on_fresh_init_only(self, tmp_path):
+    """End to end: train model A, warm-start model B's matching layer."""
+    import tests.test_executor_hardening as helpers
+    from lingvo_tpu.runners import executor as executor_lib
+
+    # model A: train briefly and checkpoint
+    logdir_a = str(tmp_path / "a")
+    sched, task, task_p = helpers._MakeScheduleAndTask(logdir_a, max_steps=10)
+    ex = executor_lib.ExecutorTpu(task_p, logdir_a, schedule=sched, task=task)
+    state_a = ex.Start()
+
+    # model B: same architecture, warm start proj from A (rules set on the
+    # params BEFORE instantiation — params freeze at Instantiate)
+    logdir_b = str(tmp_path / "b")
+    from lingvo_tpu.runners import program as program_lib
+    task_bp = helpers._TaskParams(max_steps=10, steps_per_loop=5,
+                                  save_interval=10)
+    task_bp.train.init_from_checkpoint_rules = {
+        os.path.join(logdir_a, "train"): [(r"proj\.(.*)", r"proj.\1")]}
+    task_b = task_bp.Instantiate()
+    task_b.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_bp, logdir=logdir_b, steps_per_loop=5)
+    sched_b = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+        task=task_b,
+        input_generators={"Train": helpers._RegressionInput()})
+    ex_b = executor_lib.ExecutorTpu(task_bp, logdir_b, schedule=sched_b,
+                                    task=task_b)
+    # intercept: check theta right after warm start by comparing first loss
+    state_b = ex_b.Start()
+    # B started from A's trained weights: its step-10 loss must beat a cold
+    # start's first-loop loss by a wide margin (A already converged partway)
+    import json
+    first_a = json.loads(
+        open(os.path.join(logdir_a, "metrics.jsonl")).readline())
+    first_b = json.loads(
+        open(os.path.join(logdir_b, "metrics.jsonl")).readline())
+    assert first_b["train"]["loss"] < 0.7 * first_a["train"]["loss"], (
+        first_a["train"]["loss"], first_b["train"]["loss"])
