@@ -1,0 +1,320 @@
+"""Attention variants: Transformer-XL relative attention, Performer FAVOR+,
+routing (clustered sparse) attention, funnel pooling.
+
+Re-designs the remaining attention breadth of
+`lingvo/core/batch_major_attention.py` — XL-style relative attention
+(`:2233`), `MultiHeadedFavorAttention:2125` + `favor_attention.py`,
+`RoutingAttention:4458` (k-means clustered sparse attention), funnel
+down/up-sampling (`:5943, :8162, :8423`) — on the batch-major JAX stack.
+All variants reuse MultiHeadedAttention's projections, so they drop into
+TransformerLayer via `tr_atten_tpl.atten_tpl`.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import attention as attention_lib
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+
+_NEG_INF = attention_lib._NEG_INF
+
+
+class TransformerXLAttention(attention_lib.MultiHeadedAttention):
+  """Transformer-XL relative position attention (ref
+  `batch_major_attention.py:2233`):
+
+    logits[i,j] = (q_i + u) . k_j + (q_i + v) . r_{i-j}
+
+  with sinusoidal relative embeddings r projected per head and learned
+  content/position biases u/v.
+  """
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    n, h = p.num_heads, self._dim_per_head
+    self.CreateVariable(
+        "w_rel", WeightParams((p.input_dim, n, h), p.params_init, p.dtype))
+    self.CreateVariable("u_bias", WeightParams((n, h),
+                                               WeightInit.Constant(0.0),
+                                               p.dtype))
+    self.CreateVariable("v_bias", WeightParams((n, h),
+                                               WeightInit.Constant(0.0),
+                                               p.dtype))
+
+  def _SinusoidRel(self, t: int):
+    """[2t-1, D] sinusoid embedding of relative distance t-1 .. -(t-1)."""
+    d = self.p.input_dim
+    pos = jnp.arange(t - 1, -t, -1, dtype=jnp.float32)    # [2t-1]
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, jnp.float32) / d))
+    ang = pos[:, None] * inv[None, :]
+    emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    return emb[:, :d]
+
+  def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
+    p = self.p
+    th = self.CastTheta(theta)
+    assert key_vec is None and value_vec is None, "XL attention is self-attn"
+    b, t, _ = query_vec.shape
+    q = self._HeadsProj(theta, "query", query_vec)        # [B,T,N,H]
+    k = self._HeadsProj(theta, "key", query_vec)
+    v = self._HeadsProj(theta, "value", query_vec)
+    scale = 1.0 / math.sqrt(self._dim_per_head)
+
+    rel = self._SinusoidRel(t).astype(q.dtype)            # [2T-1, D]
+    r = jnp.einsum("rd,dnh->rnh", rel, th.w_rel)          # [2T-1, N, H]
+
+    ac = jnp.einsum("btnh,bsnh->bnts", q + th.u_bias, k)  # content term
+    bd_full = jnp.einsum("btnh,rnh->bntr", q + th.v_bias, r)
+    # rel index: r[0] is distance t-1 (far past); logits need r_{i-j}
+    idx = (jnp.arange(t)[:, None] - jnp.arange(t)[None, :])  # i-j
+    idx = (t - 1) - idx                                   # -> index into r
+    bd = jnp.take_along_axis(
+        bd_full, jnp.broadcast_to(idx[None, None], (b, p.num_heads, t, t)),
+        axis=-1)
+    logits = (ac + bd) * scale
+    logits = logits.astype(jnp.float32)
+    mask = atten_mask
+    if causal:
+      cm = attention_lib.CausalMask(t)
+      mask = cm if mask is None else mask + cm
+    if paddings is not None:
+      pm = attention_lib.PaddingsToMask(paddings)
+      mask = pm if mask is None else mask + pm
+    if segment_ids is not None:
+      sm = attention_lib.SegmentMask(segment_ids, segment_ids)
+      mask = sm if mask is None else mask + sm
+    if mask is not None:
+      logits = logits + mask.astype(jnp.float32)
+    logits = jnp.maximum(logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if p.atten_dropout_prob > 0:
+      probs = self.atten_dropout.FProp(
+          self.ChildTheta(theta, "atten_dropout"), probs,
+          keep_prob=1.0 - p.atten_dropout_prob)
+    ctx = jnp.einsum("bnts,bsnh->btnh", probs, v)
+    return self._PostProj(theta, ctx), probs
+
+
+class PerformerAttention(attention_lib.MultiHeadedAttention):
+  """FAVOR+ linear attention (ref `MultiHeadedFavorAttention:2125`,
+  `favor_attention.py`): positive random-feature softmax kernel; O(T) memory
+  and time. Causal mode uses the prefix-sum formulation."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_random_features", 128, "Random feature dim M.")
+    p.Define("favor_seed", 1234, "Fixed seed for the projection matrix.")
+    return p
+
+  def _Features(self, x, proj, per_token_stab: bool):
+    """Positive softmax-kernel features: exp(w.x - |x|^2/2) / sqrt(M).
+
+    Stabilizer subtlety (FAVOR+): a per-token max cancels only in the
+    query position of the num/den ratio; KEY features must use a stabilizer
+    CONSTANT across tokens (here: max over tokens+features per head) or
+    large-norm keys get systematically down-weighted.
+    """
+    m = self.p.num_random_features
+    # x: [B,T,N,H]; proj: [H, M]
+    xw = jnp.einsum("btnh,hm->btnm", x, proj)
+    sq = 0.5 * jnp.sum(x * x, axis=-1, keepdims=True)
+    if per_token_stab:
+      stab = jnp.max(xw - sq, axis=-1, keepdims=True)      # [B,T,N,1]
+    else:
+      stab = jnp.max(xw - sq, axis=(1, 3), keepdims=True)  # [B,1,N,1]
+    stab = jax.lax.stop_gradient(stab)
+    return jnp.exp(xw - sq - stab) / math.sqrt(m)
+
+  def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
+    p = self.p
+    assert atten_mask is None and segment_ids is None, (
+        "Performer supports paddings/causal only (kernelized logits cannot "
+        "take arbitrary additive masks)")
+    assert p.atten_dropout_prob == 0.0, (
+        "Performer never materializes attention probs; atten_dropout_prob "
+        "cannot apply — configure residual dropout instead")
+    key_vec = query_vec if key_vec is None else key_vec
+    value_vec = key_vec if value_vec is None else value_vec
+    q = self._HeadsProj(theta, "query", query_vec)
+    k = self._HeadsProj(theta, "key", key_vec)
+    v = self._HeadsProj(theta, "value", value_vec)
+    h = self._dim_per_head
+    # scale queries/keys by h^-1/4 each (softmax kernel of q.k/sqrt(h))
+    q = q * (h ** -0.25)
+    k = k * (h ** -0.25)
+    proj = jax.random.normal(
+        jax.random.PRNGKey(p.favor_seed), (h, p.num_random_features),
+        jnp.float32).astype(q.dtype)
+    qf = self._Features(q, proj, per_token_stab=True)     # [B,T,N,M]
+    kf = self._Features(k, proj, per_token_stab=False)
+    if paddings is not None:
+      kf = kf * (1.0 - paddings)[:, :, None, None].astype(kf.dtype)
+    if causal:
+      # prefix sums over time (ref favor causal numerator/denominator)
+      kv = jnp.einsum("bsnm,bsnh->bsnmh", kf, v)
+      kv = jnp.cumsum(kv, axis=1)
+      z = jnp.cumsum(kf, axis=1)
+      num = jnp.einsum("btnm,btnmh->btnh", qf, kv)
+      den = jnp.einsum("btnm,btnm->btn", qf, z)
+    else:
+      kv = jnp.einsum("bsnm,bsnh->bnmh", kf, v)
+      z = jnp.sum(kf, axis=1)                             # [B,N,M]
+      num = jnp.einsum("btnm,bnmh->btnh", qf, kv)
+      den = jnp.einsum("btnm,bnm->btn", qf, z)
+    ctx = num / jnp.maximum(den[..., None], 1e-6)
+    out = self._PostProj(theta, ctx)
+    if paddings is not None:
+      out = py_utils.ApplyPadding(paddings, out)
+    return out, None  # probs never materialized (that's the point)
+
+
+class RoutingAttention(attention_lib.MultiHeadedAttention):
+  """Clustered sparse attention (ref `RoutingAttention:4458` +
+  `attention_util.KMeansClusteringForAtten:656`): queries and keys are
+  routed to the nearest of C learned centroids; each query attends only to
+  the W keys of its own cluster (capacity-truncated, MoE-style one-hot
+  dispatch — all static shapes).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_clusters", 4, "C.")
+    p.Define("attention_window", 0, "Keys per cluster W (0 = 2*T/C).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateVariable(
+        "centroids",
+        WeightParams((p.num_heads, p.num_clusters, self._dim_per_head),
+                     p.params_init, p.dtype))
+
+  def _Assign(self, x, centroids):
+    """Nearest-centroid assignment on the unit sphere (ref k-means attn).
+
+    x: [B,T,N,H] -> one-hot [B,T,N,C]."""
+    xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-6)
+    cn = centroids / jnp.maximum(
+        jnp.linalg.norm(centroids, axis=-1, keepdims=True), 1e-6)
+    sim = jnp.einsum("btnh,nch->btnc", xn, cn)
+    return jax.nn.one_hot(jnp.argmax(sim, -1), self.p.num_clusters,
+                          dtype=x.dtype)
+
+  def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
+    p = self.p
+    th = self.CastTheta(theta)
+    assert key_vec is None and value_vec is None, "routing is self-attn"
+    assert atten_mask is None and segment_ids is None, (
+        "routing attention supports paddings/causal only")
+    b, t, _ = query_vec.shape
+    c = p.num_clusters
+    w = p.attention_window or max(2 * t // c, 1)
+    w = min(w, t)
+    q = self._HeadsProj(theta, "query", query_vec)
+    k = self._HeadsProj(theta, "key", query_vec)
+    v = self._HeadsProj(theta, "value", query_vec)
+    q = self._ScaleQuery(theta, q)
+
+    k_assign = self._Assign(k, th.centroids)              # [B,T,N,C]
+    if paddings is not None:
+      k_assign = k_assign * (1.0 - paddings)[:, :, None, None]
+    # capacity: first W keys per cluster (cumsum position, MoE-style)
+    pos = jnp.cumsum(k_assign, axis=1) - k_assign
+    k_keep = k_assign * (pos < w)
+    slot = jnp.sum(pos * k_keep, axis=-1)                 # [B,T,N]
+    slot_oh = jax.nn.one_hot(slot.astype(jnp.int32), w, dtype=q.dtype)
+    # dispatch keys/values into [B,N,C,W,H]
+    disp = (k_keep[..., None] * slot_oh[..., None, :])    # [B,T,N,C,W]
+    k_c = jnp.einsum("btncw,btnh->bncwh", disp, k)
+    v_c = jnp.einsum("btncw,btnh->bncwh", disp, v)
+    k_valid = jnp.einsum("btncw->bncw", disp)             # 1 if slot filled
+
+    q_assign = self._Assign(q, th.centroids)              # [B,T,N,C]
+    # per-query logits against its cluster's W keys
+    logits = jnp.einsum("btnh,bncwh->btncw", q, k_c)
+    logits = jnp.where(k_valid[:, None] > 0, logits, _NEG_INF)
+    if causal:
+      # key global positions per slot: [B,N,C,W]
+      key_pos = jnp.einsum("btncw,t->bncw", disp,
+                           jnp.arange(t, dtype=q.dtype))
+      q_pos = jnp.arange(t, dtype=q.dtype)[None, :, None, None, None]
+      logits = jnp.where(key_pos[:, None] <= q_pos, logits, _NEG_INF)
+    logits = logits * q_assign[..., None]  # zero out non-own clusters
+    logits = jnp.where(q_assign[..., None] > 0, logits, _NEG_INF)
+    logits = jnp.maximum(logits.astype(jnp.float32), _NEG_INF)
+    flat = logits.reshape(b, t, p.num_heads, c * w)
+    probs = jax.nn.softmax(flat, axis=-1).astype(q.dtype)
+    # a query whose cluster has no visible key has a fully-masked row:
+    # softmax would go uniform and leak — zero masked slots outright
+    probs = probs * (flat > 0.5 * _NEG_INF).astype(probs.dtype)
+    if p.atten_dropout_prob > 0:
+      probs = self.atten_dropout.FProp(
+          self.ChildTheta(theta, "atten_dropout"), probs,
+          keep_prob=1.0 - p.atten_dropout_prob)
+    probs = probs.reshape(b, t, p.num_heads, c, w)
+    ctx = jnp.einsum("btncw,bncwh->btnh", probs, v_c)
+    out = self._PostProj(theta, ctx)
+    if paddings is not None:
+      out = py_utils.ApplyPadding(paddings, out)
+    return out, None
+
+
+class FunnelPoolingLayer(base_layer.BaseLayer):
+  """Strided mean-pooling over time (ref `FunnelPoolingLayer:8162`):
+  halves (or /stride) the sequence for the deeper funnel blocks."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("stride", 2, "Time pooling stride.")
+    return p
+
+  def FProp(self, theta, inputs, paddings=None):
+    """[B, T, D] -> ([B, ceil(T/s), D], pooled paddings)."""
+    p = self.p
+    s = p.stride
+    b, t, d = inputs.shape
+    pad_t = (-t) % s
+    x = jnp.pad(inputs, ((0, 0), (0, pad_t), (0, 0)))
+    if paddings is None:
+      pads = jnp.zeros((b, t), jnp.float32)
+    else:
+      pads = paddings
+    pads = jnp.pad(pads, ((0, 0), (0, pad_t)), constant_values=1.0)
+    nonpad = (1.0 - pads)[..., None]
+    x = (x * nonpad.astype(x.dtype)).reshape(b, -1, s, d).sum(axis=2)
+    cnt = nonpad.reshape(b, -1, s, 1).sum(axis=2)
+    x = x / jnp.maximum(cnt, 1.0).astype(x.dtype)
+    # a pooled frame is padding only when ALL its inputs were padding
+    new_pads = (cnt[..., 0] == 0).astype(jnp.float32)
+    return x, new_pads
+
+
+class FunnelUpsampleLayer(base_layer.BaseLayer):
+  """Nearest-neighbor upsampling back to the original rate (ref `:8423`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("stride", 2, "Repeat factor (inverse of the pooling stride).")
+    return p
+
+  def FProp(self, theta, inputs, target_len: int | None = None):
+    out = jnp.repeat(inputs, self.p.stride, axis=1)
+    if target_len is not None:
+      out = out[:, :target_len]
+    return out

@@ -59,6 +59,11 @@ class BaseTask(base_layer.BaseLayer):
               "re.sub(target_regex, source_template, path), with dtype "
               "casting (ref bfloat16_variables.py). Applied only when no "
               "checkpoint exists in the run's own train dir.")
+    tp.Define("pruning", None,
+              "Optional core.pruning.PruningSchedule params: magnitude "
+              "masks updated at the schedule cadence and re-applied after "
+              "every program run (ref model_pruning hooks, "
+              "base_model.py:1105).")
     p.Define("train", tp, "Training hyperparams.")
     ep = hyperparams.Params()
     ep.Define("samples_per_summary", 1000, "Max eval examples per run.")
@@ -174,7 +179,8 @@ class BaseTask(base_layer.BaseLayer):
 
       def _Loss(trainable, frozen_rest, lrn=lrn):
         full_theta = self._MergeSubset(frozen_rest, trainable)
-        with py_utils.StepSeedContext(step_key):
+        with py_utils.StepSeedContext(step_key), \
+             py_utils.GlobalStepContext(state.step):
           with py_utils.ForwardStateContext() as fwd:
             with py_utils.AuxLossContext() as aux_losses:
               metrics_, per_example_ = self.FProp(full_theta, input_batch)
@@ -235,10 +241,17 @@ class BaseTask(base_layer.BaseLayer):
                                   per_example=per_example or NestedMap())
     return new_state, out_metrics_stats
 
-  def EvalStep(self, theta: NestedMap,
-               input_batch: NestedMap) -> tuple[NestedMap, NestedMap]:
-    """One pure eval step (eval-mode FProp)."""
-    with py_utils.EvalContext():
+  def EvalStep(self, theta: NestedMap, input_batch: NestedMap,
+               step=None) -> tuple[NestedMap, NestedMap]:
+    """One pure eval step (eval-mode FProp).
+
+    `step` (optional): the global step, for schedule-dependent layers
+    (quantization clip caps must anneal identically in train and eval).
+    """
+    import contextlib
+    step_ctx = (py_utils.GlobalStepContext(step) if step is not None
+                else contextlib.nullcontext())
+    with py_utils.EvalContext(), step_ctx:
       return self.FProp(theta, input_batch)
 
   # ---- input ---------------------------------------------------------------

@@ -148,34 +148,61 @@ def ApplyInitFromCheckpointRules(state: NestedMap, rules: dict) -> NestedMap:
     return node
 
   for ckpt_dir, pairs in rules.items():
-    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir),
+                                item_handlers=ocp.PyTreeCheckpointHandler())
     try:
       src_step = mgr.latest_step()
       if src_step is None:
         raise FileNotFoundError(
             f"init_from_checkpoint_rules: no checkpoint in {ckpt_dir}")
-      restored = mgr.restore(src_step)  # numpy tree, as saved
-      src_theta = _ToNested(dict(restored)["theta"])
-      src_flat = dict(src_theta.FlattenItems())
-      n_loaded = 0
-      for path, value in state.theta.FlattenItems():
+      # resolve target path -> source path BEFORE any I/O
+      mapping = {}  # target path -> source path
+      for path, _ in state.theta.FlattenItems():
         for target_regex, source_tpl in pairs:
           if re.fullmatch(target_regex, path):
-            src_path = re.sub(target_regex, source_tpl, path)
-            if src_path not in src_flat:
-              raise KeyError(
-                  f"init_from_checkpoint_rules: {path!r} matched "
-                  f"{target_regex!r} but source var {src_path!r} is not in "
-                  f"{ckpt_dir} (has {len(src_flat)} vars)")
-            src_val = src_flat[src_path]
-            if tuple(np.shape(src_val)) != tuple(np.shape(value)):
-              raise ValueError(
-                  f"init_from_checkpoint_rules: shape mismatch for {path}: "
-                  f"{np.shape(value)} vs source {np.shape(src_val)}")
-            state.theta.Set(
-                path, jnp.asarray(src_val, dtype=value.dtype))
-            n_loaded += 1
+            mapping[path] = re.sub(target_regex, source_tpl, path)
             break  # first matching rule wins
+      # partial restore: only the mapped source vars are read (a few vars
+      # from a 175B checkpoint must not materialize the whole thing on host)
+      meta = _ToNested(mgr.item_metadata(src_step).tree)
+      meta_flat = dict(meta.GetItem("theta").FlattenItems())
+      for path, src_path in mapping.items():
+        if src_path not in meta_flat:
+          raise KeyError(
+              f"init_from_checkpoint_rules: {path!r} maps to source var "
+              f"{src_path!r} which is not in {ckpt_dir} "
+              f"(has {len(meta_flat)} vars)")
+      abstract: dict = {"theta": {}}
+      for src_path in set(mapping.values()):
+        node = abstract["theta"]
+        parts = src_path.split(".")
+        for key in parts[:-1]:
+          node = node.setdefault(key, {})
+        m = meta_flat[src_path]
+        node[parts[-1]] = jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
+      restored = mgr.restore(
+          src_step, args=ocp.args.PyTreeRestore(abstract,
+                                                partial_restore=True))
+      src_flat = dict(_ToNested(dict(restored)["theta"]).FlattenItems())
+      n_loaded = 0
+      for path, src_path in mapping.items():
+        value = state.theta.GetItem(path)
+        src_val = src_flat[src_path]
+        if tuple(np.shape(src_val)) != tuple(np.shape(value)):
+          raise ValueError(
+              f"init_from_checkpoint_rules: shape mismatch for {path}: "
+              f"{np.shape(value)} vs source {np.shape(src_val)}")
+        new_val = jnp.asarray(src_val, dtype=value.dtype)
+        if isinstance(value, jax.Array) and hasattr(value, "sharding"):
+          # keep the target's (possibly multi-host) sharding layout
+          new_val = jax.device_put(new_val, value.sharding)
+        state.theta.Set(path, new_val)
+        # EMA shadows theta at init (base_model copies theta into
+        # ema_theta BEFORE warm start runs): mirror the warm value or
+        # eval/decode (use_ema=True) would score random weights
+        if "ema_theta" in state:
+          state.ema_theta.Set(path, new_val)
+        n_loaded += 1
       print(f"[checkpointer] warm start: {n_loaded} vars from {ckpt_dir} "
             f"@ step {src_step}", flush=True)
     finally:

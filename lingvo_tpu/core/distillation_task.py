@@ -1,0 +1,81 @@
+"""Teacher/student distillation task wrapper (ref
+`lingvo/core/distillation_task.py`).
+
+Both models live in one task; the teacher's variables are frozen (excluded
+from every learner via a variable filter and wrapped in stop_gradient), and
+the loss mixes the student's ground-truth loss with a soft-label KL against
+the teacher's logits. Teacher weights typically arrive via
+`train.init_from_checkpoint_rules` (warm start) mapping `teacher\\..*`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class DistillationTask(base_model.BaseTask):
+  """Wraps a teacher task and a student task of the same interface."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("teacher", None, "Teacher task Params (frozen).")
+    p.Define("student", None, "Student task Params (trained).")
+    p.Define("distill_weight", 0.5,
+             "Mix: loss = (1-w) * student_loss + w * distill_KL.")
+    p.Define("temperature", 1.0, "Soft-label temperature.")
+    return p
+
+  def __init__(self, params):
+    params = params.Copy()
+    # freeze the teacher in every learner (ref: teacher vars excluded from
+    # BProp) — set on the learner params before they instantiate
+    learners = params.train.learner
+    for lp in (learners if isinstance(learners, (list, tuple))
+               else [learners]):
+      assert lp.bprop_variable_exclusion is None, (
+          "DistillationTask owns bprop_variable_exclusion")
+      lp.bprop_variable_exclusion = r"^teacher\."
+    super().__init__(params)
+    p = self.p
+    assert p.teacher is not None and p.student is not None
+    self.CreateChild("teacher", p.teacher)
+    self.CreateChild("student", p.student)
+
+  def ComputePredictions(self, theta, input_batch):
+    frozen_teacher = jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                            theta.teacher)
+    teacher_preds = self.teacher.ComputePredictions(frozen_teacher,
+                                                    input_batch)
+    student_preds = self.student.ComputePredictions(theta.student,
+                                                    input_batch)
+    return NestedMap(teacher=teacher_preds, student=student_preds)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    p = self.p
+    metrics, per_example = self.student.ComputeLoss(
+        theta.student, predictions.student, input_batch)
+    hard_loss, weight = metrics.loss
+    t = p.temperature
+    t_logits = predictions.teacher.logits.astype(jnp.float32) / t
+    s_logits = predictions.student.logits.astype(jnp.float32) / t
+    t_probs = jax.nn.softmax(t_logits, axis=-1)
+    kl = jnp.sum(
+        t_probs * (jax.nn.log_softmax(t_logits, -1)
+                   - jax.nn.log_softmax(s_logits, -1)), axis=-1)
+    if "paddings" in input_batch:
+      w = 1.0 - input_batch.paddings
+      distill_loss = jnp.sum(kl * w) / jnp.maximum(jnp.sum(w), 1e-8)
+    else:
+      distill_loss = jnp.mean(kl)
+    distill_loss = distill_loss * (t * t)  # classic T^2 scaling
+    total = (1.0 - p.distill_weight) * hard_loss + (
+        p.distill_weight * distill_loss)
+    metrics.loss = (total, weight)
+    metrics.hard_loss = (hard_loss, weight)
+    metrics.distill_loss = (distill_loss, weight)
+    return metrics, per_example

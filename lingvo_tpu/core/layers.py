@@ -52,6 +52,9 @@ class ProjectionLayer(base_layer.BaseLayer):
     p.Define("batch_norm", False, "Apply BatchNorm before activation.")
     p.Define("ln_tpl", None, "Optional LayerNorm params applied pre-activation.")
     p.Define("weight_norm", False, "Reparameterize w = g * v/||v||.")
+    p.Define("qdomain", None,
+             "Optional quant_utils.QDomain params: fake-quantize the weight "
+             "and the output activation (ref QuantizableLayer wiring).")
     return p
 
   def __init__(self, params):
@@ -77,6 +80,8 @@ class ProjectionLayer(base_layer.BaseLayer):
                        p.dtype, tensor_split_dims_mapping=bias_sharding))
     if p.batch_norm:
       self.CreateChild("bn", BatchNormLayer.Params().Set(dim=p.output_dim))
+    if p.qdomain is not None:
+      self.CreateChild("qdomain", p.qdomain.Copy())
 
   def FProp(self, theta, inputs, paddings=None):
     p = self.p
@@ -85,6 +90,10 @@ class ProjectionLayer(base_layer.BaseLayer):
     w = th.w
     if p.weight_norm:
       w = jnp.reshape((1.0 + th.g) / jnp.linalg.norm(w, axis=0), (1, -1)) * w
+    if p.qdomain is not None:
+      # quantize the EFFECTIVE matmul weight (post weight-norm) — QAT must
+      # simulate the weight the int8 deployment actually uses
+      w = self.qdomain.QuantizeWeight(self.ChildTheta(theta, "qdomain"), w)
     out = jnp.einsum("...i,io->...o", x, w)
     if p.has_bias:
       out = out + th.b
@@ -92,6 +101,9 @@ class ProjectionLayer(base_layer.BaseLayer):
       out = self.bn.FProp(theta.bn, out, paddings)
     if p.activation != "NONE":
       out = activations.GetFn(p.activation)(out)
+    if p.qdomain is not None:
+      out = self.qdomain.QuantizeAct(
+          self.ChildTheta(theta, "qdomain"), "act", out)
     if paddings is not None:
       out = py_utils.ApplyPadding(paddings, out)
     return out

@@ -1,0 +1,98 @@
+"""Magnitude pruning (ref `lingvo/core/pruning_utils.py` + the
+model_pruning mask hooks at `base_model.py:1105`).
+
+TPU-native shape: masks are part of the train state (a parallel pytree of
+0/1 arrays over the pruned weights), updated on the host between program
+runs at a polynomial sparsity schedule, and applied inside TrainStep by
+masking theta before FProp and re-masking after the optimizer update —
+functional, jit-compatible, no assign ops.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import hyperparams
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class PruningSchedule:
+  """Polynomial sparsity ramp (ref pruning schedule): 0 -> final_sparsity
+  over [begin_step, end_step], updated every `frequency` steps."""
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", "pruning", "Name.")
+    p.Define("weight_regex", r".*\.w", "Which theta paths are pruned.")
+    p.Define("final_sparsity", 0.9, "Target fraction of zeros.")
+    p.Define("begin_step", 0, "Ramp start.")
+    p.Define("end_step", 10000, "Ramp end.")
+    p.Define("frequency", 100, "Mask update cadence (steps).")
+    p.Define("power", 3.0, "Polynomial decay power (ref: cubic).")
+    return p
+
+  def __init__(self, params):
+    self.p = params.Copy()
+
+  def SparsityAt(self, step: int) -> float:
+    p = self.p
+    if step <= p.begin_step:
+      return 0.0
+    frac = min((step - p.begin_step) / max(p.end_step - p.begin_step, 1),
+               1.0)
+    return p.final_sparsity * (1.0 - (1.0 - frac) ** p.power)
+
+  def ShouldUpdate(self, step: int, last_update_step: int = -1) -> bool:
+    """True when a frequency boundary was CROSSED since the last update —
+    the caller only observes steps at program-run boundaries, so an exact
+    `step % frequency == 0` test could never fire (e.g. steps_per_loop=64,
+    frequency=100)."""
+    p = self.p
+    if step < p.begin_step:
+      return False
+    f = max(p.frequency, 1)
+    return step // f > last_update_step // f
+
+  def Matches(self, path: str) -> bool:
+    return re.fullmatch(self.p.weight_regex, path) is not None
+
+
+def ComputeMasks(theta: NestedMap, schedule: PruningSchedule,
+                 step: int) -> NestedMap:
+  """Magnitude masks at the scheduled sparsity: the smallest |w| fraction
+  of each matched weight is zeroed (per-tensor threshold, ref magnitude
+  pruning)."""
+  sparsity = schedule.SparsityAt(step)
+
+  def _One(path, w):
+    if not schedule.Matches(path) or np.ndim(w) < 2:
+      return jnp.ones_like(w)
+    flat = jnp.abs(w.reshape(-1))
+    k = int(sparsity * flat.shape[0])
+    if k <= 0:
+      return jnp.ones_like(w)
+    threshold = jnp.sort(flat)[k - 1]
+    return (jnp.abs(w) > threshold).astype(w.dtype)
+
+  return theta.TransformWithKey(_One)
+
+
+def ApplyMasks(theta: NestedMap, masks: NestedMap) -> NestedMap:
+  return jax.tree_util.tree_map(lambda w, m: w * m, theta, masks)
+
+
+def Sparsity(masks: NestedMap, schedule: PruningSchedule) -> float:
+  """Realized fraction of zeros over the pruned weights."""
+  zeros = total = 0
+  for path, m in masks.FlattenItems():
+    if schedule.Matches(path) and np.ndim(m) >= 2:
+      arr = np.asarray(m)
+      zeros += arr.size - int(arr.sum())
+      total += arr.size
+  return zeros / total if total else 0.0

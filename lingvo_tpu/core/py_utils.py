@@ -318,6 +318,24 @@ def _Stack(name: str) -> list:
 
 
 @contextlib.contextmanager
+def GlobalStepContext(step):
+  """Makes the global step available to schedule-dependent layers (e.g.
+  quantization clip schedules) during FProp. Entered by TrainStep."""
+  stack = _Stack("global_step")
+  stack.append(step)
+  try:
+    yield
+  finally:
+    stack.pop()
+
+
+def GetGlobalStep():
+  """Current global step inside FProp, or None outside TrainStep."""
+  stack = _Stack("global_step")
+  return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
 def StepSeedContext(key: jax.Array):
   """Makes a per-step PRNG key available to stochastic layers during FProp."""
   stack = _Stack("step_seed")

@@ -1,0 +1,128 @@
+"""Quantization domains: fake-quant training for on-device int8 inference.
+
+Re-designs `lingvo/core/quant_utils.py` (1.8k LoC: `QuantizableLayer` mixin,
+`QDomain` fake-quant domains, clipping-cap schedules) for JAX: fake
+quantization is a pure function with a straight-through estimator — XLA
+fuses the quantize-dequantize pair into the surrounding matmul, so there is
+no custom-op machinery. Activation ranges are tracked through the same
+forward-state channel BatchNorm statistics use (EMA of batch max-abs),
+matching the reference's `PassiveAsymQDomain` range tracking.
+
+Usage: give a layer's Params a `qdomain` template
+(`SymmetricQDomain.Params()`); the layer calls `QuantizeWeight` /
+`QuantizeAct` around its matmuls (ProjectionLayer is wired).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+
+
+def FakeQuant(x, scale, bits: int = 8):
+  """Quantize-dequantize with a straight-through estimator.
+
+  scale: positive per-tensor (or broadcastable) step size. The rounding is
+  invisible to the gradient (STE): backward acts as identity within the
+  clip range.
+  """
+  qmax = 2.0 ** (bits - 1) - 1
+  scale = jnp.maximum(scale, 1e-8)
+  q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+  return x + jax.lax.stop_gradient(q - x)
+
+
+class QDomain(base_layer.BaseLayer):
+  """Base quantization domain (ref QDomain): no-op."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("bits", 8, "Quantized bit width.")
+    return p
+
+  def QuantizeWeight(self, theta, w):
+    return w
+
+  def QuantizeAct(self, theta, name: str, x):
+    return x
+
+
+class SymmetricQDomain(QDomain):
+  """Symmetric per-tensor fake quant (ref SymmetricScheduledClipQDomain
+  without the schedule): weights use their own max-abs; activations use an
+  EMA max-abs range tracked as forward state (BN-style)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("ema_decay", 0.99, "Activation range EMA decay.")
+    p.Define("act_names", ("act",),
+             "Activation hooks this domain owns (one range var each).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    for name in self.p.act_names:
+      self.CreateVariable(
+          f"range_{name}",
+          WeightParams((), WeightInit.Constant(1.0), jnp.float32,
+                       collections=("non_trainable", "moving_stats")))
+
+  def QuantizeWeight(self, theta, w):
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (
+        2.0 ** (self.p.bits - 1) - 1)
+    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+
+  def QuantizeAct(self, theta, name: str, x):
+    p = self.p
+    assert name in p.act_names, (name, p.act_names)
+    th = self.CastTheta(theta)
+    ema = th[f"range_{name}"].astype(jnp.float32)
+    if not py_utils.DoEval():
+      batch_max = jnp.max(jnp.abs(x.astype(jnp.float32)))
+      new_range = p.ema_decay * ema + (1.0 - p.ema_decay) * batch_max
+      py_utils.AddForwardStateUpdate(f"{self.path}/range_{name}", new_range)
+      rng = new_range
+    else:
+      rng = ema
+    scale = rng / (2.0 ** (p.bits - 1) - 1)
+    return FakeQuant(x, scale.astype(x.dtype), p.bits)
+
+
+class ScheduledClipQDomain(SymmetricQDomain):
+  """Adds the reference's clipping-cap schedule (ref ClippingCapSchedule):
+  the activation clip range anneals from start_cap to end_cap over
+  [clip_start_step, clip_end_step], after which quantization is fully on."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("start_cap", 8.0, "Initial (loose) activation cap.")
+    p.Define("end_cap", 1.0, "Final activation cap.")
+    p.Define("clip_start_step", 0, "Annealing start.")
+    p.Define("clip_end_step", 10000, "Annealing end.")
+    return p
+
+  def _Cap(self):
+    p = self.p
+    step = py_utils.GetGlobalStep()
+    if step is None:
+      return jnp.asarray(p.end_cap, jnp.float32)
+    frac = jnp.clip(
+        (step - p.clip_start_step) /
+        max(p.clip_end_step - p.clip_start_step, 1), 0.0, 1.0)
+    # log-space interpolation (ref ClippingCapSchedule._Value)
+    return jnp.exp(jnp.log(p.start_cap) * (1 - frac) +
+                   jnp.log(p.end_cap) * frac)
+
+  def QuantizeAct(self, theta, name: str, x):
+    cap = self._Cap().astype(x.dtype)
+    x = jnp.clip(x, -cap, cap)
+    scale = cap.astype(jnp.float32) / (2.0 ** (self.p.bits - 1) - 1)
+    return FakeQuant(x, scale.astype(x.dtype), self.p.bits)

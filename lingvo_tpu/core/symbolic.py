@@ -1,0 +1,111 @@
+"""Symbolic dimensions in Params (ref `lingvo/core/symbolic.py`, `tshape.py`).
+
+Experiment templates can set dims to sympy symbols (e.g. blocks whose widths
+scale together) and resolve them at instantiation time:
+
+  D = symbolic.Symbol("model_dim")
+  p.hidden_dim = 4 * D
+  with symbolic.SymbolToValueMap({D: 1024}):
+    hidden = symbolic.EvalExpr(p.hidden_dim)   # -> 4096
+
+Layers that may receive symbolic dims call `EvalExpr` (integers pass
+through untouched, so non-symbolic configs pay nothing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any
+
+import sympy
+
+_TLS = threading.local()
+
+
+def Symbol(name: str) -> "sympy.Symbol":
+  """A positive-integer symbolic dimension."""
+  return sympy.Symbol(name, positive=True, integer=True)
+
+
+def _Maps() -> list:
+  if not hasattr(_TLS, "maps"):
+    _TLS.maps = []
+  return _TLS.maps
+
+
+@contextlib.contextmanager
+def SymbolToValueMap(mapping: dict):
+  """Binds symbol values for EvalExpr within the scope (stackable; inner
+  scopes override, ref symbolic.SymbolToValueMap)."""
+  _Maps().append(dict(mapping))
+  try:
+    yield
+  finally:
+    _Maps().pop()
+
+
+def IsExpr(v: Any) -> bool:
+  return isinstance(v, sympy.Expr) and not isinstance(v, sympy.Integer)
+
+
+def EvalExpr(v: Any) -> Any:
+  """Resolves a (possibly symbolic) value with the active symbol bindings.
+
+  Plain ints/floats/tuples pass through; unresolved symbols raise.
+  """
+  if isinstance(v, (list, tuple)):
+    return type(v)(EvalExpr(x) for x in v)
+  if not isinstance(v, sympy.Expr):
+    return v
+  subs = {}
+  for m in _Maps():
+    subs.update(m)
+  out = v.subs(subs) if subs else v
+  if isinstance(out, sympy.Integer):
+    return int(out)
+  if isinstance(out, (sympy.Float, sympy.Rational)):
+    return float(out)
+  if out.free_symbols:
+    raise ValueError(
+        f"Unresolved symbols {out.free_symbols} in {v}; wrap instantiation "
+        "in symbolic.SymbolToValueMap({...})")
+  return out
+
+
+class Shape:
+  """Symbolic tensor shape algebra (ref `tshape.Shape`): concatenation,
+  slicing, and products stay symbolic until evaluated."""
+
+  def __init__(self, dims):
+    self._dims = list(dims)
+
+  def __getitem__(self, i):
+    out = self._dims[i]
+    return Shape(out) if isinstance(out, list) else out
+
+  def __len__(self):
+    return len(self._dims)
+
+  def __add__(self, other):
+    other_dims = other._dims if isinstance(other, Shape) else list(other)
+    return Shape(self._dims + other_dims)
+
+  def __eq__(self, other):
+    other_dims = other._dims if isinstance(other, Shape) else list(other)
+    return [sympy.simplify(a - b) == 0 if IsExpr(a) or IsExpr(b) else a == b
+            for a, b in zip(self._dims, other_dims)] == [True] * len(
+                self._dims)
+
+  @property
+  def size(self):
+    out = 1
+    for d in self._dims:
+      out = out * d
+    return out
+
+  def ToTuple(self):
+    return tuple(EvalExpr(d) for d in self._dims)
+
+  def __repr__(self):
+    return f"Shape({self._dims})"

@@ -1,0 +1,106 @@
+"""Sharded large-vocab embedding tables (ref
+`lingvo/core/tpu_embedding_layers.py` / `_v1.py` / `_v2.py` +
+`tpu_embedding_manager.py`).
+
+The reference drives the TPU embedding mid-level API (host-side enqueue,
+load/retrieve around the train loop) because TF cannot express giant sparse
+tables in-graph. Under GSPMD none of that machinery is needed: the table is
+a regular variable row-sharded over the mesh, the lookup is a one-hot
+matmul (MXU-friendly and partitionable — XLA turns it into a collective
+gather over the table shards), and optimizer slots shard the same way
+automatically. What remains of the reference surface is the table/feature
+config and a combiner for multi-valent features.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightParams
+
+
+class ShardedEmbeddingTable(base_layer.BaseLayer):
+  """One row-sharded table (ref TPUEmbeddingTable)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 0, "Rows.")
+    p.Define("embedding_dim", 0, "Cols.")
+    p.Define("shard_axis", "data",
+             "Mesh axis the vocab dim shards over (rows split across "
+             "chips like the reference's table sharding).")
+    p.Define("combiner", "sum", "'sum' | 'mean' for multi-valent lookups.")
+    p.Define("scale_sqrt_depth", False, "Scale outputs by sqrt(dim).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.vocab_size > 0 and p.embedding_dim > 0
+    self.CreateVariable(
+        "table",
+        WeightParams((p.vocab_size, p.embedding_dim), p.params_init, p.dtype,
+                     tensor_split_dims_mapping=(p.shard_axis, None)))
+
+  def EmbLookup(self, theta, ids):
+    """ids [..., ] int32 -> [..., dim]; one-hot matmul keeps the table
+    sharded (gather would force an all-gather of the table)."""
+    p = self.p
+    th = self.CastTheta(theta)
+    one_hot = jax.nn.one_hot(ids, p.vocab_size, dtype=th.table.dtype)
+    out = jnp.einsum("...v,vd->...d", one_hot, th.table)
+    if p.scale_sqrt_depth:
+      out = out * (p.embedding_dim ** 0.5)
+    return out
+
+  def MultivalentLookup(self, theta, ids, weights=None):
+    """ids [b, n] with optional weights [b, n] -> combined [b, dim]
+    (ref combiner semantics: sum or weighted mean over the n values)."""
+    p = self.p
+    emb = self.EmbLookup(theta, ids)                      # [b, n, d]
+    if weights is None:
+      weights = jnp.ones(ids.shape, emb.dtype)
+    weights = weights.astype(emb.dtype)
+    out = jnp.einsum("bnd,bn->bd", emb, weights)
+    if p.combiner == "mean":
+      out = out / jnp.maximum(
+          jnp.sum(weights, axis=-1, keepdims=True), 1e-8)
+    return out
+
+
+class TpuEmbeddingCollection(base_layer.BaseLayer):
+  """A set of named tables + feature->table wiring (ref
+  TPUEmbeddingLayer/manager: features share tables; one call embeds a
+  NestedMap of id features)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("tables", [], "List of (table_name, ShardedEmbeddingTable "
+             "Params).")
+    p.Define("feature_to_table", {}, "feature name -> table name.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self._table_names = [name for name, _ in p.tables]
+    for name, tp in p.tables:
+      self.CreateChild(f"table_{name}", tp)
+    for feat, tbl in p.feature_to_table.items():
+      assert tbl in self._table_names, (feat, tbl)
+
+  def EmbLookup(self, theta, id_features: NestedMap) -> NestedMap:
+    """NestedMap of int id arrays -> NestedMap of embeddings."""
+    out = NestedMap()
+    for feat, ids in id_features.FlattenItems():
+      tbl = self.p.feature_to_table[feat]
+      table = getattr(self, f"table_{tbl}")
+      out.Set(feat, table.EmbLookup(
+          self.ChildTheta(theta, f"table_{tbl}"), ids))
+    return out

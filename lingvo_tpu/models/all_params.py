@@ -15,6 +15,10 @@ try:
 except ImportError:
   pass
 try:
+  from lingvo_tpu.models.lm.params import wiki_bert  # noqa: F401
+except ImportError:
+  pass
+try:
   from lingvo_tpu.models.mt.params import wmt14_en_de  # noqa: F401
 except ImportError:
   pass

@@ -150,3 +150,46 @@ class SyntheticLmInput(base_input_generator.BaseInputGenerator):
       out.segment_ids = segment_ids
       out.segment_pos = segment_pos
     return out
+
+
+class SyntheticBertInput(base_input_generator.BaseInputGenerator):
+  """Masked-LM batches over the same learnable pattern process as
+  SyntheticLmInput: 15% of content positions replaced by mask_id (80%) /
+  random token (10%) / kept (10%), BERT-style."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("seq_len", 128, "Tokens per row.")
+    p.Define("vocab_size", 32000, "Vocab (mask_id must be < vocab).")
+    p.Define("pattern_len", 8, "Pattern period.")
+    p.Define("mask_prob", 0.15, "Fraction of positions scored.")
+    p.Define("mask_id", 3, "The [MASK] token id.")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 6029 * self._step) % (2**31))
+    self._step += 1
+    b, t = p.batch_size, p.seq_len
+    labels = np.zeros((b, t), np.int32)
+    for i in range(b):
+      pat = rng.randint(4, p.vocab_size, p.pattern_len)
+      reps = -(-t // p.pattern_len)
+      labels[i] = np.tile(pat, reps)[:t]
+    masked = rng.rand(b, t) < p.mask_prob
+    ids = labels.copy()
+    action = rng.rand(b, t)
+    ids[masked & (action < 0.8)] = p.mask_id
+    rand_tok = rng.randint(4, p.vocab_size, (b, t))
+    repl = masked & (action >= 0.8) & (action < 0.9)
+    ids[repl] = rand_tok[repl]
+    return NestedMap(
+        ids=ids, labels=labels,
+        masked_weights=masked.astype(np.float32),
+        paddings=np.zeros((b, t), np.float32))

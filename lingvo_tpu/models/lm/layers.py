@@ -39,6 +39,8 @@ class TransformerLm(base_model.BaseTask):
              "Scan-over-layers (True) vs distinct layers (False).")
     p.Define("atten_tpl", None, "Optional attention template override.")
     p.Define("use_rotary", True, "RoPE instead of absolute positions.")
+    p.Define("bidirectional", False,
+             "No causal mask (BERT-style encoder; pair with an MLM task).")
     p.Define("label_smoothing", 0.0, "Label smoothing.")
     p.Define("softmax_logits_soft_max", 30.0, "Logit tanh cap (gshard-style).")
     p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
@@ -72,7 +74,7 @@ class TransformerLm(base_model.BaseTask):
 
     layer_body = transformer_lib.TransformerLayer.Params().Set(
         input_dim=p.model_dim, num_heads=p.num_heads,
-        hidden_dim=p.hidden_dim, mask_self_atten=True)
+        hidden_dim=p.hidden_dim, mask_self_atten=not p.bidirectional)
     atten_tpl = p.atten_tpl
     if atten_tpl is not None:
       layer_body.tr_atten_tpl.atten_tpl = atten_tpl.Copy()
@@ -170,3 +172,39 @@ class TransformerLm(base_model.BaseTask):
     x = self.final_ln.FProp(theta.final_ln, x)
     logits = self.emb.Logits(theta.emb, x)
     return logits[:, 0, :], new_states
+
+
+class BertLm(TransformerLm):
+  """Masked-LM pretraining task (ref `tasks/lm/params/wiki_bert.py` +
+  `tasks/lm/layers.py` MLM usage): bidirectional encoder, loss only on
+  masked positions.
+
+  Batch fields: ids (with mask tokens applied), labels (original ids),
+  masked_weights [b, t] (1.0 where a prediction is scored), paddings.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.bidirectional = True
+    p.use_rotary = False  # BERT uses absolute positions
+    return p
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    p = self.p
+    xent = self.emb.XentLossFromLogits(
+        predictions.logits, class_ids=input_batch.labels,
+        label_smoothing=p.label_smoothing)
+    weights = input_batch.masked_weights * py_utils.SequenceMask(
+        input_batch.paddings)
+    tot_weight = jnp.maximum(jnp.sum(weights), 1e-8)
+    avg_xent = jnp.sum(xent.per_example_xent * weights) / tot_weight
+    acc = jnp.sum(
+        (jnp.argmax(predictions.logits, -1) == input_batch.labels)
+        * weights) / tot_weight
+    metrics = NestedMap(
+        loss=(avg_xent, tot_weight),
+        mlm_log_pplx=(avg_xent, tot_weight),
+        mlm_accuracy=(acc, tot_weight),
+        num_predictions=(tot_weight, 1.0))
+    return metrics, NestedMap(xent=xent.per_example_xent)

@@ -153,3 +153,30 @@ class DenseLm128B(DenseLmTemplate):
   NUM_LAYERS = 64
   NUM_HEADS = 128
   HIDDEN_DIM = 65536
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLm175B(DenseLmTemplate):
+  """Ref DenseLm175B32x32 (`synthetic_packed_input.py:238-288`): GPT-3-scale
+  shapes — 96 blocks, model_dim 12288, ff 49152, 96 heads, seq 2048 — for a
+  2048-core slice (mesh data x model from runtime flags)."""
+
+  SEQUENCE_LENGTH = 2048
+  MODEL_DIM = 12288
+  NUM_LAYERS = 96
+  NUM_HEADS = 96
+  HIDDEN_DIM = 49152
+  BATCH_SIZE = 1  # per host; global batch from the data axis
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLm1T(DenseLmTemplate):
+  """Ref DenseLm1T16x16 (`synthetic_packed_input.py:330`): ~1T params with
+  512-way model parallelism — 128 blocks, model_dim 16384, ff 262144."""
+
+  SEQUENCE_LENGTH = 512
+  MODEL_DIM = 16384
+  NUM_LAYERS = 128
+  NUM_HEADS = 256
+  HIDDEN_DIM = 262144
+  BATCH_SIZE = 1

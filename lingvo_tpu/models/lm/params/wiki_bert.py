@@ -1,0 +1,99 @@
+"""BERT masked-LM configs (ref `lingvo/tasks/lm/params/wiki_bert.py`):
+bidirectional TransformerLm + MLM loss on synthetic masked batches until the
+native pipeline feeds real wiki shards (TextLmInput + a masking processor)."""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.lm import input_generator
+from lingvo_tpu.models.lm import layers as lm_layers
+
+
+class BertTemplate(base_model_params.SingleTaskModelParams):
+  """Shared BERT recipe."""
+
+  SEQUENCE_LENGTH = 512
+  BATCH_SIZE = 16
+  VOCAB_SIZE = 32000
+  MODEL_DIM = 768
+  NUM_LAYERS = 12
+  NUM_HEADS = 12
+  HIDDEN_DIM = 3072
+  LEARNING_RATE = 1e-4
+  MAX_STEPS = 1_000_000
+
+  def Train(self):
+    return input_generator.SyntheticBertInput.Params().Set(
+        batch_size=self.BATCH_SIZE, seq_len=self.SEQUENCE_LENGTH,
+        vocab_size=self.VOCAB_SIZE)
+
+  def Test(self):
+    return input_generator.SyntheticBertInput.Params().Set(
+        batch_size=self.BATCH_SIZE, seq_len=self.SEQUENCE_LENGTH,
+        vocab_size=self.VOCAB_SIZE, seed=99)
+
+  def Task(self):
+    p = lm_layers.BertLm.Params()
+    p.name = "bert"
+    p.vocab_size = self.VOCAB_SIZE
+    p.model_dim = self.MODEL_DIM
+    p.num_layers = self.NUM_LAYERS
+    p.num_heads = self.NUM_HEADS
+    p.hidden_dim = self.HIDDEN_DIM
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=self.LEARNING_RATE,
+        optimizer=opt_lib.AdamW.Params().Set(beta2=0.999,
+                                             weight_decay=0.01),
+        lr_schedule=sched_lib.LinearRampupCosineDecay.Params().Set(
+            warmup_steps=10000, total_steps=self.MAX_STEPS),
+        clip_gradient_norm_to_value=1.0)
+    p.train.max_steps = self.MAX_STEPS
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class BertBase(BertTemplate):
+  """BERT-Base shapes (ref wiki_bert Wiki/BertBase)."""
+
+
+@model_registry.RegisterSingleTaskModel
+class BertLarge(BertTemplate):
+  """BERT-Large shapes."""
+
+  MODEL_DIM = 1024
+  NUM_LAYERS = 24
+  NUM_HEADS = 16
+  HIDDEN_DIM = 4096
+
+
+@model_registry.RegisterSingleTaskModel
+class BertTiny(BertTemplate):
+  """Smoke-test scale (short pattern period: the masked-copy rule is
+  learnable in a few hundred steps instead of waiting out the induction
+  phase transition)."""
+
+  SEQUENCE_LENGTH = 64
+  BATCH_SIZE = 8
+  VOCAB_SIZE = 128
+  MODEL_DIM = 64
+  NUM_LAYERS = 2
+  NUM_HEADS = 4
+  HIDDEN_DIM = 128
+  LEARNING_RATE = 1e-3
+
+  def Train(self):
+    return super().Train().Set(pattern_len=4)
+
+  def Test(self):
+    return super().Test().Set(pattern_len=4)
+
+  def Task(self):
+    p = super().Task()
+    p.train.learner.lr_schedule = sched_lib.Constant.Params()
+    p.train.tpu_steps_per_loop = 20
+    return p

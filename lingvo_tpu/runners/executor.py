@@ -66,6 +66,9 @@ class ExecutorTpu:
         save_interval_steps=tp.save_interval_steps,
         max_to_keep=tp.save_max_to_keep)
     self._init_seed = init_seed
+    self._pruning_schedule = None
+    self._pruning_masks = None
+    self._last_prune_step = -1
     self._precompile = precompile
     self._max_steps = tp.max_steps
     # early stop on eval plateau (ref base_runner._ShouldStop + EarlyStop)
@@ -105,6 +108,29 @@ class ExecutorTpu:
     with open(os.path.join(self._logdir, "model_analysis.txt"), "w") as f:
       f.write("\n".join(lines) + "\n")
 
+  def _MaybePrune(self, state: NestedMap, step: int) -> NestedMap:
+    """Magnitude pruning between program runs (ref _GetMaskUpdateOp):
+    masks recomputed at the schedule cadence, re-applied every loop so
+    pruned weights cannot regrow."""
+    tp = self._task.p.train if self._task is not None else None
+    if tp is None or getattr(tp, "pruning", None) is None:
+      return state
+    from lingvo_tpu.core import pruning as pruning_lib
+    if self._pruning_schedule is None:
+      self._pruning_schedule = tp.pruning.Instantiate()
+    sched = self._pruning_schedule
+    if self._pruning_masks is None or sched.ShouldUpdate(
+        step, self._last_prune_step):
+      self._pruning_masks = pruning_lib.ComputeMasks(state.theta, sched,
+                                                     step)
+      self._last_prune_step = step
+    state.theta = pruning_lib.ApplyMasks(state.theta, self._pruning_masks)
+    if "ema_theta" in state:
+      # eval/decode/export read EMA weights — they must be pruned too
+      state.ema_theta = pruning_lib.ApplyMasks(state.ema_theta,
+                                               self._pruning_masks)
+    return state
+
   def _CreateTrainState(self) -> NestedMap:
     key = jax.random.PRNGKey(self._init_seed)
     if self._task is None or hasattr(self._schedule, "CreateTrainState"):
@@ -121,8 +147,11 @@ class ExecutorTpu:
     OOM, shape bugs) is fatal immediately.
     """
     state = self._CreateTrainState()
+    # 'no checkpoint at all' (fresh run) is distinct from 'restored the
+    # step-0 checkpoint' — warm start must apply only to the former
+    fresh_run = self._checkpointer.LatestStep() is None
     state, start_step = self._checkpointer.Restore(state)
-    if start_step == 0 and self._task is not None:
+    if fresh_run and self._task is not None:
       rules = getattr(self._task.p.train, "init_from_checkpoint_rules", None)
       if rules:
         # fresh run: warm-start matching vars from other checkpoints
@@ -157,6 +186,7 @@ class ExecutorTpu:
         state, step = self._checkpointer.Restore(self._CreateTrainState())
         continue
       step = int(jax.device_get(state.step))
+      state = self._MaybePrune(state, step)
       self._ExportMetrics(step, results)
       if self._early_stop is not None and self._task is not None:
         tp = self._task.p.train

@@ -213,8 +213,8 @@ class EvalProgram(BaseProgram):
   def _GetStepFn(self, state: NestedMap | None = None):
     if self._step_fn is None:
 
-      def _Step(theta, batch):
-        metrics, _ = self._task.EvalStep(theta, batch)
+      def _Step(theta, batch, step):
+        metrics, _ = self._task.EvalStep(theta, batch, step=step)
         return metrics
 
       self._step_fn = jax.jit(_Step)
@@ -245,7 +245,7 @@ class EvalProgram(BaseProgram):
     n = 0
     with self._MeshScope(), self._ProfilerScope():
       for batch in batches:
-        out = fn(theta, self._PutBatch(batch))
+        out = fn(theta, self._PutBatch(batch), state.step)
         acc = metrics_lib.AccumulateMetrics(acc, out)
         n += 1
         if n >= max_batches:

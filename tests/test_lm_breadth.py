@@ -1,0 +1,167 @@
+"""BERT MLM, giant-LM configs, GShard streaming decode driver (VERDICT r1
+item 9)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu import model_registry
+import lingvo_tpu.models.all_params  # noqa: F401
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TestBert:
+
+  def test_bert_learns_masked_prediction(self):
+    mp = model_registry.GetParams("lm.wiki_bert.BertTiny", "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    step = jax.jit(task.TrainStep)
+    losses, accs = [], []
+    for _ in range(150):
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+      accs.append(float(out.metrics.mlm_accuracy[0]))
+    # pattern-structured data: masked tokens are predictable from context
+    assert losses[-1] < 0.8 * losses[0], (losses[0], losses[-1])
+
+  def test_bert_is_bidirectional(self):
+    """MLM prediction at position i must see positions > i."""
+    mp = model_registry.GetParams("lm.wiki_bert.BertTiny", "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    preds = task.ComputePredictions(theta, batch)
+    # perturb the future: logits at position 0 must change
+    batch2 = batch.Copy()
+    batch2.ids = batch.ids.at[:, -8:].set(5)
+    preds2 = task.ComputePredictions(theta, batch2)
+    assert not np.allclose(np.asarray(preds.logits[:, 0]),
+                           np.asarray(preds2.logits[:, 0]), atol=1e-5)
+
+  def test_mlm_loss_only_on_masked_positions(self):
+    mp = model_registry.GetParams("lm.wiki_bert.BertTiny", "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    preds = task.ComputePredictions(theta, batch)
+    m1, _ = task.ComputeLoss(theta, preds, batch)
+    # corrupting labels at UNmasked positions must not change the loss
+    batch2 = batch.Copy()
+    batch2.labels = jnp.where(batch.masked_weights > 0, batch.labels, 7)
+    m2, _ = task.ComputeLoss(theta, preds, batch2)
+    np.testing.assert_allclose(float(m1.loss[0]), float(m2.loss[0]),
+                               rtol=1e-6)
+
+
+class TestGiantConfigs:
+
+  @pytest.mark.parametrize("name,expect_layers", [
+      ("lm.synthetic_packed_input.DenseLm175B", 96),
+      ("lm.synthetic_packed_input.DenseLm1T", 128),
+  ])
+  def test_params_instantiate_with_shapes(self, name, expect_layers):
+    """Registry smoke test (ref models_test_helper stubbed-variable runs):
+    full param trees build and variable specs have the advertised scale."""
+    mp = model_registry.GetParams(name, "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    assert mp.task.num_layers == expect_layers
+    specs = task.VariableSpecs()
+    total = 0
+    for _, wp in specs.FlattenItems():
+      n = 1
+      for d in wp.shape:
+        n *= int(d)
+      total += n
+    if "175B" in name:
+      assert total > 100e9, total
+    else:
+      assert total > 700e9, total
+
+
+class TestGShardDecodeDriver:
+
+  def test_decodes_every_new_checkpoint(self, tmp_path):
+    from lingvo_tpu.runners import gshard_decode
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+
+    # write two "training" checkpoints
+    train_dir = str(tmp_path / "train")
+    ckpt = checkpointer_lib.Checkpointer(train_dir)
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    state.step = jnp.asarray(10, jnp.int32)
+    ckpt.Save(10, state, force=True)
+    state.step = jnp.asarray(20, jnp.int32)
+    ckpt.Save(20, state, force=True)
+    ckpt.Close()
+    open(os.path.join(train_dir, "FINISHED"), "w").write("20")
+
+    out_path = str(tmp_path / "decodes.jsonl")
+    driver = gshard_decode.GShardDecode(
+        task, train_dir, out_path, max_decode_steps=8,
+        poll_interval_secs=0.1, timeout_secs=10.0)
+    prompts = np.array([[5, 6, 7, 8], [9, 10, 11, 12]], np.int32)
+    lens = np.array([4, 4], np.int32)
+    driver.Run(prompts, lens)
+
+    recs = [json.loads(l) for l in open(out_path)]
+    assert recs, "no decodes written"
+    assert recs[-1]["checkpoint_step"] == 20
+    assert len(recs[-1]["output_ids"]) == 8
+    assert recs[-1]["prompt_ids"] == [9, 10, 11, 12]
+
+  def test_greedy_matches_argmax_rollout(self, tmp_path):
+    """driver's jitted primed-cache sampler == naive re-encode rollout."""
+    from lingvo_tpu.runners import gshard_decode
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    train_dir = str(tmp_path / "train")
+    ckpt = checkpointer_lib.Checkpointer(train_dir)
+    state = task.CreateTrainState(jax.random.PRNGKey(3))
+    ckpt.Save(1, state, force=True)
+    ckpt.Close()
+
+    driver = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "o.jsonl"), max_decode_steps=4)
+    prompts = np.array([[5, 6, 7, 8]], np.int32)
+    recs = driver.DecodeOnce(1, prompts, np.array([4], np.int32))
+    got = recs[0]["output_ids"]
+
+    # naive rollout: full forward each step
+    theta = state.theta
+    ids = list(prompts[0])
+    for _ in range(4):
+      batch = NestedMap(
+          ids=jnp.asarray([ids], jnp.int32),
+          labels=jnp.zeros((1, len(ids)), jnp.int32),
+          paddings=jnp.zeros((1, len(ids)), jnp.float32))
+      preds = task.ComputePredictions(theta, batch)
+      ids.append(int(jnp.argmax(preds.logits[0, -1])))
+    assert got == ids[4:], (got, ids[4:])

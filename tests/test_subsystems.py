@@ -1,0 +1,310 @@
+"""Quantization, pruning, distillation, symbolic dims, builder DSL, sharded
+embedding (VERDICT r1 coverage rows 8/25/28/29/58)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import builder_layers
+from lingvo_tpu.core import distillation_task
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import pruning
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import quant_utils
+from lingvo_tpu.core import symbolic
+from lingvo_tpu.core import tpu_embedding_layers
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(17)
+
+
+class TestQuantization:
+
+  def test_fake_quant_ste(self):
+    x = jnp.linspace(-1.0, 1.0, 11)
+    q = quant_utils.FakeQuant(x, scale=jnp.asarray(0.25), bits=8)
+    # quantized to multiples of the scale
+    np.testing.assert_allclose(np.asarray(q) % 0.25, 0.0, atol=1e-6)
+    # straight-through: gradient is identity
+    g = jax.grad(lambda x: jnp.sum(quant_utils.FakeQuant(x, 0.25)))(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+  def test_projection_with_qdomain_trains(self):
+    p = layers_lib.ProjectionLayer.Params().Set(
+        name="proj", input_dim=8, output_dim=8,
+        qdomain=quant_utils.SymmetricQDomain.Params())
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (4, 8))
+    with py_utils.ForwardStateContext() as fwd:
+      out = layer.FProp(theta, x)
+    assert out.shape == (4, 8)
+    # activation range EMA was tracked via forward state
+    assert any("range_act" in k for k in fwd)
+    # quantization is coarse: outputs land on a lattice
+    grads = jax.grad(lambda th: jnp.sum(
+        layer.FProp(th, x) ** 2))(theta)
+    assert float(sum(jnp.sum(jnp.abs(g))
+                     for g in jax.tree.leaves(grads))) > 0
+
+  def test_scheduled_clip_anneals(self):
+    p = quant_utils.ScheduledClipQDomain.Params().Set(
+        name="qd", start_cap=8.0, end_cap=1.0, clip_start_step=0,
+        clip_end_step=100)
+    qd = p.Instantiate()
+    qd.FinalizePaths()
+    theta = qd.InstantiateVariables(KEY)
+    x = 5.0 * jnp.ones((4,))
+    with py_utils.GlobalStepContext(jnp.asarray(0)):
+      early = qd.QuantizeAct(theta, "act", x)
+    with py_utils.GlobalStepContext(jnp.asarray(1000)):
+      late = qd.QuantizeAct(theta, "act", x)
+    assert float(early[0]) > 4.0   # loose cap keeps the value
+    assert float(late[0]) <= 1.0   # tight cap clips it
+
+
+class TestPruning:
+
+  def _sched(self, **kw):
+    return pruning.PruningSchedule(
+        pruning.PruningSchedule.Params().Set(
+            weight_regex=r".*w", final_sparsity=0.5, begin_step=0,
+            end_step=100, **kw))
+
+  def test_sparsity_ramp(self):
+    s = self._sched()
+    assert s.SparsityAt(0) == 0.0
+    assert 0 < s.SparsityAt(50) < 0.5
+    assert abs(s.SparsityAt(100) - 0.5) < 1e-6
+    assert abs(s.SparsityAt(10**6) - 0.5) < 1e-6
+
+  def test_masks_zero_smallest_magnitudes(self):
+    s = self._sched()
+    theta = NestedMap(proj=NestedMap(
+        w=jnp.asarray([[0.1, -5.0], [3.0, -0.2]]),
+        b=jnp.asarray([1.0, 2.0])))
+    masks = pruning.ComputeMasks(theta, s, step=100)  # 50% sparsity
+    np.testing.assert_array_equal(np.asarray(masks.proj.w),
+                                  [[0.0, 1.0], [1.0, 0.0]])
+    np.testing.assert_array_equal(np.asarray(masks.proj.b), [1.0, 1.0])
+    pruned = pruning.ApplyMasks(theta, masks)
+    assert float(pruned.proj.w[0, 0]) == 0.0
+    assert abs(pruning.Sparsity(masks, s) - 0.5) < 1e-6
+
+  def test_executor_prunes(self, tmp_path):
+    import tests.test_executor_hardening as helpers
+    from lingvo_tpu.runners import executor as executor_lib
+    from lingvo_tpu.runners import program as program_lib
+    task_p = helpers._TaskParams(max_steps=20, steps_per_loop=5)
+    task_p.train.pruning = pruning.PruningSchedule.Params().Set(
+        weight_regex=r"proj\.w", final_sparsity=0.5, begin_step=0,
+        end_step=10, frequency=5)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=str(tmp_path), steps_per_loop=5)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+        task=task, input_generators={"Train": helpers._RegressionInput()})
+    ex = executor_lib.ExecutorTpu(task_p, str(tmp_path), schedule=sched,
+                                  task=task)
+    state = ex.Start()
+    w = np.asarray(state.theta.proj.w)
+    sparsity = (w == 0).mean()
+    assert sparsity >= 0.45, sparsity
+
+
+class TestDistillation:
+
+  def test_teacher_frozen_student_learns(self):
+    student_p = base_model.BaseTask.Params()  # placeholder (unused)
+    import tests.test_executor_hardening as helpers
+
+    class _ClsTask(base_model.BaseTask):
+      @classmethod
+      def Params(cls):
+        p = super().Params()
+        p.Define("dim", 4, "")
+        p.Define("nclass", 3, "")
+        return p
+
+      def __init__(self, params):
+        super().__init__(params)
+        self.CreateChild("proj", layers_lib.ProjectionLayer.Params().Set(
+            input_dim=self.p.dim, output_dim=self.p.nclass))
+
+      def ComputePredictions(self, theta, input_batch):
+        return NestedMap(logits=self.proj.FProp(theta.proj, input_batch.x))
+
+      def ComputeLoss(self, theta, predictions, input_batch):
+        xent = layers_lib.XentLossFromLogits(
+            predictions.logits, self.p.nclass,
+            class_ids=input_batch.y).per_example_xent
+        return NestedMap(loss=(jnp.mean(xent), 4.0)), NestedMap()
+
+    p = distillation_task.DistillationTask.Params().Set(name="distill")
+    p.teacher = _ClsTask.Params().Set(name="teacher")
+    p.student = _ClsTask.Params().Set(name="student")
+    p.distill_weight = 0.5
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=0.1, optimizer=opt_lib.Adam.Params())
+    task = p.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(KEY)
+    teacher_w0 = np.asarray(state.theta.teacher.proj.w).copy()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 4).astype("float32")
+    y = rng.randint(0, 3, 16)
+    batch = NestedMap(x=jnp.asarray(x), y=jnp.asarray(y))
+    step = jax.jit(task.TrainStep)
+    losses = []
+    for _ in range(30):
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+    assert losses[-1] < losses[0]
+    # the teacher never moved
+    np.testing.assert_array_equal(teacher_w0,
+                                  np.asarray(state.theta.teacher.proj.w))
+    # metrics expose both components
+    assert "hard_loss" in out.metrics and "distill_loss" in out.metrics
+
+
+class TestSymbolic:
+
+  def test_symbol_resolution(self):
+    d = symbolic.Symbol("model_dim")
+    expr = 4 * d
+    with symbolic.SymbolToValueMap({d: 256}):
+      assert symbolic.EvalExpr(expr) == 1024
+      assert symbolic.EvalExpr((d, 2 * d)) == (256, 512)
+      assert symbolic.EvalExpr(7) == 7
+    with pytest.raises(ValueError):
+      symbolic.EvalExpr(expr)
+
+  def test_nested_scopes_override(self):
+    d = symbolic.Symbol("d")
+    with symbolic.SymbolToValueMap({d: 8}):
+      with symbolic.SymbolToValueMap({d: 16}):
+        assert symbolic.EvalExpr(d) == 16
+      assert symbolic.EvalExpr(d) == 8
+
+  def test_shape_algebra(self):
+    d = symbolic.Symbol("d")
+    s = symbolic.Shape([2, d]) + symbolic.Shape([3 * d])
+    assert len(s) == 3
+    with symbolic.SymbolToValueMap({d: 4}):
+      assert s.ToTuple() == (2, 4, 12)
+    assert s.size == 2 * d * 3 * d
+
+
+class TestBuilderDsl:
+
+  def _proj(self, name, din, dout):
+    return layers_lib.ProjectionLayer.Params().Set(
+        name=name, input_dim=din, output_dim=dout, has_bias=False)
+
+  def test_seq_par_graph(self):
+    b = builder_layers.Builder()
+    seq = b._Seq("seq", self._proj("p1", 4, 8), self._proj("p2", 8, 4))
+    layer = seq.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (2, 4))
+    out = layer.FProp(theta, x)
+    assert out.shape == (2, 4)
+    # manual composition matches
+    manual = jnp.einsum("bi,io->bo", jnp.einsum("bi,io->bo", x,
+                                                theta.sub[0].w),
+                        theta.sub[1].w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(manual),
+                               atol=1e-5)
+
+    par = b._Par("par", self._proj("a", 4, 4), self._proj("c", 4, 4))
+    pl = par.Instantiate()
+    pl.FinalizePaths()
+    ptheta = pl.InstantiateVariables(KEY)
+    pout = pl.FProp(ptheta, x)
+    expect = (jnp.einsum("bi,io->bo", x, ptheta.sub[0].w)
+              + jnp.einsum("bi,io->bo", x, ptheta.sub[1].w))
+    np.testing.assert_allclose(np.asarray(pout), np.asarray(expect),
+                               atol=1e-5)
+
+    graph = b._Graph(
+        "g", ["x"], ["y"],
+        ("x->h", self._proj("e1", 4, 8)),
+        ("h->y", self._proj("e2", 8, 4)))
+    gl = graph.Instantiate()
+    gl.FinalizePaths()
+    gtheta = gl.InstantiateVariables(KEY)
+    gout = gl.FProp(gtheta, NestedMap(x=x))
+    assert gout.y.shape == (2, 4)
+
+  def test_soft_cond(self):
+    p = builder_layers.SoftCondLayer.Params().Set(
+        name="sc", sub=self._proj("e", 4, 4), num_experts=3, cond_dim=4)
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(KEY, (2, 4))
+    out = layer.FProp(theta, x)
+    assert out.shape == (2, 4)
+
+
+class TestShardedEmbedding:
+
+  def test_lookup_and_combiner(self):
+    p = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        name="tbl", vocab_size=50, embedding_dim=8, combiner="mean")
+    tbl = p.Instantiate()
+    tbl.FinalizePaths()
+    theta = tbl.InstantiateVariables(KEY)
+    ids = jnp.asarray([[1, 2, 2], [3, 0, 0]], jnp.int32)
+    emb = tbl.EmbLookup(theta, ids)
+    assert emb.shape == (2, 3, 8)
+    weights = jnp.asarray([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+    combined = tbl.MultivalentLookup(theta, ids, weights)
+    expect0 = (np.asarray(theta.table[1]) + np.asarray(theta.table[2])) / 2
+    np.testing.assert_allclose(np.asarray(combined[0]), expect0, atol=1e-5)
+
+  def test_sharded_over_mesh(self):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs 8 devices")
+    from lingvo_tpu.parallel import mesh as mesh_lib
+    p = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        name="tbl", vocab_size=64, embedding_dim=8, shard_axis="data")
+    tbl = p.Instantiate()
+    tbl.FinalizePaths()
+    theta = tbl.InstantiateVariables(KEY)
+    mesh = mesh_lib.MakeMesh({"data": 8})
+    sh = mesh_lib.ThetaShardings(mesh, tbl, theta)
+    placed = jax.device_put(theta, sh)
+    assert "data" in str(placed.table.sharding.spec)
+    ids = jnp.asarray([[1], [63]], jnp.int32)
+    out = jax.jit(tbl.EmbLookup)(placed, ids)
+    np.testing.assert_allclose(np.asarray(out[0, 0]),
+                               np.asarray(theta.table[1]), atol=1e-5)
+
+  def test_collection_routes_features(self):
+    tp = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        vocab_size=10, embedding_dim=4)
+    p = tpu_embedding_layers.TpuEmbeddingCollection.Params().Set(
+        name="coll",
+        tables=[("words", tp.Copy()), ("cats", tp.Copy())],
+        feature_to_table={"query": "words", "doc": "words",
+                          "category": "cats"})
+    coll = p.Instantiate()
+    coll.FinalizePaths()
+    theta = coll.InstantiateVariables(KEY)
+    feats = NestedMap(query=jnp.asarray([1, 2]), doc=jnp.asarray([3]),
+                      category=jnp.asarray([4]))
+    out = coll.EmbLookup(theta, feats)
+    assert out.query.shape == (2, 4)
+    # query and doc share the words table
+    np.testing.assert_allclose(
+        np.asarray(out.doc[0]),
+        np.asarray(theta.table_words.table[3]), atol=1e-5)
