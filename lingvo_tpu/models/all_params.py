@@ -26,3 +26,16 @@ try:
   from lingvo_tpu.models.asr.params import librispeech  # noqa: F401
 except ImportError:
   pass
+
+try:
+  from lingvo_tpu.models.punctuator.params import codelab  # noqa: F401
+except ImportError:
+  pass
+try:
+  from lingvo_tpu.models.milan.params import dual_encoder  # noqa: F401
+except ImportError:
+  pass
+try:
+  from lingvo_tpu.models.car.params import kitti  # noqa: F401
+except ImportError:
+  pass

@@ -1,0 +1,75 @@
+"""Car input: synthetic pillared point clouds with box targets (ref the
+KITTI/Waymo loaders in `lingvo/tasks/car/` — here the target-assignment
+convention those pipelines produce, generated synthetically)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class SyntheticCarInput(base_input_generator.BaseInputGenerator):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("grid_size", 16, "BEV grid G (world is [0, G) x [0, G)).")
+    p.Define("max_pillars", 64, "P.")
+    p.Define("points_per_pillar", 8, "N.")
+    p.Define("num_objects", 3, "Ground-truth boxes per scene.")
+    p.Define("num_classes", 2, "Foreground classes.")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._step = 0
+
+  @property
+  def point_dim(self):
+    return 4  # x, y, z, intensity
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 48271 * self._step) % (2**31))
+    self._step += 1
+    b, g = p.batch_size, p.grid_size
+    pts = np.zeros((b, p.max_pillars, p.points_per_pillar, 4), np.float32)
+    ppad = np.ones((b, p.max_pillars, p.points_per_pillar), np.float32)
+    cells = np.full((b, p.max_pillars), -1, np.int32)
+    cls_t = np.zeros((b, g * g), np.int32)
+    reg_t = np.zeros((b, g * g, 7), np.float32)
+    reg_w = np.zeros((b, g * g), np.float32)
+    for i in range(b):
+      pillar = 0
+      for _ in range(p.num_objects):
+        cx, cy = rng.uniform(1, g - 1, 2)
+        cz = rng.uniform(-1, 1)
+        l, w, h = rng.uniform(0.5, 2.0, 3)
+        theta = rng.uniform(-np.pi, np.pi)
+        cls = rng.randint(1, p.num_classes + 1)
+        cell = int(cy) * g + int(cx)
+        cls_t[i, cell] = cls
+        # residuals relative to the cell center (standard encoding)
+        reg_t[i, cell] = [cx - (int(cx) + 0.5), cy - (int(cy) + 0.5),
+                          cz, l, w, h, theta]
+        reg_w[i, cell] = 1.0
+        # a couple of pillars of points inside the box
+        for _ in range(2):
+          if pillar >= p.max_pillars:
+            break
+          n = rng.randint(2, p.points_per_pillar + 1)
+          pts[i, pillar, :n, 0] = cx + rng.uniform(-l / 2, l / 2, n)
+          pts[i, pillar, :n, 1] = cy + rng.uniform(-w / 2, w / 2, n)
+          pts[i, pillar, :n, 2] = cz + rng.uniform(-h / 2, h / 2, n)
+          pts[i, pillar, :n, 3] = cls  # class-colored intensity: learnable
+          ppad[i, pillar, :n] = 0.0
+          px = int(np.clip(pts[i, pillar, 0, 0], 0, g - 1))
+          py = int(np.clip(pts[i, pillar, 0, 1], 0, g - 1))
+          cells[i, pillar] = py * g + px
+          pillar += 1
+    return NestedMap(
+        pillar_points=pts, point_paddings=ppad, pillar_cells=cells,
+        cls_targets=cls_t, reg_targets=reg_t, reg_weights=reg_w)

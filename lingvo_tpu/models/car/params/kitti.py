@@ -1,0 +1,41 @@
+"""Car configs (ref `lingvo/tasks/car/params/kitti.py` StarNetCarModel /
+PointPillars recipes, on synthetic scenes until real KITTI prep lands)."""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.car import input_generator
+from lingvo_tpu.models.car import pillars
+
+
+@model_registry.RegisterSingleTaskModel
+class PointPillarsCar(base_model_params.SingleTaskModelParams):
+
+  BATCH_SIZE = 16
+  GRID = 16
+  FEATURE_DIM = 64
+
+  def Train(self):
+    return input_generator.SyntheticCarInput.Params().Set(
+        batch_size=self.BATCH_SIZE, grid_size=self.GRID)
+
+  def Test(self):
+    return self.Train().Set(seed=99)
+
+  def Task(self):
+    p = pillars.PointPillarsModel.Params()
+    p.name = "car_pillars"
+    p.featurizer.point_dim = 4
+    p.featurizer.feature_dim = self.FEATURE_DIM
+    p.backbone.grid_size = self.GRID
+    p.backbone.feature_dim = self.FEATURE_DIM
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params(),
+        lr_schedule=sched_lib.Constant.Params())
+    p.train.tpu_steps_per_loop = 50
+    return p

@@ -1,0 +1,197 @@
+"""Point-cloud 3D detection: PointPillars-style slice of the car family
+(ref `lingvo/tasks/car/` — StarNet/PointPillars models, `pillars.py`,
+`point_detector.py`; the 22k-LoC reference also carries KITTI/Waymo
+pipelines and extensive geometry libs, which enter as data prep here).
+
+TPU-first shapes: the pillar featurizer is a per-point MLP + masked
+max-pool (batched matmuls), the scatter of pillar features onto the BEV
+grid is a one-hot einsum (MXU-friendly; no data-dependent scatter), and
+the backbone/head are dense convs — everything static-shape under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class PillarFeaturizer(base_layer.BaseLayer):
+  """Per-point MLP + masked max-pool per pillar (ref PointNet featurizer)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("point_dim", 0, "Per-point input features.")
+    p.Define("feature_dim", 64, "Pillar feature dim C.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "mlp",
+        layers_lib.FeedForwardNet.Params().Set(
+            input_dim=p.point_dim,
+            hidden_layer_dims=[p.feature_dim, p.feature_dim]))
+
+  def FProp(self, theta, pillar_points, point_paddings):
+    """[b, P, N, D], [b, P, N] -> pillar features [b, P, C]."""
+    feats = self.mlp.FProp(theta.mlp, pillar_points)      # [b,P,N,C]
+    masked = jnp.where(point_paddings[..., None] > 0.5, -1e9, feats)
+    pooled = jnp.max(masked, axis=2)
+    # pillars with zero points pool to -1e9: zero them
+    any_point = jnp.any(point_paddings < 0.5, axis=2, keepdims=True)
+    return jnp.where(any_point, pooled, 0.0)
+
+
+class BevBackboneHead(base_layer.BaseLayer):
+  """Scatter to BEV + conv backbone + per-cell detection head."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("grid_size", 16, "BEV grid is grid_size x grid_size.")
+    p.Define("feature_dim", 64, "Input pillar feature dim.")
+    p.Define("num_classes", 2, "Foreground classes (0 = background).")
+    p.Define("box_dims", 7, "Box residual dims (x,y,z,l,w,h,theta).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    c = p.feature_dim
+    self.CreateChild(
+        "conv1",
+        layers_lib.Conv2DLayer.Params().Set(
+            filter_shape=(3, 3, c, c), filter_stride=(1, 1),
+            activation="RELU", batch_norm=False, has_bias=True))
+    self.CreateChild(
+        "conv2",
+        layers_lib.Conv2DLayer.Params().Set(
+            filter_shape=(3, 3, c, c), filter_stride=(1, 1),
+            activation="RELU", batch_norm=False, has_bias=True))
+    self.CreateChild(
+        "cls_head",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=c, output_dim=p.num_classes + 1))
+    self.CreateChild(
+        "reg_head",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=c, output_dim=p.box_dims))
+
+  def FProp(self, theta, pillar_feats, pillar_cells):
+    """pillar_feats [b, P, C], pillar_cells [b, P] (flat BEV cell index or
+    -1 for empty) -> (cls_logits [b, G*G, K+1], box_residuals [b, G*G, 7])."""
+    p = self.p
+    g2 = p.grid_size * p.grid_size
+    valid = (pillar_cells >= 0)
+    one_hot = jax.nn.one_hot(
+        jnp.where(valid, pillar_cells, 0), g2,
+        dtype=pillar_feats.dtype)                          # [b,P,G2]
+    one_hot = one_hot * valid[..., None].astype(one_hot.dtype)
+    # scatter-as-einsum: multiple pillars in one cell SUM their features
+    bev = jnp.einsum("bpc,bpg->bgc", pillar_feats, one_hot)
+    b = bev.shape[0]
+    img = bev.reshape(b, p.grid_size, p.grid_size, -1)
+    img = self.conv1.FProp(theta.conv1, img)
+    img = self.conv2.FProp(theta.conv2, img)
+    flat = img.reshape(b, g2, -1)
+    return (self.cls_head.FProp(theta.cls_head, flat),
+            self.reg_head.FProp(theta.reg_head, flat))
+
+
+class PointPillarsModel(base_model.BaseTask):
+  """Single-anchor-per-cell detector.
+
+  Batch contract (targets precomputed by the input pipeline like the
+  reference's KITTI loaders):
+    pillar_points [b,P,N,D], point_paddings [b,P,N], pillar_cells [b,P]
+    cls_targets [b, G*G] int (0=background), reg_targets [b, G*G, 7],
+    reg_weights [b, G*G] (1 on positive cells)
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("featurizer", PillarFeaturizer.Params(), "Pillar featurizer.")
+    p.Define("backbone", BevBackboneHead.Params(), "BEV backbone + heads.")
+    p.Define("reg_loss_weight", 2.0, "Box regression loss weight.")
+    p.Define("num_boxes_to_decode", 8, "Top-k boxes emitted by Decode.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("featurizer", self.p.featurizer)
+    self.CreateChild("backbone", self.p.backbone)
+
+  def ComputePredictions(self, theta, input_batch):
+    feats = self.featurizer.FProp(
+        self.ChildTheta(theta, "featurizer"), input_batch.pillar_points,
+        input_batch.point_paddings)
+    cls_logits, reg = self.backbone.FProp(
+        self.ChildTheta(theta, "backbone"), feats, input_batch.pillar_cells)
+    return NestedMap(cls_logits=cls_logits, box_residuals=reg)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    cls_logits = predictions.cls_logits.astype(jnp.float32)
+    num_classes = cls_logits.shape[-1]
+    onehot = jax.nn.one_hot(input_batch.cls_targets, num_classes)
+    cls_loss = -jnp.sum(
+        onehot * jax.nn.log_softmax(cls_logits, -1), -1)   # [b, G2]
+    # focal-style down-weighting of easy negatives (ref car losses)
+    probs = jax.nn.softmax(cls_logits, -1)
+    pt = jnp.sum(onehot * probs, -1)
+    cls_loss = cls_loss * (1.0 - pt) ** 2
+    cls_loss = jnp.mean(cls_loss)
+
+    diff = (predictions.box_residuals.astype(jnp.float32)
+            - input_batch.reg_targets)
+    huber = jnp.where(jnp.abs(diff) < 1.0, 0.5 * diff * diff,
+                      jnp.abs(diff) - 0.5)
+    w = input_batch.reg_weights
+    reg_loss = jnp.sum(huber.sum(-1) * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    total = cls_loss + self.p.reg_loss_weight * reg_loss
+    b = float(cls_logits.shape[0])
+    return NestedMap(loss=(total, b), cls_loss=(cls_loss, b),
+                     reg_loss=(reg_loss, b)), NestedMap()
+
+  def Decode(self, theta, input_batch):
+    preds = self.ComputePredictions(theta, input_batch)
+    probs = jax.nn.softmax(preds.cls_logits.astype(jnp.float32), -1)
+    fg_score = 1.0 - probs[..., 0]                         # [b, G2]
+    k = self.p.num_boxes_to_decode
+    top_scores, top_cells = jax.lax.top_k(fg_score, k)
+    top_boxes = jnp.take_along_axis(preds.box_residuals,
+                                    top_cells[..., None], axis=1)
+    top_cls = jnp.take_along_axis(jnp.argmax(probs, -1), top_cells, axis=1)
+    return NestedMap(scores=top_scores, cells=top_cells, boxes=top_boxes,
+                     classes=top_cls,
+                     gt_cls_targets=input_batch.cls_targets)
+
+  def CreateDecoderMetrics(self):
+    from lingvo_tpu.core import metrics as metrics_lib
+    return {"cell_precision": metrics_lib.AverageMetric(),
+            "cell_recall": metrics_lib.AverageMetric()}
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    """Cell-level detection precision/recall at score>0.5 (the AP slice;
+    full rotated-IoU AP lives with real-data geometry in tools/)."""
+    scores = np.asarray(decode_out.scores)
+    cells = np.asarray(decode_out.cells)
+    gt = np.asarray(decode_out.gt_cls_targets)
+    for i in range(scores.shape[0]):
+      pred_cells = set(cells[i][scores[i] > 0.5].tolist())
+      gt_cells = set(np.nonzero(gt[i])[0].tolist())
+      if pred_cells:
+        decoder_metrics["cell_precision"].Update(
+            len(pred_cells & gt_cells) / len(pred_cells))
+      if gt_cells:
+        decoder_metrics["cell_recall"].Update(
+            len(pred_cells & gt_cells) / len(gt_cells))

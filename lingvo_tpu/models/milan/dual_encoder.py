@@ -1,0 +1,126 @@
+"""Milan dual-encoder retrieval (ref `lingvo/tasks/milan/dual_encoder.py`):
+two modality encoders projected into a shared space, trained with the
+symmetric in-batch contrastive softmax loss, evaluated by retrieval
+recall@k.
+
+TPU-first: the in-batch similarity matrix is one [B, B] matmul (MXU);
+under data parallelism the batch dim shards and XLA inserts the all-gather
+of the opposite tower's embeddings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import base_model
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+
+
+class MlpEncoder(base_layer.BaseLayer):
+  """Feature-vector encoder tower (image features / pooled text)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Input feature dim.")
+    p.Define("hidden_dims", [256], "MLP hidden dims.")
+    p.Define("output_dim", 128, "Joint embedding dim.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "mlp",
+        layers_lib.FeedForwardNet.Params().Set(
+            input_dim=p.input_dim,
+            hidden_layer_dims=list(p.hidden_dims) + [p.output_dim],
+            activation=["RELU"] * len(p.hidden_dims) + ["NONE"]))
+
+  def FProp(self, theta, features):
+    return self.mlp.FProp(theta.mlp, features)
+
+
+class DualEncoderTask(base_model.BaseTask):
+  """Two towers + temperature-scaled contrastive loss (ref
+  `dual_encoder.py` loss + `score_functions`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("image_encoder", MlpEncoder.Params(), "Tower A.")
+    p.Define("text_encoder", MlpEncoder.Params(), "Tower B.")
+    p.Define("init_temperature", 0.07, "Softmax temperature (learned log).")
+    p.Define("recall_at", (1, 5), "Ks for retrieval recall metrics.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild("image_encoder", p.image_encoder)
+    self.CreateChild("text_encoder", p.text_encoder)
+    self.CreateVariable(
+        "log_inv_temperature",
+        WeightParams((), WeightInit.Constant(
+            float(np.log(1.0 / p.init_temperature))), jnp.float32))
+
+  def _Embed(self, theta, input_batch):
+    img = self.image_encoder.FProp(
+        self.ChildTheta(theta, "image_encoder"), input_batch.image)
+    txt = self.text_encoder.FProp(
+        self.ChildTheta(theta, "text_encoder"), input_batch.text)
+    img = img / jnp.maximum(
+        jnp.linalg.norm(img, axis=-1, keepdims=True), 1e-6)
+    txt = txt / jnp.maximum(
+        jnp.linalg.norm(txt, axis=-1, keepdims=True), 1e-6)
+    return img, txt
+
+  def ComputePredictions(self, theta, input_batch):
+    th = self.CastTheta(theta)
+    img, txt = self._Embed(theta, input_batch)
+    scale = jnp.exp(th.log_inv_temperature)
+    sims = scale * jnp.einsum("id,jd->ij", img, txt)     # [B, B]
+    return NestedMap(similarities=sims, image_emb=img, text_emb=txt)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    sims = predictions.similarities.astype(jnp.float32)
+    b = sims.shape[0]
+    labels = jnp.arange(b)
+    i2t = -jnp.mean(jax.nn.log_softmax(sims, axis=1)[labels, labels])
+    t2i = -jnp.mean(jax.nn.log_softmax(sims, axis=0)[labels, labels])
+    loss = 0.5 * (i2t + t2i)
+    metrics = NestedMap(
+        loss=(loss, float(b)),
+        i2t_loss=(i2t, float(b)),
+        t2i_loss=(t2i, float(b)))
+    for k in self.p.recall_at:
+      if k <= b:
+        topk = jnp.argsort(-sims, axis=1)[:, :k]          # i2t retrieval
+        hit = jnp.any(topk == labels[:, None], axis=1)
+        metrics.Set(f"recall_at_{k}", (jnp.mean(
+            hit.astype(jnp.float32)), float(b)))
+    return metrics, NestedMap()
+
+  def Decode(self, theta, input_batch):
+    preds = self.ComputePredictions(theta, input_batch)
+    return NestedMap(similarities=preds.similarities)
+
+  def CreateDecoderMetrics(self):
+    from lingvo_tpu.core import metrics as metrics_lib
+    return {f"recall_at_{k}": metrics_lib.AverageMetric()
+            for k in self.p.recall_at}
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    sims = np.asarray(decode_out.similarities)
+    b = sims.shape[0]
+    order = np.argsort(-sims, axis=1)
+    for k in self.p.recall_at:
+      if k <= b:
+        hit = (order[:, :k] == np.arange(b)[:, None]).any(axis=1)
+        for h in hit:
+          decoder_metrics[f"recall_at_{k}"].Update(float(h))

@@ -1,0 +1,44 @@
+"""Milan input: synthetic paired (image-feature, text-feature) batches.
+
+Pairs share a latent code rendered through two fixed random linear maps +
+noise — cross-modal retrieval is learnable but not trivial (ref milan's
+image/text input pipelines over tfrecords; plug TextMtInput-style file
+generators for real data)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class SyntheticPairedInput(base_input_generator.BaseInputGenerator):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("latent_dim", 16, "Shared latent code dim.")
+    p.Define("image_dim", 64, "Image feature dim.")
+    p.Define("text_dim", 48, "Text feature dim.")
+    p.Define("noise", 0.1, "Observation noise.")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    rng = np.random.RandomState(4242)  # fixed across train/test
+    self._img_map = rng.randn(p.latent_dim, p.image_dim).astype(np.float32)
+    self._txt_map = rng.randn(p.latent_dim, p.text_dim).astype(np.float32)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 92821 * self._step) % (2**31))
+    self._step += 1
+    z = rng.randn(p.batch_size, p.latent_dim).astype(np.float32)
+    img = z @ self._img_map + p.noise * rng.randn(p.batch_size, p.image_dim)
+    txt = z @ self._txt_map + p.noise * rng.randn(p.batch_size, p.text_dim)
+    return NestedMap(image=img.astype(np.float32),
+                     text=txt.astype(np.float32))

@@ -1,0 +1,42 @@
+"""Milan configs (ref `lingvo/tasks/milan/params/*`)."""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.milan import dual_encoder
+from lingvo_tpu.models.milan import input_generator
+
+
+@model_registry.RegisterSingleTaskModel
+class MilanDualEncoder(base_model_params.SingleTaskModelParams):
+
+  BATCH_SIZE = 64
+  IMAGE_DIM = 64
+  TEXT_DIM = 48
+  EMB_DIM = 128
+
+  def Train(self):
+    return input_generator.SyntheticPairedInput.Params().Set(
+        batch_size=self.BATCH_SIZE, image_dim=self.IMAGE_DIM,
+        text_dim=self.TEXT_DIM)
+
+  def Test(self):
+    return self.Train().Set(seed=99)
+
+  def Task(self):
+    p = dual_encoder.DualEncoderTask.Params()
+    p.name = "milan"
+    p.image_encoder.input_dim = self.IMAGE_DIM
+    p.image_encoder.output_dim = self.EMB_DIM
+    p.text_encoder.input_dim = self.TEXT_DIM
+    p.text_encoder.output_dim = self.EMB_DIM
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params(),
+        lr_schedule=sched_lib.Constant.Params())
+    p.train.tpu_steps_per_loop = 50
+    return p

@@ -1,0 +1,69 @@
+"""Punctuator input: synthetic 'strip the punctuation' pairs (ref
+`lingvo/tasks/punctuator/input_generator.py` over the Brown corpus:
+source = lowercased unpunctuated text, target = original).
+
+Token convention: content ids 5.., punctuation ids {3, 4} ('comma',
+'period'); the source drops punctuation tokens, the target keeps them —
+exactly the restoration task, fully learnable synthetically."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+COMMA, PERIOD = 3, 4
+
+
+class SyntheticPunctuatorInput(base_input_generator.BaseInputGenerator):
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("src_seq_len", 20, "Max source tokens.")
+    p.Define("tgt_seq_len", 26, "Max target tokens (incl sos/eos + punct).")
+    p.Define("vocab_size", 64, "Vocab; content ids 5..")
+    p.Define("sos_id", 1, "SOS.")
+    p.Define("eos_id", 2, "EOS.")
+    p.Define("clause_len", 4, "Tokens between punctuation marks.")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 60013 * self._step) % (2**31))
+    self._step += 1
+    b = p.batch_size
+    src_ids = np.zeros((b, p.src_seq_len), np.int32)
+    src_pad = np.ones((b, p.src_seq_len), np.float32)
+    tgt_ids = np.zeros((b, p.tgt_seq_len), np.int32)
+    tgt_labels = np.zeros((b, p.tgt_seq_len), np.int32)
+    tgt_pad = np.ones((b, p.tgt_seq_len), np.float32)
+    for i in range(b):
+      n = rng.randint(p.clause_len, p.src_seq_len + 1)
+      content = rng.randint(5, p.vocab_size, n)
+      # deterministic punctuation rule: comma after each clause, period at
+      # the end — recoverable from position within the clause structure
+      punctuated = []
+      for j, tok in enumerate(content):
+        punctuated.append(tok)
+        if (j + 1) % p.clause_len == 0 and j + 1 < n:
+          punctuated.append(COMMA)
+      punctuated.append(PERIOD)
+      punctuated = punctuated[:p.tgt_seq_len - 1]
+      src_ids[i, :n] = content
+      src_pad[i, :n] = 0.0
+      m = len(punctuated)  # <= tgt_seq_len - 1 by the truncation above
+      tgt_ids[i, 0] = p.sos_id
+      tgt_ids[i, 1:m + 1] = punctuated
+      tgt_labels[i, :m] = punctuated
+      tgt_labels[i, m] = p.eos_id
+      tgt_pad[i, :m + 1] = 0.0
+    return NestedMap(
+        src=NestedMap(ids=src_ids, paddings=src_pad),
+        tgt=NestedMap(ids=tgt_ids, labels=tgt_labels, paddings=tgt_pad))

@@ -1,0 +1,66 @@
+"""Punctuator configs (ref `lingvo/tasks/punctuator/params/codelab.py`
+RNMTModel — here the transformer seq2seq, which subsumes the RNMT recipe on
+TPU)."""
+
+from __future__ import annotations
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.mt import model as mt_model
+from lingvo_tpu.models.punctuator import input_generator
+
+
+@model_registry.RegisterSingleTaskModel
+class TransformerModel(base_model_params.SingleTaskModelParams):
+  """Punctuation restoration as seq2seq translation."""
+
+  BATCH_SIZE = 32
+  VOCAB = 64
+  MODEL_DIM = 128
+  NUM_LAYERS = 4
+  NUM_HEADS = 4
+  HIDDEN_DIM = 512
+  SRC_LEN = 20
+  TGT_LEN = 26
+
+  def Train(self):
+    return input_generator.SyntheticPunctuatorInput.Params().Set(
+        batch_size=self.BATCH_SIZE, vocab_size=self.VOCAB,
+        src_seq_len=self.SRC_LEN, tgt_seq_len=self.TGT_LEN)
+
+  def Test(self):
+    return self.Train().Set(seed=99)
+
+  def Task(self):
+    p = mt_model.TransformerModel.Params()
+    p.name = "punctuator"
+    for enc_dec in (p.encoder, p.decoder):
+      enc_dec.vocab_size = self.VOCAB
+      enc_dec.model_dim = self.MODEL_DIM
+      enc_dec.num_layers = self.NUM_LAYERS
+      enc_dec.num_heads = self.NUM_HEADS
+      enc_dec.hidden_dim = self.HIDDEN_DIM
+    p.decoder.beam_search.target_seq_len = self.TGT_LEN
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params().Set(beta2=0.98),
+        lr_schedule=sched_lib.Constant.Params(),
+        clip_gradient_norm_to_value=1.0)
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class TransformerModelTiny(TransformerModel):
+  """Smoke-test scale."""
+
+  BATCH_SIZE = 8
+  MODEL_DIM = 32
+  NUM_LAYERS = 2
+  NUM_HEADS = 2
+  HIDDEN_DIM = 64
+  SRC_LEN = 12
+  TGT_LEN = 18

@@ -1,0 +1,91 @@
+"""Punctuator, milan, car task families (VERDICT r1 coverage rows 70/73/75)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu import model_registry
+import lingvo_tpu.models.all_params  # noqa: F401
+
+
+def _train(name, steps, overrides=None):
+  mp = model_registry.GetParams(name, "Train")
+  mp.task.input = mp.input
+  if overrides:
+    overrides(mp)
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  state = task.CreateTrainState(jax.random.PRNGKey(0))
+  gen = mp.input.Instantiate()
+  step = jax.jit(task.TrainStep)
+  losses = []
+  out = None
+  for _ in range(steps):
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    state, out = step(state, batch)
+    losses.append(float(out.metrics.loss[0]))
+  return task, state, losses, out, gen
+
+
+class TestPunctuator:
+
+  def test_trains_and_decodes(self):
+    task, state, losses, _, gen = _train(
+        "punctuator.codelab.TransformerModelTiny", 100)
+    assert losses[-1] < 0.9 * losses[0], (losses[0], losses[-1])
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    assert dec.topk_ids.shape[0] == batch.src.ids.shape[0]
+
+
+class TestMilan:
+
+  def test_contrastive_retrieval_learns(self):
+    task, state, losses, out, gen = _train("milan.dual_encoder.MilanDualEncoder", 60)
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    # in-batch retrieval recall improves well past chance (1/64)
+    assert float(out.metrics.recall_at_1[0]) > 0.2
+    # decode path: recall metrics over the similarity matrix
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    m = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(
+        jax.tree_util.tree_map(np.asarray, dec), m)
+    res = task.DecodeFinalize(m)
+    assert res["recall_at_1"] > 0.2
+
+
+class TestCar:
+
+  def test_detector_trains_and_decodes(self):
+    task, state, losses, out, gen = _train("car.kitti.PointPillarsCar", 50)
+    assert losses[-1] < 0.8 * losses[0], (losses[0], losses[-1])
+    assert "cls_loss" in out.metrics and "reg_loss" in out.metrics
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    assert dec.boxes.shape[-1] == 7
+    m = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(
+        jax.tree_util.tree_map(np.asarray, dec), m)
+    res = task.DecodeFinalize(m)
+    assert "cell_precision" in res and "cell_recall" in res
+
+  def test_featurizer_ignores_padded_points(self):
+    from lingvo_tpu.models.car import pillars
+    p = pillars.PillarFeaturizer.Params().Set(
+        name="feat", point_dim=4, feature_dim=8)
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(jax.random.PRNGKey(0))
+    pts = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 4, 4))
+    pads = jnp.zeros((1, 2, 4)).at[0, 0, 2:].set(1.0)
+    out1 = layer.FProp(theta, pts, pads)
+    pts2 = pts.at[0, 0, 2:].set(99.0)  # only padded points changed
+    out2 = layer.FProp(theta, pts2, pads)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=1e-6)
+    # fully-padded pillar pools to exactly zero
+    pads3 = jnp.ones((1, 2, 4))
+    out3 = layer.FProp(theta, pts, pads3)
+    np.testing.assert_allclose(np.asarray(out3), 0.0, atol=1e-6)
