@@ -193,3 +193,107 @@ class SequenceBatcher:
         vals = padded
       out.Set(k, np.stack(vals))
     return out
+
+
+class CrossBatchMixingDataSource(DataSource):
+  """Example-level mixing across sources (ref CrossBatchMixingDataSource:194):
+  each record is drawn from a child source sampled by weight, so one batch
+  interleaves examples from every source (vs whole-batch switching)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("sub", [], "Child DataSource Params.")
+    p.Define("weights", [], "Sampling weight per child.")
+    p.Define("seed", 301, "Sampling seed.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert len(p.sub) == len(p.weights) and p.sub
+    self._sources = [sp.Instantiate() for sp in p.sub]
+
+  def __iter__(self):
+    p = self.p
+    rng = np.random.RandomState(p.seed)
+    iters = [iter(s) for s in self._sources]
+    probs = np.asarray(p.weights, np.float64)
+    probs = probs / probs.sum()
+    alive = [True] * len(iters)
+    while any(alive):
+      k = rng.choice(len(iters), p=probs)
+      if not alive[k]:
+        continue
+      rec = next(iters[k], None)
+      if rec is None:
+        alive[k] = False
+        # renormalize over live children (a dead child must not starve)
+        live = np.asarray(alive, np.float64) * np.asarray(p.weights)
+        if live.sum() == 0:
+          return
+        probs = live / live.sum()
+        continue
+      yield rec
+
+
+class PrefixedDataSource(DataSource):
+  """Prepends a directory prefix to the wrapped source's file patterns
+  (ref PrefixedDataSource:325 — dataset roots differ per cluster)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("sub", None, "Wrapped DataSource Params (SimpleDataSource).")
+    p.Define("file_pattern_prefix", "", "Directory prefix to prepend.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    sub = p.sub.Copy()
+    prefix = p.file_pattern_prefix.rstrip("/")
+
+    def _Prefix(pat: str) -> str:
+      if ":" in pat:
+        kind, rest = pat.split(":", 1)
+        return f"{kind}:{prefix}/{rest}"
+      return f"{prefix}/{pat}"
+
+    if isinstance(sub.file_pattern, (list, tuple)):
+      sub.file_pattern = [_Prefix(x) for x in sub.file_pattern]
+    else:
+      sub.file_pattern = _Prefix(sub.file_pattern)
+    self._source = sub.Instantiate()
+
+  def __iter__(self):
+    return iter(self._source)
+
+
+class TfdsDataSource(DataSource):
+  """tensorflow_datasets adapter (ref TFDatasetSource family:351): yields
+  serialized examples from a TFDS builder when the package is available;
+  raises a clear error otherwise (the package is optional)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("dataset", "", "TFDS name, e.g. 'lm1b'.")
+    p.Define("split", "train", "Split.")
+    p.Define("shuffle_files", True, "Shuffle input files.")
+    p.Define("field", "text", "Example field to yield (bytes).")
+    return p
+
+  def __iter__(self):
+    try:
+      import tensorflow_datasets as tfds  # type: ignore
+    except ImportError as e:
+      raise ImportError(
+          "TfdsDataSource needs the optional tensorflow_datasets package; "
+          "use SimpleDataSource over exported files instead") from e
+    p = self.p
+    ds = tfds.load(p.dataset, split=p.split,
+                   shuffle_files=p.shuffle_files)
+    for ex in tfds.as_numpy(ds):
+      val = ex[p.field]
+      yield val if isinstance(val, bytes) else str(val).encode()

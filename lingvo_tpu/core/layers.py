@@ -819,3 +819,139 @@ class UniformLabelSmoother(base_layer.BaseLayer):
     p = self.p
     one_hot = jax.nn.one_hot(target_ids, p.num_classes, dtype=jnp.float32)
     return (1.0 - p.uncertainty) * one_hot + p.uncertainty / p.num_classes
+
+
+class EinsumEmbeddingLayer(SimpleEmbeddingLayer):
+  """Embedding as a pure einsum over one-hot ids (ref
+  `layers.py:3018` EinsumEmbeddingLayer): SimpleEmbeddingLayer with the
+  matmul formulation forced on — the MXU-native choice, and the one GSPMD
+  partitions cleanly when the table is sharded (gather would all-gather
+  the table)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.use_matmul = True
+    return p
+
+
+class SampledSoftmax(base_layer.BaseLayer):
+  """Sampled softmax for huge vocabularies (ref `SimpleFullSoftmax`'s
+  num_sampled path, `layers.py:3697+` — what the word-level 793k-vocab
+  1B-words configs need).
+
+  Training computes logits only over the true class + num_sampled
+  log-uniform (Zipfian) negatives with the standard expected-count
+  correction; eval uses the full softmax. Sampling draws from the step-seed
+  context so it is deterministic per step.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Input depth.")
+    p.Define("num_classes", 0, "Full vocabulary size.")
+    p.Define("num_sampled", 4096, "Negatives sampled per batch.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim > 0 and p.num_classes > 0
+    self.CreateVariable(
+        "w", WeightParams((p.num_classes, p.input_dim), p.params_init,
+                          p.dtype,
+                          tensor_split_dims_mapping=(
+                              p.weight_split_dims_mapping)))
+    self.CreateVariable(
+        "b", WeightParams((p.num_classes,), WeightInit.Constant(0.0),
+                          p.dtype))
+
+  def _LogExpectedCount(self, ids):
+    """log E[count(id)] under num_sampled draws of the log-uniform (Zipf)
+    sampler (ref TF's log_uniform_candidate_sampler + sampled-softmax
+    correction logit - log Q): E[count] = num_sampled * P(id)."""
+    ids = ids.astype(jnp.float32)
+    log_p = jnp.log(
+        jnp.log((ids + 2.0) / (ids + 1.0)) /
+        math.log(self.p.num_classes + 1.0))
+    return log_p + math.log(self.p.num_sampled)
+
+  def _SampleNegatives(self, key):
+    """Log-uniform sampling via inverse CDF: id = floor(exp(u*log(V+1)))-1."""
+    p = self.p
+    u = jax.random.uniform(key, (p.num_sampled,))
+    ids = jnp.exp(u * math.log(p.num_classes + 1.0)) - 1.0
+    return jnp.clip(ids.astype(jnp.int32), 0, p.num_classes - 1)
+
+  def Logits(self, theta, inputs):
+    """Full logits (eval / decode path)."""
+    th = self.CastTheta(theta)
+    return jnp.einsum("...d,vd->...v", self.ToFPropDtype(inputs),
+                      th.w) + th.b
+
+  def XentLossFromInputs(self, theta, inputs, class_ids):
+    """Sampled-softmax xent: inputs [..., D], class_ids [...] -> xent [...].
+
+    Falls back to the full softmax outside training (no step seed).
+    """
+    p = self.p
+    th = self.CastTheta(theta)
+    if py_utils.DoEval() or not py_utils.HasStepSeed():
+      logits = self.Logits(theta, inputs).astype(jnp.float32)
+      return XentLossFromLogits(logits, p.num_classes,
+                                class_ids=class_ids).per_example_xent
+    key = py_utils.StepSeed(f"{self.path}/sampled_softmax")
+    neg_ids = self._SampleNegatives(key)                   # [S]
+    x = self.ToFPropDtype(inputs)
+    # true-class logit with its correction
+    w_true = jnp.take(th.w, class_ids, axis=0)             # [..., D]
+    b_true = jnp.take(th.b, class_ids, axis=0)
+    true_logit = jnp.sum(x * w_true, -1) + b_true
+    true_logit = true_logit.astype(jnp.float32) - self._LogExpectedCount(
+        class_ids)
+    # negative logits with their corrections
+    w_neg = jnp.take(th.w, neg_ids, axis=0)                # [S, D]
+    b_neg = jnp.take(th.b, neg_ids, axis=0)
+    neg_logits = jnp.einsum("...d,sd->...s", x, w_neg) + b_neg
+    neg_logits = neg_logits.astype(jnp.float32) - self._LogExpectedCount(
+        neg_ids)
+    # mask accidental hits of the true class among negatives
+    hit = (neg_ids == class_ids[..., None])
+    neg_logits = jnp.where(hit, -1e9, neg_logits)
+    all_logits = jnp.concatenate([true_logit[..., None], neg_logits], -1)
+    return -jax.nn.log_softmax(all_logits, axis=-1)[..., 0]
+
+
+class StackingOverTime(base_layer.BaseLayer):
+  """Stacks adjacent frames and subsamples time (ref
+  `layers.py:2006` StackingOverTime — the classic ASR encoder front):
+  [b, t, d] -> [b, ceil(t/stride), d*(left+1+right)]."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("left_context", 0, "Past frames stacked per output frame.")
+    p.Define("right_context", 2, "Future frames stacked.")
+    p.Define("stride", 3, "Output frame subsampling.")
+    return p
+
+  @property
+  def window_size(self):
+    return self.p.left_context + 1 + self.p.right_context
+
+  def FProp(self, theta, inputs, paddings=None):
+    """Returns (stacked [b, t_out, d*window], out_paddings [b, t_out])."""
+    p = self.p
+    b, t, d = inputs.shape
+    if paddings is None:
+      paddings = jnp.zeros((b, t), inputs.dtype)
+    x = jnp.pad(inputs, ((0, 0), (p.left_context, p.right_context), (0, 0)))
+    pad = jnp.pad(paddings, ((0, 0), (p.left_context, p.right_context)),
+                  constant_values=1.0)
+    frames = [x[:, i:i + t] for i in range(self.window_size)]
+    stacked = jnp.concatenate(frames, axis=-1)             # [b, t, d*w]
+    stacked = stacked[:, ::p.stride]
+    # an output frame is padding iff its CENTER frame was padding (ref)
+    out_paddings = pad[:, p.left_context:p.left_context + t][:, ::p.stride]
+    return stacked, out_paddings

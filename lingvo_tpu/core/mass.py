@@ -1,0 +1,45 @@
+"""MASS masked-seq2seq example synthesis (ref `lingvo/core/ops/mass_op.cc`):
+pick a contiguous span; the encoder source masks the span, the decoder
+reconstructs it (inputs = shifted span, also masked per the MASS recipe).
+
+Pure numpy — runs in the input pipeline's record processor (the C++ op's
+role); deterministic per (seed, example)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def MassExample(ids: np.ndarray, mask_id: int, seed: int,
+                mask_ratio: float = 0.5,
+                span_len: int | None = None) -> NestedMap:
+  """ids: [n] content token ids -> NestedMap(src, tgt) MASS pair.
+
+  src.ids: ids with the span replaced by mask_id.
+  tgt.ids: decoder inputs — the span shifted right, non-span positions
+           masked (MASS trains only on the span); tgt.labels: the span;
+           tgt.weights: 1 on span positions.
+  """
+  ids = np.asarray(ids, np.int32)
+  n = len(ids)
+  rng = np.random.RandomState(seed % (2**31))
+  span = span_len if span_len is not None else max(1, int(n * mask_ratio))
+  span = min(span, n)
+  start = rng.randint(0, n - span + 1)
+  end = start + span
+
+  src = ids.copy()
+  src[start:end] = mask_id
+
+  labels = ids.copy()
+  weights = np.zeros(n, np.float32)
+  weights[start:end] = 1.0
+  # decoder input: previous target token inside the span, mask elsewhere
+  dec_in = np.full(n, mask_id, np.int32)
+  dec_in[start + 1:end] = ids[start:end - 1]
+  return NestedMap(
+      src=NestedMap(ids=src),
+      tgt=NestedMap(ids=dec_in, labels=labels, weights=weights),
+      span=(int(start), int(end)))

@@ -46,14 +46,22 @@ class RNNCell(base_layer.BaseLayer):
 
   def _ApplyPadding(self, new_state, state0, padding):
     """Padded steps: hold state (default) or zero it (reset_cell_state=True,
-    so packed segments start fresh after padding — ref reset_cell_state)."""
+    so packed segments start fresh after padding — ref reset_cell_state).
+
+    Broadcasts the [b] padding to each state leaf's rank (ConvLSTM states
+    are [b, H, W, C])."""
     if padding is None:
       return new_state
-    pad = padding[:, None]
+
+    def _Pad(leaf):
+      return padding.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(
+          leaf.dtype)
+
     if self.p.reset_cell_state:
-      return jax.tree_util.tree_map(lambda n: n * (1.0 - pad), new_state)
+      return jax.tree_util.tree_map(
+          lambda n: n * (1.0 - _Pad(n)), new_state)
     return jax.tree_util.tree_map(
-        lambda n, o: n * (1.0 - pad) + o * pad, new_state, state0)
+        lambda n, o: n * (1.0 - _Pad(n)) + o * _Pad(n), new_state, state0)
 
 
 class LSTMCellSimple(RNNCell):
@@ -219,4 +227,58 @@ class SRUCell(RNNCell):
     r = jax.nn.sigmoid(r_pre)
     c = f * state0.c + (1.0 - f) * x_t
     m = r * jnp.tanh(c) + (1.0 - r) * x_skip
+    return self._ApplyPadding(NestedMap(m=m, c=c), state0, padding)
+
+
+class ConvLSTMCell(RNNCell):
+  """Convolutional LSTM over 2D feature maps (ref `rnn_cell.py:2015`
+  ConvLSTMCell): states m/c are [b, H, W, C]; gates come from a conv over
+  [input, m]."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("inputs_shape", [0, 0, 0], "Per-step input [H, W, C_in].")
+    p.Define("cell_shape", [0, 0, 0], "State shape [H, W, C].")
+    p.Define("filter_shape", [3, 3], "Conv kernel [fh, fw].")
+    p.Define("forget_gate_bias", 1.0, "Added to the forget gate.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    h, w, c = p.cell_shape
+    cin = p.inputs_shape[2]
+    fh, fw = p.filter_shape
+    assert p.inputs_shape[:2] == p.cell_shape[:2], "spatial dims must match"
+    self.CreateVariable(
+        "w_conv",
+        py_utils.WeightParams((fh, fw, cin + c, 4 * c), p.params_init,
+                              p.dtype))
+    self.CreateVariable(
+        "b", py_utils.WeightParams((4 * c,),
+                                   py_utils.WeightInit.Constant(0.0),
+                                   p.dtype))
+
+  def InitState(self, batch_size):
+    h, w, c = self.p.cell_shape
+    z = jnp.zeros((batch_size, h, w, c), self.fprop_dtype)
+    return NestedMap(m=z, c=z)
+
+  def GetOutput(self, state):
+    return state.m
+
+  def FProp(self, theta, state0, inputs, padding=None, preprocessed=False):
+    """inputs: [b, H, W, C_in]."""
+    del preprocessed
+    p = self.p
+    th = self.CastTheta(theta)
+    xm = jnp.concatenate([self.ToFPropDtype(inputs), state0.m], axis=-1)
+    gates = jax.lax.conv_general_dilated(
+        xm, th.w_conv, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + th.b
+    i, g, f, o = jnp.split(gates, 4, axis=-1)
+    f = f + p.forget_gate_bias
+    c = jax.nn.sigmoid(f) * state0.c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    m = jax.nn.sigmoid(o) * jnp.tanh(c)
     return self._ApplyPadding(NestedMap(m=m, c=c), state0, padding)

@@ -114,3 +114,71 @@ class StackedFRNNLayerByLayer(base_layer.BaseLayer):
         out = out + x
       x = out
     return x
+
+
+class FRNNWithAttention(base_layer.BaseLayer):
+  """Functional RNN whose cell consumes per-step attention context (ref
+  `rnn_layers.py:756` FRNNWithAttention): the seq2seq decoder recurrence —
+  cell input is [x_t, ctx_{t-1}], the cell output queries the attention.
+
+  Uses the core/seq_attention per-step API, so any of that family
+  (additive, location-sensitive, monotonic, ...) plugs in.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("cell", rnn_cell.LSTMCellSimple.Params(), "The RNN cell.")
+    p.Define("attention", None,
+             "seq_attention Params (source_dim/query_dim set by caller).")
+    p.Define("output_prev_atten_ctx", False,
+             "Emit ctx_{t-1} (pre-update) instead of ctx_t per step.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.attention is not None
+    self.CreateChild("cell", p.cell)
+    self.CreateChild("atten", p.attention)
+
+  def FProp(self, theta, source_vecs, source_paddings, inputs,
+            paddings=None, state0=None):
+    """source_vecs [b, s, ds]; inputs [b, t, d] ->
+    (outputs [b, t, h], contexts [b, t, ds], final_state)."""
+    p = self.p
+    b, t = inputs.shape[0], inputs.shape[1]
+    src_len = source_vecs.shape[1]
+    packed = self.atten.PackSource(
+        self.ChildTheta(theta, "atten"), source_vecs, source_paddings)
+    if paddings is None:
+      paddings = jnp.zeros((b, t), jnp.float32)
+    cell_state = state0 if state0 is not None else self.cell.InitState(b)
+    atten_state = self.atten.ZeroAttentionState(b, src_len)
+    ctx0 = jnp.zeros((b, source_vecs.shape[-1]), source_vecs.dtype)
+
+    def _Step(carry, per_t):
+      cell_state, atten_state, ctx = carry
+      x_t, pad_t = per_t
+      cell_in = jnp.concatenate([x_t, ctx.astype(x_t.dtype)], axis=-1)
+      new_cell = self.cell.FProp(theta.cell, cell_state, cell_in,
+                                 padding=pad_t)
+      query = self.cell.GetOutput(new_cell)
+      new_ctx, probs, new_atten = self.atten.ComputeContextVector(
+          self.ChildTheta(theta, "atten"), packed, query, atten_state)
+      # padded steps hold the attention state and carried context too —
+      # stateful aligners (location-sensitive, monotonic) must not advance
+      # over padding frames
+      def _Hold(new, old):
+        pad = pad_t.reshape((-1,) + (1,) * (new.ndim - 1)).astype(new.dtype)
+        return new * (1 - pad) + old * pad
+
+      new_atten = jax.tree_util.tree_map(_Hold, new_atten, atten_state)
+      new_ctx = _Hold(new_ctx, ctx)
+      emit_ctx = ctx if p.output_prev_atten_ctx else new_ctx
+      return (new_cell, new_atten, new_ctx), (query, emit_ctx, probs)
+
+    (final_cell, _, _), (outs, ctxs, probs) = jax.lax.scan(
+        _Step, (cell_state, atten_state, ctx0),
+        (inputs.swapaxes(0, 1), paddings.swapaxes(0, 1)))
+    return (outs.swapaxes(0, 1), ctxs.swapaxes(0, 1), final_cell)

@@ -43,6 +43,10 @@ class TransformerLm(base_model.BaseTask):
              "No causal mask (BERT-style encoder; pair with an MLM task).")
     p.Define("label_smoothing", 0.0, "Label smoothing.")
     p.Define("softmax_logits_soft_max", 30.0, "Logit tanh cap (gshard-style).")
+    p.Define("softmax_num_sampled", 0,
+             "If >0, train with a sampled softmax over this many log-uniform "
+             "negatives (untied output head; the word-level 793k-vocab "
+             "1B-words recipe). Eval still uses the full softmax.")
     p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
     p.Define("atten_dropout_prob", 0.0, "Attention dropout.")
     p.Define("num_experts", 0,
@@ -118,6 +122,15 @@ class TransformerLm(base_model.BaseTask):
           transformer_lib.StackedTransformerLayers.Params().Set(
               num_layers=p.num_layers, input_dim=p.model_dim,
               transformer_layer_params_tpl=layer_body, final_ln=False))
+    if p.softmax_num_sampled > 0:
+      assert p.label_smoothing == 0.0, (
+          "label_smoothing is not supported with the sampled softmax "
+          "(the sampled xent has no smoothing term)")
+      self.CreateChild(
+          "sampled_softmax",
+          layers_lib.SampledSoftmax.Params().Set(
+              input_dim=p.model_dim, num_classes=p.vocab_size,
+              num_sampled=p.softmax_num_sampled))
     self.CreateChild(
         "final_ln",
         layers_lib.LayerNorm.Params().Set(input_dim=p.model_dim))
@@ -139,16 +152,33 @@ class TransformerLm(base_model.BaseTask):
     x = self.stack.FProp(theta.stack, x, paddings=input_batch.paddings,
                          segment_ids=seg_ids, token_ids=ids)
     x = self.final_ln.FProp(theta.final_ln, x)
-    logits = self.emb.Logits(theta.emb, x)
+    if p.softmax_num_sampled > 0 and not py_utils.DoEval() and \
+        py_utils.HasStepSeed():
+      # training with a sampled softmax: defer to ComputeLoss (no [B,T,V]
+      # logits are ever materialized — the point for 793k vocabs)
+      return NestedMap(hidden=x)
+    logits = self.emb.Logits(theta.emb, x) if p.softmax_num_sampled == 0 \
+        else self.sampled_softmax.Logits(
+            self.ChildTheta(theta, "sampled_softmax"), x)
     return NestedMap(logits=logits)
 
   def ComputeLoss(self, theta, predictions, input_batch):
     p = self.p
+    weights = py_utils.SequenceMask(input_batch.paddings)
+    tot_weight = jnp.maximum(jnp.sum(weights), 1e-8)
+    if "hidden" in predictions:
+      per_tok = self.sampled_softmax.XentLossFromInputs(
+          self.ChildTheta(theta, "sampled_softmax"), predictions.hidden,
+          input_batch.labels)
+      avg_xent = jnp.sum(per_tok * weights) / tot_weight
+      metrics = NestedMap(
+          loss=(avg_xent, tot_weight),
+          log_pplx=(avg_xent, tot_weight),
+          num_predictions=(tot_weight, 1.0))
+      return metrics, NestedMap(xent=per_tok)
     xent = self.emb.XentLossFromLogits(
         predictions.logits, class_ids=input_batch.labels,
         label_smoothing=p.label_smoothing)
-    weights = py_utils.SequenceMask(input_batch.paddings)
-    tot_weight = jnp.maximum(jnp.sum(weights), 1e-8)
     avg_xent = jnp.sum(xent.per_example_xent * weights) / tot_weight
     metrics = NestedMap(
         loss=(avg_xent, tot_weight),
@@ -170,7 +200,13 @@ class TransformerLm(base_model.BaseTask):
     x = self.emb.EmbLookup(theta.emb, ids_t)
     x, new_states = self.stack.ExtendStep(theta.stack, x, states)
     x = self.final_ln.FProp(theta.final_ln, x)
-    logits = self.emb.Logits(theta.emb, x)
+    if self.p.softmax_num_sampled > 0:
+      # decode must score with the head that was TRAINED (the untied
+      # sampled-softmax head), not the tied embedding
+      logits = self.sampled_softmax.Logits(
+          self.ChildTheta(theta, "sampled_softmax"), x)
+    else:
+      logits = self.emb.Logits(theta.emb, x)
     return logits[:, 0, :], new_states
 
 

@@ -95,3 +95,19 @@ class OneBWdsRealData(OneBWdsTransformerLm):
     p = self._Input("heldout-monolingual.tokenized.shuffled/news.en.heldout-*",
                     seed=7)
     return p.Set(shuffle=False, max_epochs=1, require_sequential_order=True)
+
+
+@model_registry.RegisterSingleTaskModel
+class WordLevelOneBwdsSampledSoftmax(OneBWdsTransformerLm):
+  """Word-level 1B-words with a sampled softmax (ref
+  `one_billion_wds.py:138` WordLevelOneBwdsSimpleSampledSoftmax): the
+  793k-word vocabulary trains against 4096 log-uniform negatives — full
+  [B, T, 793k] logits are never materialized."""
+
+  VOCAB = 793_470
+  NUM_SAMPLED = 4096
+
+  def Task(self):
+    p = super().Task()
+    p.softmax_num_sampled = self.NUM_SAMPLED
+    return p

@@ -1,0 +1,236 @@
+"""Sampled softmax, EinsumEmbedding, StackingOverTime, ConvLSTM,
+FRNNWithAttention, new datasources, MASS (VERDICT r1 P-row closures)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import datasource, layers as layers_lib, mass, py_utils
+from lingvo_tpu.core import rnn_cell, rnn_layers, seq_attention
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(23)
+
+
+class TestSampledSoftmax:
+
+  def _make(self, num_sampled=16, vocab=64, dim=8):
+    p = layers_lib.SampledSoftmax.Params().Set(
+        name="ss", input_dim=dim, num_classes=vocab,
+        num_sampled=num_sampled)
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    return layer, layer.InstantiateVariables(KEY)
+
+  def test_eval_falls_back_to_full_softmax(self):
+    layer, theta = self._make()
+    x = jax.random.normal(KEY, (4, 8))
+    ids = jnp.asarray([1, 2, 3, 4])
+    # no step seed -> full softmax; must equal explicit full xent
+    xent = layer.XentLossFromInputs(theta, x, ids)
+    full = layers_lib.XentLossFromLogits(
+        layer.Logits(theta, x).astype(jnp.float32), 64,
+        class_ids=ids).per_example_xent
+    np.testing.assert_allclose(np.asarray(xent), np.asarray(full),
+                               atol=1e-5)
+
+  def test_sampled_loss_tracks_true_logit(self):
+    """Raising the true class's weight must lower the sampled xent (the
+    estimator optimizes the real objective); absolute values differ from
+    the full xent by construction (negatives are a sampled subset)."""
+    layer, theta = self._make(num_sampled=32, vocab=512)
+    x = jax.random.normal(KEY, (8, 8))
+    ids = jnp.asarray([7] * 8)
+    with py_utils.StepSeedContext(jax.random.PRNGKey(5)):
+      base = float(layer.XentLossFromInputs(theta, x, ids).mean())
+    theta2 = theta.DeepCopy()
+    theta2.w = theta2.w.at[7].set(theta2.w[7] + 0.5 * x.mean(0))
+    with py_utils.StepSeedContext(jax.random.PRNGKey(5)):
+      better = float(layer.XentLossFromInputs(theta2, x, ids).mean())
+    assert better < base, (base, better)
+
+  def test_training_signal_reduces_sampled_loss(self):
+    layer, theta = self._make(num_sampled=32, vocab=64)
+    x = jax.random.normal(KEY, (32, 8))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, 32))
+
+    def loss_fn(theta, key):
+      with py_utils.StepSeedContext(key):
+        return jnp.mean(layer.XentLossFromInputs(theta, x, ids))
+
+    import optax
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(theta)
+    losses = []
+    for i in range(100):
+      loss, grads = jax.value_and_grad(loss_fn)(theta, jax.random.PRNGKey(i))
+      updates, opt_state = opt.update(grads, opt_state)
+      theta = optax.apply_updates(theta, updates)
+      losses.append(float(loss))
+    # full-softmax loss must ALSO have dropped (the estimate trains the
+    # real objective, not just the sampled one)
+    full = layers_lib.XentLossFromLogits(
+        layer.Logits(theta, x).astype(jnp.float32), 64,
+        class_ids=ids).per_example_xent
+    # started near log(64) ~ 4.16; sampled training must have cut it deeply
+    assert float(full.mean()) < 1.5, float(full.mean())
+
+  def test_lm_sampled_training_materializes_no_logits(self):
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    mp.task.softmax_num_sampled = 32
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    with py_utils.StepSeedContext(jax.random.PRNGKey(1)):
+      preds = task.ComputePredictions(theta, batch)
+    assert "hidden" in preds and "logits" not in preds
+    # eval path still yields full logits
+    with py_utils.EvalContext():
+      preds_eval = task.ComputePredictions(theta, batch)
+    assert preds_eval.logits.shape[-1] == mp.task.vocab_size
+    # one jitted train step end to end
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    state, out = jax.jit(task.TrainStep)(state, batch)
+    assert np.isfinite(float(out.metrics.loss[0]))
+
+
+class TestEinsumEmbedding:
+
+  def test_matches_gather_embedding(self):
+    p = layers_lib.EinsumEmbeddingLayer.Params().Set(
+        name="emb", vocab_size=16, embedding_dim=8)
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    ids = jnp.asarray([[0, 5], [15, 3]])
+    out = layer.EmbLookup(theta, ids)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(theta.emb)[np.asarray(ids)],
+                               atol=1e-6)
+
+
+class TestStackingOverTime:
+
+  def test_stack_and_subsample(self):
+    p = layers_lib.StackingOverTime.Params().Set(
+        name="stack", left_context=0, right_context=2, stride=3)
+    layer = p.Instantiate()
+    x = jnp.arange(12, dtype=jnp.float32).reshape(1, 12, 1)
+    pads = jnp.zeros((1, 12)).at[0, 9:].set(1.0)
+    out, opads = layer.FProp(NestedMap(), x, pads)
+    assert out.shape == (1, 4, 3)
+    # frame 0 stacks inputs [0, 1, 2]
+    np.testing.assert_allclose(np.asarray(out[0, 0]), [0.0, 1.0, 2.0])
+    # frame 1 starts at t=3
+    np.testing.assert_allclose(np.asarray(out[0, 1]), [3.0, 4.0, 5.0])
+    # output padding follows the center (start) frame
+    np.testing.assert_allclose(np.asarray(opads[0]), [0, 0, 0, 1])
+
+
+class TestConvLstm:
+
+  def test_shapes_and_padding(self):
+    p = rnn_cell.ConvLSTMCell.Params().Set(
+        name="clstm", inputs_shape=[4, 4, 3], cell_shape=[4, 4, 8])
+    cell = p.Instantiate()
+    cell.FinalizePaths()
+    theta = cell.InstantiateVariables(KEY)
+    st = cell.InitState(2)
+    x = jax.random.normal(KEY, (2, 4, 4, 3))
+    st1 = cell.FProp(theta, st, x)
+    assert cell.GetOutput(st1).shape == (2, 4, 4, 8)
+    # a padded step must not move the state
+    st2 = cell.FProp(theta, st1, x, padding=jnp.asarray([1.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(st2.m[0]), np.asarray(st1.m[0]),
+                               atol=1e-6)
+    assert not np.allclose(np.asarray(st2.m[1]), np.asarray(st1.m[1]))
+
+
+class TestFrnnWithAttention:
+
+  def test_runs_and_attends(self):
+    fp = rnn_layers.FRNNWithAttention.Params().Set(name="fa")
+    fp.cell = rnn_cell.LSTMCellSimple.Params().Set(
+        num_input_nodes=8 + 12, num_output_nodes=6)
+    fp.attention = seq_attention.AdditiveAttention.Params().Set(
+        source_dim=12, query_dim=6, hidden_dim=8)
+    layer = fp.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    src = jax.random.normal(KEY, (2, 5, 12))
+    srcp = jnp.zeros((2, 5)).at[1, 3:].set(1.0)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 7, 8))
+    outs, ctxs, final = layer.FProp(theta, src, srcp, x)
+    assert outs.shape == (2, 7, 6) and ctxs.shape == (2, 7, 12)
+    # perturbing a padded source frame must not change anything
+    src2 = src.at[1, 4].set(50.0)
+    outs2, _, _ = layer.FProp(theta, src2, srcp, x)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(outs2),
+                               atol=1e-5)
+
+
+class TestDataSources:
+
+  def test_cross_batch_mixing(self, tmp_path):
+    for name, tok in [("a", "aa"), ("b", "bb")]:
+      (tmp_path / f"{name}.txt").write_text("\n".join([tok] * 50) + "\n")
+    p = datasource.CrossBatchMixingDataSource.Params().Set(
+        weights=[0.5, 0.5], seed=7)
+    for name in ("a", "b"):
+      p.sub.append(datasource.SimpleDataSource.Params().Set(
+          file_pattern=f"text:{tmp_path}/{name}.txt", shuffle=False,
+          max_epochs=1, num_threads=1))
+    recs = [r.decode() for r in p.Instantiate()]
+    assert len(recs) == 100
+    # both sources appear, interleaved within the stream
+    first_half = recs[:50]
+    assert "aa" in first_half and "bb" in first_half
+
+  def test_prefixed_datasource(self, tmp_path):
+    sub = tmp_path / "data"
+    sub.mkdir()
+    (sub / "x.txt").write_text("hello\n")
+    p = datasource.PrefixedDataSource.Params().Set(
+        file_pattern_prefix=str(tmp_path),
+        sub=datasource.SimpleDataSource.Params().Set(
+            file_pattern="text:data/x.txt", shuffle=False, max_epochs=1,
+            num_threads=1))
+    recs = list(p.Instantiate())
+    assert recs == [b"hello"]
+
+  def test_tfds_source_raises_without_package(self):
+    p = datasource.TfdsDataSource.Params().Set(dataset="lm1b")
+    try:
+      import tensorflow_datasets  # noqa: F401
+      pytest.skip("tfds installed; adapter exercised in real runs")
+    except ImportError:
+      with pytest.raises(ImportError, match="tensorflow_datasets"):
+        next(iter(p.Instantiate()))
+
+
+class TestMass:
+
+  def test_mass_example_structure(self):
+    ids = np.arange(10) + 5
+    ex = mass.MassExample(ids, mask_id=3, seed=1, mask_ratio=0.5)
+    s, e = ex.span
+    assert e - s == 5
+    # source masks exactly the span
+    np.testing.assert_array_equal(ex.src.ids[s:e], 3)
+    np.testing.assert_array_equal(ex.src.ids[:s], ids[:s])
+    # labels are the original sequence; weights mark the span
+    np.testing.assert_array_equal(ex.tgt.labels, ids)
+    assert ex.tgt.weights.sum() == 5
+    # decoder input inside the span is the shifted original
+    np.testing.assert_array_equal(ex.tgt.ids[s + 1:e], ids[s:e - 1])
+    assert ex.tgt.ids[s] == 3
+    # deterministic per seed
+    ex2 = mass.MassExample(ids, mask_id=3, seed=1, mask_ratio=0.5)
+    assert ex2.span == ex.span

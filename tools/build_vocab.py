@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Builds a vocab file from text shards (ref `lingvo/tools/wpm_encode_file.py`
+/ vocab generation tools): counts whitespace tokens, writes the top-k with
+special tokens first. Works for VocabFileTokenizer; for WPM/BPE train the
+pieces with your favorite trainer and feed the files to
+core.tokenizers.{Wpm,Bpe}Tokenizer."""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import sys
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--input_glob", required=True)
+  ap.add_argument("--output", required=True)
+  ap.add_argument("--vocab_size", type=int, default=32000)
+  ap.add_argument("--specials", default="<pad>,<s>,</s>,<unk>")
+  args = ap.parse_args(argv)
+
+  counts: collections.Counter = collections.Counter()
+  files = sorted(glob.glob(args.input_glob))
+  if not files:
+    print(f"no files match {args.input_glob}", file=sys.stderr)
+    return 1
+  for path in files:
+    with open(path, errors="replace") as f:
+      for line in f:
+        counts.update(line.split())
+  specials = args.specials.split(",")
+  budget = args.vocab_size - len(specials)
+  vocab = specials + [w for w, _ in counts.most_common(budget)]
+  with open(args.output, "w") as f:
+    f.write("\n".join(vocab) + "\n")
+  print(f"wrote {len(vocab)} tokens from {len(files)} files -> "
+        f"{args.output}")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

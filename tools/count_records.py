@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Counts records in sharded files via the native yielder (ref
+`lingvo/tools/count_records.py`)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--input", required=True,
+                  help="'type:glob' pattern (text/tfrecord/recordio).")
+  args = ap.parse_args(argv)
+  from lingvo_tpu.ops import native
+  y = native.RecordYielder(args.input, shuffle=False, max_epochs=1,
+                           num_threads=1)
+  n = sum(1 for _ in y)
+  print(n)
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

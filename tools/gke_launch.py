@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Emits Kubernetes YAML for a TPU training job + follower evaler/decoder +
+tensorboard (ref `lingvo/tools/gke_launch.py` up/down/reload verbs; this
+writes the manifests — apply them with kubectl)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+JOB_TEMPLATE = """\
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {name}
+spec:
+  backoffLimit: 2
+  template:
+    spec:
+      restartPolicy: Never
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: {accelerator}
+        cloud.google.com/gke-tpu-topology: {topology}
+      containers:
+      - name: {name}
+        image: {image}
+        command: ["python", "-m", "lingvo_tpu.trainer"]
+        args: ["--model={model}", "--logdir={logdir}", "--mode={mode}",
+               "--job={job}"]
+        resources:
+          limits:
+            google.com/tpu: {num_chips}
+"""
+
+TB_TEMPLATE = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {name}-tensorboard
+spec:
+  replicas: 1
+  selector:
+    matchLabels: {{app: {name}-tensorboard}}
+  template:
+    metadata:
+      labels: {{app: {name}-tensorboard}}
+    spec:
+      containers:
+      - name: tensorboard
+        image: {image}
+        command: ["tensorboard", "--logdir={logdir}", "--host=0.0.0.0"]
+        ports:
+        - containerPort: 6006
+"""
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--name", required=True)
+  ap.add_argument("--model", required=True)
+  ap.add_argument("--image", required=True)
+  ap.add_argument("--logdir", required=True, help="GCS path.")
+  ap.add_argument("--accelerator", default="tpu-v5p-slice")
+  ap.add_argument("--topology", default="2x2x1")
+  ap.add_argument("--num_chips", type=int, default=4)
+  ap.add_argument("--with_evaler", action="store_true")
+  ap.add_argument("--output", default="-")
+  args = ap.parse_args(argv)
+
+  docs = [JOB_TEMPLATE.format(
+      name=f"{args.name}-train", model=args.model, image=args.image,
+      logdir=args.logdir, mode="train", job="executor_tpu",
+      accelerator=args.accelerator, topology=args.topology,
+      num_chips=args.num_chips)]
+  if args.with_evaler:
+    docs.append(JOB_TEMPLATE.format(
+        name=f"{args.name}-evaler", model=args.model, image=args.image,
+        logdir=args.logdir, mode="eval", job="evaler",
+        accelerator=args.accelerator, topology=args.topology, num_chips=1))
+  docs.append(TB_TEMPLATE.format(name=args.name, image=args.image,
+                                 logdir=args.logdir))
+  yaml = "---\n".join(docs)
+  if args.output == "-":
+    print(yaml)
+  else:
+    with open(args.output, "w") as f:
+      f.write(yaml)
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

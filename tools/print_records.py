@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Prints the first N records of sharded files (ref
+`lingvo/tools/print_tf_records.py`)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--input", required=True)
+  ap.add_argument("--limit", type=int, default=10)
+  args = ap.parse_args(argv)
+  from lingvo_tpu.ops import native
+  y = native.RecordYielder(args.input, shuffle=False, max_epochs=1,
+                           num_threads=1)
+  for i, rec in enumerate(y):
+    if i >= args.limit:
+      break
+    print(rec[:200])
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
