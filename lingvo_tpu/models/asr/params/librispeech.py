@@ -147,3 +147,69 @@ class LibrispeechLasTiny(Librispeech960GraphemeLas):
     p.train.learner.lr_schedule = sched_lib.Constant.Params()
     p.train.tpu_steps_per_loop = 20
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class Librispeech960Rnnt(base_model_params.SingleTaskModelParams):
+  """Conformer transducer (the RNN-T decoder family the reference carries
+  in `tasks/asr/decoder.py`; conformer-transducer recipe shapes)."""
+
+  BATCH_SIZE = 16
+  NUM_BINS = 80
+  MODEL_DIM = 256
+  NUM_LAYERS = 16
+  NUM_HEADS = 4
+  VOCAB = 77
+
+  def Train(self):
+    return input_generator.SyntheticAsrInput.Params().Set(
+        batch_size=self.BATCH_SIZE, num_bins=self.NUM_BINS,
+        vocab_size=min(self.VOCAB, 30))
+
+  def Test(self):
+    return self.Train().Set(seed=99)
+
+  def Task(self):
+    from lingvo_tpu.models.asr import rnnt
+    p = rnnt.RnntAsrModel.Params()
+    p.name = "librispeech_rnnt"
+    p.vocab_size = self.VOCAB  # synthetic input clamps ITS vocab, not the head
+    p.encoder.input_dim = self.NUM_BINS
+    p.encoder.model_dim = self.MODEL_DIM
+    p.encoder.num_layers = self.NUM_LAYERS
+    p.encoder.num_heads = self.NUM_HEADS
+    p.encoder.dropout_prob = 0.1
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=2.0,
+        optimizer=opt_lib.AdamW.Params().Set(beta2=0.98, weight_decay=1e-6),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=10000, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=1.0)
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class LibrispeechRnntTiny(Librispeech960Rnnt):
+  """Smoke-test scale transducer."""
+
+  BATCH_SIZE = 4
+  NUM_BINS = 16
+  MODEL_DIM = 32
+  NUM_LAYERS = 2
+  NUM_HEADS = 2
+  VOCAB = 30
+
+  def Task(self):
+    p = super().Task()
+    p.encoder.kernel_size = 8
+    p.encoder.dropout_prob = 0.0
+    p.encoder.specaug.freq_mask_max_bins = 4
+    p.encoder.specaug.time_mask_max_frames = 8
+    p.decoder.emb_dim = 16
+    p.decoder.pred_dim = 32
+    p.decoder.joint_dim = 32
+    p.train.learner.learning_rate = 3e-3
+    p.train.learner.lr_schedule = sched_lib.Constant.Params()
+    p.train.tpu_steps_per_loop = 20
+    return p

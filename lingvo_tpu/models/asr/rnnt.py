@@ -1,0 +1,253 @@
+"""RNN-T (transducer) ASR: prediction network + joint + transducer loss +
+greedy decode (ref the RNN-T pieces of `lingvo/tasks/asr/decoder.py` and
+the reference's transducer configs).
+
+TPU-first: the transducer forward variable is computed with a `lax.scan`
+over encoder time whose carry is one log-alpha row over label positions
+(the inner emit recursion scans over U — static shapes, no host loops);
+greedy decode is a bounded scan over T+U joint steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import rnn_cell
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.asr import model as model_lib
+
+NEG_INF = -1.0e30
+
+
+def RnntLoss(logits, labels, t_lens, u_lens, blank_id: int = 0):
+  """Transducer negative log-likelihood.
+
+  logits: [B, T, U+1, V] joint outputs (U = max label length);
+  labels: [B, U]; t_lens: [B] encoder lengths; u_lens: [B] label lengths.
+  Returns per-sequence -log P(labels | acoustics), [B].
+
+  Forward recursion (log domain):
+    alpha[0, 0] = 0
+    alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                            alpha[t, u-1] + emit(t, u-1))
+    ll = alpha[T-1, U] + blank(T-1, U)
+  """
+  b, t_max, u_plus1, v = logits.shape
+  u_max = u_plus1 - 1
+  log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+  blank_lp = log_probs[..., blank_id]                     # [B, T, U+1]
+  # emit(t, u) = log P(label_{u+1} | t, u)
+  emit_lp = jnp.take_along_axis(
+      log_probs[:, :, :u_max, :], labels[:, None, :, None], axis=-1
+  )[..., 0]                                               # [B, T, U]
+  # labels past u_len must never be emitted
+  u_mask = (jnp.arange(u_max)[None] < u_lens[:, None])    # [B, U]
+  emit_lp = jnp.where(u_mask[:, None, :], emit_lp, NEG_INF)
+
+  def _EmitAlongU(alpha_from_blank, emit_row):
+    """alpha'[u] = logaddexp(from_blank[u], alpha'[u-1] + emit[u-1])."""
+
+    def _Step(prev_alpha_u, x):
+      from_blank_u, emit_prev = x
+      val = jnp.logaddexp(from_blank_u, prev_alpha_u + emit_prev)
+      return val, val
+
+    first = alpha_from_blank[:, 0]
+    if u_max == 0:
+      return first[:, None]
+    # u = 1..U pairs from_blank[:, u] with emit_row[:, u-1]
+    xs = (alpha_from_blank[:, 1:].swapaxes(0, 1),
+          emit_row.swapaxes(0, 1))
+    _, rest = jax.lax.scan(_Step, first, xs)
+    return jnp.concatenate([first[:, None], rest.swapaxes(0, 1)], axis=1)
+
+  # t = 0 row: only emits from (0, u-1)
+  init_from_blank = jnp.full((b, u_plus1), NEG_INF).at[:, 0].set(0.0)
+  alpha0 = _EmitAlongU(init_from_blank, emit_lp[:, 0])    # [B, U+1]
+
+  def _TStep(alpha_prev, per_t):
+    blank_prev_row, emit_row = per_t
+    from_blank = alpha_prev + blank_prev_row              # [B, U+1]
+    alpha = _EmitAlongU(from_blank, emit_row)
+    return alpha, alpha
+
+  if t_max > 1:
+    per_t = (blank_lp[:, :-1].swapaxes(0, 1),             # blank at t-1
+             emit_lp[:, 1:].swapaxes(0, 1))
+    _, alphas = jax.lax.scan(_TStep, alpha0, per_t)
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, U+1]
+  else:
+    alphas = alpha0[None]
+  alphas = alphas.swapaxes(0, 1)                          # [B, T, U+1]
+
+  t_idx = jnp.clip(t_lens - 1, 0, t_max - 1)
+  final_alpha = jnp.take_along_axis(
+      alphas, t_idx[:, None, None].repeat(u_plus1, 2), axis=1)[:, 0]
+  final_alpha = jnp.take_along_axis(final_alpha, u_lens[:, None], 1)[:, 0]
+  final_blank = jnp.take_along_axis(
+      blank_lp, t_idx[:, None, None].repeat(u_plus1, 2), axis=1)[:, 0]
+  final_blank = jnp.take_along_axis(final_blank, u_lens[:, None], 1)[:, 0]
+  return -(final_alpha + final_blank)
+
+
+class RnntDecoder(base_layer.BaseLayer):
+  """Prediction network + joint (ref RNN-T decoder pieces)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 30, "Vocab incl. blank at 0.")
+    p.Define("emb_dim", 64, "Label embedding dim.")
+    p.Define("pred_dim", 128, "Prediction LSTM dim.")
+    p.Define("joint_dim", 128, "Joint hidden dim.")
+    p.Define("source_dim", 256, "Encoder output dim.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    self.CreateChild(
+        "emb", layers_lib.SimpleEmbeddingLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.emb_dim))
+    self.CreateChild(
+        "pred_cell", rnn_cell.LSTMCellSimple.Params().Set(
+            num_input_nodes=p.emb_dim, num_output_nodes=p.pred_dim))
+    self.CreateChild(
+        "enc_proj", layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.source_dim, output_dim=p.joint_dim, has_bias=False))
+    self.CreateChild(
+        "pred_proj", layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.pred_dim, output_dim=p.joint_dim))
+    self.CreateChild(
+        "joint_out", layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.joint_dim, output_dim=p.vocab_size))
+
+  def PredictNet(self, theta, labels):
+    """labels [B, U] -> prediction activations [B, U+1, pred_dim]
+    (position 0 = the 'blank so far' start state)."""
+    b, u = labels.shape
+    emb = self.emb.EmbLookup(self.ChildTheta(theta, "emb"), labels)
+
+    def _Step(state, x_t):
+      new_state = self.pred_cell.FProp(theta.pred_cell, state, x_t)
+      return new_state, self.pred_cell.GetOutput(new_state)
+
+    state0 = self.pred_cell.InitState(b)
+    zero = jnp.zeros((b, self.p.pred_dim), emb.dtype)
+    _, outs = jax.lax.scan(_Step, state0, emb.swapaxes(0, 1))
+    return jnp.concatenate([zero[:, None], outs.swapaxes(0, 1)], axis=1)
+
+  def Joint(self, theta, enc, pred):
+    """enc [B, T, D], pred [B, U+1, P] -> logits [B, T, U+1, V]."""
+    e = self.enc_proj.FProp(theta.enc_proj, enc)          # [B, T, J]
+    g = self.pred_proj.FProp(theta.pred_proj, pred)       # [B, U+1, J]
+    h = jnp.tanh(e[:, :, None, :] + g[:, None, :, :])
+    return self.joint_out.FProp(theta.joint_out, h)
+
+  def GreedyDecode(self, theta, enc, enc_paddings, max_symbols: int):
+    """Frame-synchronous greedy transducer decode: at each joint step emit
+    the argmax; blank advances time, a label advances the prediction net
+    (bounded at T + max_symbols steps)."""
+    p = self.p
+    b, t_max, _ = enc.shape
+    e = self.enc_proj.FProp(theta.enc_proj, enc)          # [B, T, J]
+    t_lens = jnp.sum(1.0 - enc_paddings, axis=1).astype(jnp.int32)
+
+    def _Step(carry, _):
+      t_idx, pred_state, pred_out, hyp, hyp_len = carry
+      e_t = jnp.take_along_axis(
+          e, jnp.clip(t_idx, 0, t_max - 1)[:, None, None].repeat(
+              e.shape[-1], 2), axis=1)[:, 0]
+      g = self.pred_proj.FProp(theta.pred_proj, pred_out)
+      logits = self.joint_out.FProp(theta.joint_out, jnp.tanh(e_t + g))
+      sym = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+      done = t_idx >= t_lens
+      is_blank = (sym == 0) | done
+      # on a label: extend hyp + step the prediction net
+      emb = self.emb.EmbLookup(self.ChildTheta(theta, "emb"),
+                               sym[:, None])[:, 0]
+      new_state = self.pred_cell.FProp(theta.pred_cell, pred_state, emb)
+
+      def _Sel(new, old):
+        k = is_blank.reshape((-1,) + (1,) * (new.ndim - 1)).astype(new.dtype)
+        return old * k + new * (1 - k)
+
+      pred_state = jax.tree_util.tree_map(_Sel, new_state, pred_state)
+      pred_out = _Sel(self.pred_cell.GetOutput(new_state), pred_out)
+      write = (~is_blank) & (hyp_len < hyp.shape[1])
+      hyp = jnp.where(
+          (jnp.arange(hyp.shape[1])[None] == hyp_len[:, None])
+          & write[:, None], sym[:, None], hyp)
+      hyp_len = hyp_len + write.astype(jnp.int32)
+      t_idx = t_idx + is_blank.astype(jnp.int32)
+      return (t_idx, pred_state, pred_out, hyp, hyp_len), ()
+
+    hyp0 = jnp.zeros((b, max_symbols), jnp.int32)
+    carry = (jnp.zeros((b,), jnp.int32), self.pred_cell.InitState(b),
+             jnp.zeros((b, p.pred_dim), enc.dtype), hyp0,
+             jnp.zeros((b,), jnp.int32))
+    (t_idx, _, _, hyp, hyp_len), _ = jax.lax.scan(
+        _Step, carry, None, length=t_max + max_symbols)
+    return hyp, hyp_len
+
+
+class RnntAsrModel(model_lib._AsrTaskBase):
+  """Conformer encoder + RNN-T decoder (shares _AsrTaskBase's encoder
+  wiring and WER decode metrics).
+
+  Batch: features/feature_paddings (or waveform), tgt.ids [B, U] (content
+  ids >= 1, no sos/eos framing) + tgt.paddings — the CTC label layout.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("decoder", RnntDecoder.Params(), "RNN-T decoder.")
+    p.Define("max_decode_symbols", 32, "Greedy decode label budget.")
+    return p
+
+  def __init__(self, params):
+    p = params
+    p.decoder.vocab_size = p.vocab_size
+    p.decoder.source_dim = p.encoder.model_dim
+    super().__init__(p)
+    self.CreateChild("decoder", p.decoder)
+
+  def ComputePredictions(self, theta, input_batch):
+    enc, enc_pad = self._Encode(theta, input_batch)
+    dec_theta = self.ChildTheta(theta, "decoder")
+    pred = self.decoder.PredictNet(dec_theta, input_batch.tgt.ids)
+    logits = self.decoder.Joint(dec_theta, enc, pred)
+    return NestedMap(logits=logits, enc_paddings=enc_pad)
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    t_lens = jnp.sum(1.0 - predictions.enc_paddings, 1).astype(jnp.int32)
+    u_lens = jnp.sum(1.0 - input_batch.tgt.paddings, 1).astype(jnp.int32)
+    nll = RnntLoss(predictions.logits, input_batch.tgt.ids, t_lens, u_lens)
+    per_label = nll / jnp.maximum(u_lens.astype(jnp.float32), 1.0)
+    b = float(nll.shape[0])
+    return NestedMap(loss=(jnp.mean(per_label), b)), NestedMap(nll=nll)
+
+  def Decode(self, theta, input_batch):
+    enc, enc_pad = self._Encode(theta, input_batch)
+    hyp, hyp_len = self.decoder.GreedyDecode(
+        self.ChildTheta(theta, "decoder"), enc, enc_pad,
+        self.p.max_decode_symbols)
+    return NestedMap(hyp_ids=hyp, hyp_lens=hyp_len,
+                     target_ids=input_batch.tgt.ids,
+                     target_paddings=input_batch.tgt.paddings)
+
+  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
+    hyps = np.asarray(decode_out.hyp_ids)
+    lens = np.asarray(decode_out.hyp_lens)
+    labels = np.asarray(decode_out.target_ids)
+    lpads = np.asarray(decode_out.target_paddings)
+    for i in range(hyps.shape[0]):
+      hyp = [int(x) for x in hyps[i, :int(lens[i])]]
+      ref_len = int((1.0 - lpads[i]).sum())
+      ref = [int(x) for x in labels[i, :ref_len]]
+      decoder_metrics["wer"].Update(ref, hyp)

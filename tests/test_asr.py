@@ -135,3 +135,67 @@ class TestCtcTask:
         jax.tree_util.tree_map(np.asarray, dec), metrics)
     results = task.DecodeFinalize(metrics)
     assert 0.0 <= results["wer"] <= 2.0
+
+
+class TestRnnt:
+
+  def test_loss_matches_bruteforce_dp(self):
+    from lingvo_tpu.models.asr import rnnt
+    rng = np.random.RandomState(0)
+    B, T, U, V = 3, 5, 4, 6
+    logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+    labels = rng.randint(1, V, (B, U)).astype(np.int32)
+    t_lens = np.array([5, 4, 3], np.int32)
+    u_lens = np.array([4, 2, 3], np.int32)
+
+    def brute(lgt, lab, t_len, u_len):
+      lp = np.asarray(jax.nn.log_softmax(jnp.asarray(lgt), -1))
+      NEG = -1e30
+      alpha = np.full((t_len, u_len + 1), NEG)
+      alpha[0, 0] = 0.0
+
+      def la(a, b):
+        m = max(a, b)
+        return NEG if m <= NEG / 2 else m + np.log(
+            np.exp(a - m) + np.exp(b - m))
+
+      for t in range(t_len):
+        for u in range(u_len + 1):
+          if t == 0 and u == 0:
+            continue
+          v1 = alpha[t - 1, u] + lp[t - 1, u, 0] if t > 0 else NEG
+          v2 = (alpha[t, u - 1] + lp[t, u - 1, lab[u - 1]]
+                if u > 0 else NEG)
+          alpha[t, u] = la(v1, v2)
+      return -(alpha[t_len - 1, u_len] + lp[t_len - 1, u_len, 0])
+
+    expect = np.array([brute(logits[i], labels[i], t_lens[i], u_lens[i])
+                       for i in range(B)])
+    got = np.asarray(rnnt.RnntLoss(
+        jnp.asarray(logits), jnp.asarray(labels), jnp.asarray(t_lens),
+        jnp.asarray(u_lens)))
+    np.testing.assert_allclose(got, expect, atol=1e-4)
+
+  def test_rnnt_trains_and_decodes(self):
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    mp = model_registry.GetParams("asr.librispeech.LibrispeechRnntTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    step = jax.jit(task.TrainStep)
+    losses = []
+    for _ in range(15):
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    assert dec.hyp_ids.shape[0] == 4
+    m = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(jax.tree_util.tree_map(np.asarray, dec), m)
+    res = task.DecodeFinalize(m)
+    assert "wer" in res and res["num_utterances"] == 4.0
