@@ -161,7 +161,13 @@ class Params:
       if isinstance(p.default, Params):
         v = p.default.Copy()
       else:
-        v = _copy.deepcopy(p.default)
+        try:
+          v = _copy.deepcopy(p.default)
+        except TypeError:
+          # runtime handles (jax Mesh/Device objects, callables bound to
+          # device state) are not picklable — share the reference, like the
+          # reference shares non-copyable param values
+          v = p.default
       res.__dict__["_params"][name] = _Param(name, v, p.description)
     if isinstance(res, InstantiableParams) and isinstance(
         self, InstantiableParams):

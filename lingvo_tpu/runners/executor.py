@@ -25,7 +25,7 @@ class ExecutorTpu:
 
   def __init__(self, model_params, logdir: str, schedule=None, task=None,
                init_seed: int = 1234, precompile: bool = False,
-               max_train_retries: int = 3):
+               max_train_retries: int = 3, mlperf_benchmark: str = ""):
     """model_params: SingleTaskModel-style params (task + input attached).
 
     If `task` is given (e.g. the instance shared with the program schedule),
@@ -68,6 +68,14 @@ class ExecutorTpu:
     self._init_seed = init_seed
     self._pruning_schedule = None
     self._pruning_masks = None
+    # MLPerf-compliance logging (ref ml_perf_log.py:80 + executor hooks)
+    self._mlperf = None
+    if mlperf_benchmark:
+      from lingvo_tpu.core import ml_perf_log
+      self._mlperf = ml_perf_log.MlPerfLogger(
+          os.path.join(logdir, "mlperf_log.txt"),
+          benchmark=mlperf_benchmark)
+      self._mlperf.Print(ml_perf_log.INIT_START)
     self._last_prune_step = -1
     self._precompile = precompile
     self._max_steps = tp.max_steps
@@ -162,11 +170,31 @@ class ExecutorTpu:
         prog.Compile(state)
 
     from lingvo_tpu.core import retry as retry_lib
+    if self._mlperf is not None:
+      from lingvo_tpu.core import ml_perf_log
+      self._mlperf.Print(ml_perf_log.INIT_STOP)
+      self._mlperf.Print(ml_perf_log.RUN_START)
+    try:
+      return self._MainLoop(state, start_step)
+    except BaseException:
+      if self._mlperf is not None:
+        from lingvo_tpu.core import ml_perf_log
+        self._mlperf.Print(ml_perf_log.RUN_STOP,
+                           metadata={"status": "aborted"})
+        self._mlperf.Close()
+      raise
+
+  def _MainLoop(self, state, start_step):
+    from lingvo_tpu.core import retry as retry_lib
     step = start_step
     consecutive_failures = 0
     while step < self._max_steps:
       if self._checkpointer.ShouldSave(step):
         self._checkpointer.Save(step, state)
+      if self._mlperf is not None:
+        from lingvo_tpu.core import ml_perf_log
+        self._mlperf.Print(ml_perf_log.BLOCK_START,
+                           metadata={"step": step})
       try:
         state, results = self._schedule.Run(state)
         consecutive_failures = 0
@@ -188,6 +216,19 @@ class ExecutorTpu:
       step = int(jax.device_get(state.step))
       state = self._MaybePrune(state, step)
       self._ExportMetrics(step, results)
+      if self._mlperf is not None:
+        from lingvo_tpu.core import ml_perf_log
+        self._mlperf.Print(ml_perf_log.BLOCK_STOP,
+                           metadata={"step": step})
+        for name, r in results.items():
+          if not (isinstance(r, dict) and name.startswith("eval")):
+            continue
+          if "accuracy" in r:  # eval_accuracy is higher-is-better ONLY
+            self._mlperf.Print(ml_perf_log.EVAL_ACCURACY, r["accuracy"],
+                               metadata={"step": step, "program": name})
+          if "loss" in r:
+            self._mlperf.Print("eval_loss", r["loss"],
+                               metadata={"step": step, "program": name})
       if self._early_stop is not None and self._task is not None:
         tp = self._task.p.train
         # one designated eval program feeds the plateau detector — mixing
@@ -201,6 +242,11 @@ class ExecutorTpu:
                 f"(no {tp.early_stop_metric} improvement in "
                 f"{tp.early_stop_window} steps)", flush=True)
           break
+    if self._mlperf is not None:
+      from lingvo_tpu.core import ml_perf_log
+      self._mlperf.Print(ml_perf_log.RUN_STOP,
+                         metadata={"status": "success", "step": step})
+      self._mlperf.Close()
     self._checkpointer.Save(step, state, force=True)
     self._checkpointer.Close()
     # marker for follower jobs (evaler/decoder pollers): training is over —

@@ -58,6 +58,7 @@ class BaseProgram:
                                      self.p.name or type(self).__name__)
     os.makedirs(self._program_dir, exist_ok=True)
     self._step_fn = None
+    self._loop_fn = None
     self._run_count = 0
     from lingvo_tpu.core import summary_utils
     self._tb = summary_utils.SummaryWriter(
@@ -143,6 +144,11 @@ class TrainProgram(BaseProgram):
     p = super().Params()
     p.name = "train"
     p.Define("base_step_seed", 1234, "Base PRNG seed for step seeds.")
+    p.Define("on_device_loop", False,
+             "Run all steps_per_loop inside ONE jit call (lax.scan over a "
+             "stacked batch) — one host round-trip per loop instead of per "
+             "step (ref tpu_training_loop.repeat, program.py:601-609). The "
+             "host prefetches steps_per_loop batches and stacks them.")
     return p
 
   def _GetStepFn(self, state: NestedMap | None = None):
@@ -165,24 +171,96 @@ class TrainProgram(BaseProgram):
       self._step_fn = jax.jit(_Step, donate_argnums=(0,))
     return self._step_fn
 
+  def Compile(self, state: NestedMap) -> None:
+    if not self.p.on_device_loop:
+      return super().Compile(state)
+    batches = [self.input_generator.GetPreprocessedInputBatch()
+               for _ in range(self.p.steps_per_loop)]
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    stacked = stacked.Transform(jnp.asarray)
+    with self._MeshScope():
+      self._GetLoopFn(state).lower(state, stacked).compile()
+
+  def _GetLoopFn(self, state: NestedMap | None = None):
+    """steps_per_loop TrainSteps as ONE jitted lax.scan over stacked batches
+    (the reference's on-device training loop, program.py:601-609)."""
+    if self._loop_fn is None:
+
+      state_shardings = None
+      if (self.p.mesh is not None and self.p.state_sharding_fn is not None
+          and state is not None):
+        state_shardings = self.p.state_sharding_fn(state)
+
+      def _Loop(state, stacked_batches):
+        key = jax.random.PRNGKey(self.p.base_step_seed)
+
+        def _Body(carry, batch):
+          state, acc, stats_acc = carry
+          if state_shardings is not None:
+            state = jax.lax.with_sharding_constraint(state, state_shardings)
+          state, out = self._task.TrainStep(state, batch, key)
+          if state_shardings is not None:
+            state = jax.lax.with_sharding_constraint(state, state_shardings)
+          acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
+          stats = NestedMap(
+              {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+          stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats)
+          return (state, acc, stats_acc), ()
+
+        # fixed-structure zero accumulators (scan carries can't grow)
+        _, out_shape = jax.eval_shape(
+            lambda s, b: self._task.TrainStep(s, b, key), state,
+            jax.tree_util.tree_map(lambda x: x[0], stacked_batches))
+        zeros = lambda m: NestedMap(
+            {k: jnp.zeros((2,), jnp.float32) for k in m.keys()})
+        acc0 = zeros(out_shape.metrics)
+        stats0 = NestedMap({k: jnp.zeros((2,), jnp.float32)
+                            for k, _ in out_shape.stats.FlattenItems()})
+        (state, acc, stats_acc), _ = jax.lax.scan(
+            _Body, (state, acc0, stats0), stacked_batches)
+        return state, acc, stats_acc
+
+      self._loop_fn = jax.jit(_Loop, donate_argnums=(0,))
+    return self._loop_fn
+
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
     p = self.p
-    fn = self._GetStepFn(state)
-    acc = None
-    stats_acc = None
     t0 = time.time()
-    with self._MeshScope(), self._ProfilerScope():
-      for _ in range(p.steps_per_loop):
-        batch = self._PutBatch(
-            self.input_generator.GetPreprocessedInputBatch())
-        state, out = fn(state, batch)
-        acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
-        stats_pairs = NestedMap(
-            {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
-        stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
-      # One host sync per loop (ref: one session.run per steps_per_loop);
-      # inside the profiler scope so traces capture the device work.
-      jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    if p.on_device_loop:
+      # host: prefetch + stack steps_per_loop batches; device: one program
+      batches = [self.input_generator.GetPreprocessedInputBatch()
+                 for _ in range(p.steps_per_loop)]
+      stacked = jax.tree_util.tree_map(
+          lambda *xs: np.stack(xs), *batches)
+      if self.p.mesh is not None and self.p.input_sharding is not None:
+        # the stacked leading dim is the STEPS axis: keep it unsharded and
+        # shift the per-step batch spec right by one
+        spec = jax.sharding.PartitionSpec(None, *self.p.input_sharding)
+        sharding = jax.sharding.NamedSharding(self.p.mesh, spec)
+        stacked = stacked.Transform(
+            lambda x: jax.device_put(jnp.asarray(x), sharding))
+      else:
+        stacked = stacked.Transform(jnp.asarray)
+      fn = self._GetLoopFn(state)
+      with self._MeshScope(), self._ProfilerScope():
+        state, acc, stats_acc = fn(state, stacked)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    else:
+      fn = self._GetStepFn(state)
+      acc = None
+      stats_acc = None
+      with self._MeshScope(), self._ProfilerScope():
+        for _ in range(p.steps_per_loop):
+          batch = self._PutBatch(
+              self.input_generator.GetPreprocessedInputBatch())
+          state, out = fn(state, batch)
+          acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
+          stats_pairs = NestedMap(
+              {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+          stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
+        # One host sync per loop (ref: one session.run per steps_per_loop);
+        # inside the profiler scope so traces capture the device work.
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
     wall = time.time() - t0
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     if stats_acc:

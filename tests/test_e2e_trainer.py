@@ -248,3 +248,35 @@ class TestTrainerCli:
     assert rc == 0
     out = capsys.readouterr().out
     assert "TOTAL" in out
+
+
+class TestOnDeviceLoop:
+
+  def test_on_device_loop_matches_host_loop(self, tmp_path):
+    """steps_per_loop as ONE jitted scan == per-step host loop (theta and
+    metrics), the reference's in-graph training loop idiom."""
+    from lingvo_tpu.runners import program as program_lib
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+
+    def run(on_device):
+      task = mp.task.Instantiate()
+      task.FinalizePaths()
+      state = task.CreateTrainState(jax.random.PRNGKey(0))
+      tp = program_lib.TrainProgram.Params().Set(
+          task=mp.task, logdir=str(tmp_path / str(on_device)),
+          steps_per_loop=6, on_device_loop=on_device)
+      prog = program_lib.TrainProgram(
+          tp, task=task, input_generator=mp.input.Instantiate())
+      state, result = prog.Run(state)
+      state, result = prog.Run(state)
+      return state, result
+
+    s1, r1 = run(False)
+    s2, r2 = run(True)
+    np.testing.assert_allclose(r1["loss"], r2["loss"], rtol=1e-4)
+    assert int(jax.device_get(s2.step)) == 12
+    for a, b in zip(jax.tree_util.tree_leaves(s1.theta),
+                    jax.tree_util.tree_leaves(s2.theta)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

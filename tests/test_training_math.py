@@ -217,3 +217,76 @@ class TestLearner:
     new_theta, new_state, stats = step(theta, state,
                                        NestedMap(w=jnp.ones(3)), 0)
     np.testing.assert_allclose(new_theta.w, 0.9)
+
+
+class TestDistributedShampoo:
+
+  def test_converges_on_quadratic(self):
+    from lingvo_tpu.core import optimizer as opt_lib
+    p = opt_lib.DistributedShampoo.Params().Set(statistics_compute_steps=2)
+    opt = p.Instantiate()
+    params = NestedMap(w=jnp.ones((8, 4)), b=jnp.ones((4,)))
+    state = opt.InitState(params)
+    target = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+    update = jax.jit(opt.Update)
+    losses = []
+    for step in range(60):
+      g = NestedMap(w=(params.w - target), b=jnp.zeros((4,)))
+      params, state = update(state, g, params, 0.3, step)
+      losses.append(float(jnp.sum((params.w - target) ** 2)))
+    assert losses[-1] < 1e-3 * losses[0], (losses[0], losses[-1])
+
+  def test_oversized_and_vector_fall_back_to_adagrad(self):
+    from lingvo_tpu.core import optimizer as opt_lib
+    p = opt_lib.DistributedShampoo.Params().Set(block_size=4)
+    opt = p.Instantiate()
+    params = NestedMap(big=jnp.ones((8, 8)), vec=jnp.ones((5,)))
+    state = opt.InitState(params)
+    # factors for non-preconditioned leaves are scalar placeholders
+    assert state.stat_l.big.shape == ()
+    assert state.stat_l.vec.shape == ()
+    g = NestedMap(big=jnp.ones((8, 8)), vec=jnp.ones((5,)))
+    params2, state = jax.jit(opt.Update)(state, g, params, 0.1, 0)
+    assert float(params2.big[0, 0]) < 1.0  # still updated (diag AdaGrad)
+
+  def test_trains_a_real_task(self):
+    from lingvo_tpu.core import optimizer as opt_lib
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    import test_executor_hardening as helpers
+    task_p = helpers._TaskParams(lr=0.1)
+    task_p.train.learner.optimizer = (
+        opt_lib.DistributedShampoo.Params().Set(statistics_compute_steps=5))
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = helpers._RegressionInput()
+    step = jax.jit(task.TrainStep)
+    losses = []
+    for _ in range(40):
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+    assert losses[-1] < 0.2 * losses[0], (losses[0], losses[-1])
+
+
+class TestMlPerfLog:
+
+  def test_mllog_lines(self, tmp_path):
+    from lingvo_tpu.core import ml_perf_log
+    import json
+    path = str(tmp_path / "log.txt")
+    logger = ml_perf_log.MlPerfLogger(path, benchmark="bert")
+    logger.Print(ml_perf_log.RUN_START)
+    logger.Print(ml_perf_log.EVAL_ACCURACY, 0.71, metadata={"step": 100})
+    logger.Print(ml_perf_log.RUN_STOP, metadata={"status": "success"})
+    logger.Close()
+    lines = open(path).read().splitlines()
+    assert all(l.startswith(":::MLLOG ") for l in lines)
+    recs = [json.loads(l[len(":::MLLOG "):]) for l in lines]
+    keys = [r["key"] for r in recs]
+    assert keys[0] == "submission_benchmark"
+    run_start = next(r for r in recs if r["key"] == "run_start")
+    assert run_start["event_type"] == "INTERVAL_START"
+    acc = next(r for r in recs if r["key"] == "eval_accuracy")
+    assert acc["value"] == 0.71 and acc["metadata"]["step"] == 100

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Librispeech-style featurization: wav audio -> log-mel recordio shards
+the ASR input pipeline can read (ref `lingvo/tools/create_asr_features.py`
++ `audio_lib.py`).
+
+Uses the framework's own MelAsrFrontend (the same op the model applies to
+raw waveform at training time) so offline features == online features.
+Input manifest: lines of "<audio_path>\t<transcript>"."""
+
+from __future__ import annotations
+
+import argparse
+import struct
+import sys
+import wave
+
+import numpy as np
+
+
+def _ReadWav(path: str) -> tuple[np.ndarray, int]:
+  with wave.open(path, "rb") as w:
+    rate = w.getframerate()
+    n = w.getnframes()
+    raw = w.readframes(n)
+    width = w.getsampwidth()
+    if width == 2:
+      pcm = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+      pcm = np.frombuffer(raw, np.int32).astype(np.float32) / 2**31
+    else:
+      raise ValueError(f"unsupported sample width {width} in {path}")
+    if w.getnchannels() > 1:
+      pcm = pcm.reshape(-1, w.getnchannels()).mean(-1)
+  return pcm, rate
+
+
+def _WriteRecordio(path: str, records: list[bytes]):
+  """Length-prefixed container the native RecordIOIterator reads."""
+  with open(path, "wb") as f:
+    for rec in records:
+      f.write(struct.pack("<I", len(rec)))
+      f.write(rec)
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--manifest", required=True,
+                  help="Lines of '<wav_path>\\t<transcript>'.")
+  ap.add_argument("--output", required=True, help="recordio shard path.")
+  ap.add_argument("--num_bins", type=int, default=80)
+  args = ap.parse_args(argv)
+
+  import jax.numpy as jnp
+  from lingvo_tpu.models.asr import frontend as frontend_lib
+  from lingvo_tpu.core.nested_map import NestedMap
+  import json
+
+  frontends = {}  # sample_rate -> frontend (filterbank depends on the rate)
+
+  records = []
+  for line in open(args.manifest):
+    line = line.strip()
+    if not line:
+      continue
+    path, transcript = line.split("\t", 1)
+    pcm, rate = _ReadWav(path)
+    if rate not in frontends:
+      frontends[rate] = frontend_lib.MelAsrFrontend.Params().Set(
+          num_bins=args.num_bins, sample_rate=rate).Instantiate()
+    fe = frontends[rate]
+    feats, paddings = fe.FProp(NestedMap(), jnp.asarray(pcm[None]), None)
+    n = int((1.0 - np.asarray(paddings)[0]).sum()) if paddings is not None \
+        else feats.shape[1]
+    rec = {
+        "features": np.asarray(feats[0, :n]).tolist(),
+        "transcript": transcript,
+        "sample_rate": rate,
+    }
+    records.append(json.dumps(rec).encode())
+  _WriteRecordio(args.output, records)
+  print(f"wrote {len(records)} utterances -> {args.output}")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
