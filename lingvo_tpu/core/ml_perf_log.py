@@ -43,7 +43,8 @@ class MlPerfLogger:
 
   def __init__(self, path: str | None = None, benchmark: str = "",
                org: str = "", platform: str = "", echo: bool = False):
-    self._file = open(path, "a") if path else None
+    # truncate: the compliance checker expects exactly ONE run per log
+    self._file = open(path, "w") if path else None
     self._echo = echo
     self._benchmark = benchmark
     if benchmark:

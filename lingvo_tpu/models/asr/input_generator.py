@@ -77,3 +77,45 @@ class SyntheticAsrInput(base_input_generator.BaseInputGenerator):
     if p.teacher_forcing:
       tgt.labels = labels
     return NestedMap(features=feats, feature_paddings=fpad, tgt=tgt)
+
+
+class AsrRecordInput(base_input_generator.FileBasedSequenceInputGenerator):
+  """Real-data ASR input over featurized recordio shards (the output of
+  tools/create_asr_features.py): JSON records with 'features' [t, bins] and
+  'transcript', bucketed by frame count; transcripts tokenized by
+  p.tokenizer (grapheme/WPM — ids must leave 0 free for CTC blank).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("num_bins", 80, "Feature dim (records must match).")
+    p.Define("max_label_len", 64, "Max transcript tokens.")
+    p.bucket_upper_bound = [400, 800, 1600]
+    p.bucket_batch_limit = [32, 16, 8]
+    return p
+
+  def ProcessRecord(self, record: bytes):
+    import json
+    p = self.p
+    try:
+      rec = json.loads(record)
+    except ValueError:
+      return None
+    feats = np.asarray(rec["features"], np.float32)
+    if feats.ndim != 2 or feats.shape[1] != p.num_bins or not feats.size:
+      return None
+    t = feats.shape[0]
+    if t > p.bucket_upper_bound[-1]:
+      return None
+    _, label_ids, label_pads = self.StringsToIds([rec["transcript"]],
+                                                 p.max_label_len)
+    n = int((1.0 - label_pads[0]).sum())
+    if n < 1:
+      return None
+    return NestedMap(
+        features=feats,
+        feature_paddings=np.zeros(t, np.float32),
+        tgt=NestedMap(ids=label_ids[0][:n],
+                      paddings=label_pads[0][:n]),
+        bucket_key=t)

@@ -70,8 +70,9 @@ class ExecutorTpu:
     self._pruning_masks = None
     # MLPerf-compliance logging (ref ml_perf_log.py:80 + executor hooks)
     self._mlperf = None
+    from lingvo_tpu.core import ml_perf_log
+    self._mllog = ml_perf_log
     if mlperf_benchmark:
-      from lingvo_tpu.core import ml_perf_log
       self._mlperf = ml_perf_log.MlPerfLogger(
           os.path.join(logdir, "mlperf_log.txt"),
           benchmark=mlperf_benchmark)
@@ -169,17 +170,14 @@ class ExecutorTpu:
       for prog in self._schedule.programs:
         prog.Compile(state)
 
-    from lingvo_tpu.core import retry as retry_lib
     if self._mlperf is not None:
-      from lingvo_tpu.core import ml_perf_log
-      self._mlperf.Print(ml_perf_log.INIT_STOP)
-      self._mlperf.Print(ml_perf_log.RUN_START)
+      self._mlperf.Print(self._mllog.INIT_STOP)
+      self._mlperf.Print(self._mllog.RUN_START)
     try:
       return self._MainLoop(state, start_step)
     except BaseException:
       if self._mlperf is not None:
-        from lingvo_tpu.core import ml_perf_log
-        self._mlperf.Print(ml_perf_log.RUN_STOP,
+        self._mlperf.Print(self._mllog.RUN_STOP,
                            metadata={"status": "aborted"})
         self._mlperf.Close()
       raise
@@ -192,13 +190,16 @@ class ExecutorTpu:
       if self._checkpointer.ShouldSave(step):
         self._checkpointer.Save(step, state)
       if self._mlperf is not None:
-        from lingvo_tpu.core import ml_perf_log
-        self._mlperf.Print(ml_perf_log.BLOCK_START,
+        self._mlperf.Print(self._mllog.BLOCK_START,
                            metadata={"step": step})
       try:
         state, results = self._schedule.Run(state)
         consecutive_failures = 0
       except BaseException as e:  # noqa: BLE001
+        if self._mlperf is not None:
+          # keep intervals balanced: close the block before retrying/raising
+          self._mlperf.Print(self._mllog.BLOCK_STOP,
+                             metadata={"step": step, "status": "error"})
         if (not retry_lib.IsTransient(e) or
             consecutive_failures >= self._max_train_retries):
           raise
@@ -217,8 +218,7 @@ class ExecutorTpu:
       state = self._MaybePrune(state, step)
       self._ExportMetrics(step, results)
       if self._mlperf is not None:
-        from lingvo_tpu.core import ml_perf_log
-        self._mlperf.Print(ml_perf_log.BLOCK_STOP,
+        self._mlperf.Print(self._mllog.BLOCK_STOP,
                            metadata={"step": step})
         for name, r in results.items():
           if not (isinstance(r, dict) and name.startswith("eval")):
@@ -243,8 +243,7 @@ class ExecutorTpu:
                 f"{tp.early_stop_window} steps)", flush=True)
           break
     if self._mlperf is not None:
-      from lingvo_tpu.core import ml_perf_log
-      self._mlperf.Print(ml_perf_log.RUN_STOP,
+      self._mlperf.Print(self._mllog.RUN_STOP,
                          metadata={"status": "success", "step": step})
       self._mlperf.Close()
     self._checkpointer.Save(step, state, force=True)

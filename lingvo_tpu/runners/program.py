@@ -174,10 +174,12 @@ class TrainProgram(BaseProgram):
   def Compile(self, state: NestedMap) -> None:
     if not self.p.on_device_loop:
       return super().Compile(state)
-    batches = [self.input_generator.GetPreprocessedInputBatch()
-               for _ in range(self.p.steps_per_loop)]
-    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
-    stacked = stacked.Transform(jnp.asarray)
+    # shapes only: tile ONE batch rather than consuming steps_per_loop
+    # real batches from a possibly-finite stream
+    batch = self.input_generator.GetPreprocessedInputBatch()
+    stacked = batch.Transform(
+        lambda x: jnp.broadcast_to(
+            jnp.asarray(x)[None], (self.p.steps_per_loop,) + np.shape(x)))
     with self._MeshScope():
       self._GetLoopFn(state).lower(state, stacked).compile()
 

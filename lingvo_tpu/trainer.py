@@ -82,6 +82,14 @@ def main(argv=None):
                       help="Follower jobs exit after this long without a "
                            "new checkpoint (also exit early when the "
                            "trainer's FINISHED marker appears).")
+  # multi-host control plane (ref trainer.py:210-278 cluster_spec flags)
+  parser.add_argument("--coordinator_address", default=None,
+                      help="host:port of process 0 (jax.distributed).")
+  parser.add_argument("--num_processes", type=int, default=None)
+  parser.add_argument("--process_id", type=int, default=None)
+  parser.add_argument("--mlperf_benchmark", default="",
+                      help="If set, write MLPerf :::MLLOG compliance events "
+                           "to <logdir>/mlperf_log.txt.")
   parser.add_argument("--max_steps", type=int, default=None,
                       help="Override task max_steps.")
   parser.add_argument("--train_executions_per_eval", type=int, default=1)
@@ -96,6 +104,12 @@ def main(argv=None):
 
   if not args.model:
     parser.error("--model is required")
+
+  if args.coordinator_address or args.num_processes:
+    from lingvo_tpu.core import cluster
+    cluster.InitDistributed(
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes, process_id=args.process_id)
 
   model_params = model_registry.GetParams(args.model, "Train")
   if args.max_steps is not None:
@@ -121,7 +135,8 @@ def main(argv=None):
   if args.mode == "train":
     from lingvo_tpu.runners import executor as executor_lib
     execu = executor_lib.ExecutorTpu(model_params, args.logdir,
-                                     schedule=schedule, task=task)
+                                     schedule=schedule, task=task,
+                                     mlperf_benchmark=args.mlperf_benchmark)
     execu.Start()
     return 0
   if args.mode in ("eval", "decode"):

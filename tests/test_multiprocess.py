@@ -1,0 +1,85 @@
+"""Multi-host (multi-process) distributed smoke test.
+
+Exercises the control plane the reference runs over gRPC
+(`trainer.py:256-278` tf.distribute.Server + cluster specs): two REAL
+processes join via `cluster.InitDistributed` (jax.distributed), build a
+global mesh spanning both hosts' devices, feed per-host batch shards
+through `jax.make_array_from_process_local_data` (the InfeedContextScope
+per-host-sharding equivalent, SURVEY §2.9), and run a jitted global-sum —
+verifying cross-process collectives and that each host only touched its
+own shard.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import cluster
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+cluster.InitDistributed(coordinator_address=f"localhost:{port}",
+                        num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()  # 2 local x 2 procs
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+# per-host data: host p contributes rows filled with (p+1)
+local = np.full((2, 3), float(pid + 1), np.float32)
+global_arr = jax.make_array_from_process_local_data(sharding, local, (4, 3))
+
+@jax.jit
+def global_sum(x):
+  return jnp.sum(x)
+
+total = float(global_sum(global_arr))
+# rows: host0 -> 2 rows of 1s, host1 -> 2 rows of 2s => sum = 2*3*1 + 2*3*2
+assert total == 18.0, total
+print(f"proc{pid} OK total={total}", flush=True)
+"""
+
+
+class TestMultiProcessDistributed:
+
+  def test_two_process_psum(self, tmp_path):
+    import socket
+    with socket.socket() as s:
+      s.bind(("", 0))
+      port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+      try:
+        out, _ = p.communicate(timeout=180)
+      except subprocess.TimeoutExpired:
+        for q in procs:
+          q.kill()
+        pytest.fail("distributed workers hung")
+      outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+      assert p.returncode == 0, f"proc{i} failed:\n{out[-2000:]}"
+      assert f"proc{i} OK" in out

@@ -66,7 +66,9 @@ def main(argv=None):
     pcm, rate = _ReadWav(path)
     if rate not in frontends:
       frontends[rate] = frontend_lib.MelAsrFrontend.Params().Set(
-          num_bins=args.num_bins, sample_rate=rate).Instantiate()
+          num_bins=args.num_bins, sample_rate=rate,
+          # filters above Nyquist would be identically zero (8 kHz audio)
+          upper_edge_hz=min(7600.0, rate / 2.0)).Instantiate()
     fe = frontends[rate]
     feats, paddings = fe.FProp(NestedMap(), jnp.asarray(pcm[None]), None)
     n = int((1.0 - np.asarray(paddings)[0]).sum()) if paddings is not None \
