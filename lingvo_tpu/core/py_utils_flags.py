@@ -1,0 +1,37 @@
+"""Global debug/assert flags (ref `lingvo/core/py_utils_flags.py`:
+--enable_asserts, --enable_check_numerics etc.).
+
+Env-var driven (LINGVO_TPU_<NAME>=1) with programmatic override — flags
+configure debug tooling only, never model semantics (SURVEY §5: all model
+config lives in the Params tree)."""
+
+from __future__ import annotations
+
+import os
+
+_OVERRIDES: dict[str, bool] = {}
+
+
+def _Flag(name: str, default: bool = False) -> bool:
+  if name in _OVERRIDES:
+    return _OVERRIDES[name]
+  return os.environ.get(f"LINGVO_TPU_{name.upper()}", "") in ("1", "true")
+
+
+def SetFlag(name: str, value: bool) -> None:
+  _OVERRIDES[name] = value
+
+
+def enable_asserts() -> bool:
+  """Shape/value assert helpers in py_utils become real checks."""
+  return _Flag("enable_asserts", True)
+
+
+def enable_check_numerics() -> bool:
+  """CheckNumerics wrappers raise on NaN/Inf activations."""
+  return _Flag("enable_check_numerics")
+
+
+def use_eager_pallas_interpret() -> bool:
+  """Force Pallas kernels to interpret mode (debugging off-TPU)."""
+  return _Flag("pallas_interpret")

@@ -25,7 +25,8 @@ class ExecutorTpu:
 
   def __init__(self, model_params, logdir: str, schedule=None, task=None,
                init_seed: int = 1234, precompile: bool = False,
-               max_train_retries: int = 3, mlperf_benchmark: str = ""):
+               max_train_retries: int = 3, mlperf_benchmark: str = "",
+               trial=None):
     """model_params: SingleTaskModel-style params (task + input attached).
 
     If `task` is given (e.g. the instance shared with the program schedule),
@@ -39,10 +40,14 @@ class ExecutorTpu:
     os.makedirs(logdir, exist_ok=True)
     self._max_train_retries = max_train_retries
     if task is not None:
+      # task built by the caller: the caller must apply
+      # trial.OverrideModelParams before constructing it
       self._task = task
     elif schedule is not None and hasattr(schedule, "tasks"):
       self._task = None  # multi-task: schedule owns the task set
     else:
+      if trial is not None:
+        model_params = trial.OverrideModelParams(model_params)
       self._model = model_params.Instantiate()
       self._task = self._model.GetTask()
     if self._task is not None:
@@ -69,6 +74,13 @@ class ExecutorTpu:
     self._pruning_schedule = None
     self._pruning_masks = None
     # MLPerf-compliance logging (ref ml_perf_log.py:80 + executor hooks)
+    # hyperparameter-tuning service hook (ref base_trial.Trial + the
+    # executor's trial consultation; NoOpTrial when absent)
+    if trial is None:
+      from lingvo_tpu.core import base_trial
+      trial = base_trial.NoOpTrial()
+    self._trial = trial
+    self._trial_done = False
     self._mlperf = None
     from lingvo_tpu.core import ml_perf_log
     self._mllog = ml_perf_log
@@ -217,6 +229,35 @@ class ExecutorTpu:
       step = int(jax.device_get(state.step))
       state = self._MaybePrune(state, step)
       self._ExportMetrics(step, results)
+      # trial reporting: eval AND decode program metrics; NaN train loss ->
+      # report infeasible and stop (ref _RunLoop NaN-under-Vizier handling).
+      # Multi-task schedules key results 'train_<task>', so scan them all.
+      import math as _math
+      nan_loss = any(
+          isinstance(r, dict) and "loss" in r
+          and not _math.isfinite(r["loss"])
+          for name, r in results.items() if name.startswith("train"))
+      if nan_loss:
+        self._trial.ReportDone(infeasible=True, reason="nan_loss")
+        self._trial_done = True
+        if self._mlperf is not None:
+          self._mlperf.Print(self._mllog.RUN_STOP,
+                             metadata={"status": "aborted",
+                                       "reason": "nan_loss"})
+          self._mlperf.Close()
+          self._mlperf = None
+        print("[executor] NaN/Inf train loss: reporting trial infeasible "
+              "and stopping", flush=True)
+        break
+      stop_requested = False
+      for name, r in results.items():
+        if isinstance(r, dict) and name.startswith(("eval", "decode")):
+          stop_requested |= bool(
+              self._trial.ReportEvalMeasure(step, r))
+      if stop_requested or self._trial.ShouldStop():
+        print(f"[executor] trial requested early stop at step {step}",
+              flush=True)
+        break
       if self._mlperf is not None:
         self._mlperf.Print(self._mllog.BLOCK_STOP,
                            metadata={"step": step})
@@ -246,6 +287,8 @@ class ExecutorTpu:
       self._mlperf.Print(self._mllog.RUN_STOP,
                          metadata={"status": "success", "step": step})
       self._mlperf.Close()
+    if not self._trial_done:
+      self._trial.ReportDone()
     self._checkpointer.Save(step, state, force=True)
     self._checkpointer.Close()
     # marker for follower jobs (evaler/decoder pollers): training is over —

@@ -63,6 +63,10 @@ class _RegressionInput:
   def InfeedBatchSize(self):
     return self._batch
 
+  def __iter__(self):
+    while True:
+      yield self.GetPreprocessedInputBatch()
+
 
 def _TaskParams(name="reg", lr=0.05, max_steps=30, steps_per_loop=5,
                 save_interval=10):
@@ -234,3 +238,61 @@ class TestCheckpointPoller:
     poller.Run()
     # the final checkpoint (step 30) must be scored; poller then exits
     assert prog.seen and prog.seen[-1] == 30
+
+
+class TestTrialWiring:
+
+  def test_trial_reports_and_stops(self, tmp_path):
+    """The executor consults the Trial each cycle (ref executor trial hooks
+    + base_trial.Trial): eval measures reported, early stop honored."""
+    from lingvo_tpu.core import base_trial
+
+    class CountingTrial(base_trial.NoOpTrial):
+      def __init__(self):
+        self.reports = []
+        self.done = None
+
+      def ReportEvalMeasure(self, step, metrics, checkpoint_path=""):
+        self.reports.append((step, dict(metrics)))
+        return len(self.reports) >= 2
+
+      def ReportDone(self, infeasible=False, reason=""):
+        self.done = (infeasible, reason)
+
+    logdir = str(tmp_path)
+    task_p = _TaskParams(max_steps=100, steps_per_loop=5)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=logdir, steps_per_loop=5)
+    eval_p = program_lib.EvalProgram.Params().Set(
+        task=task_p, logdir=logdir, name="eval_test", steps_per_loop=2)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(
+            train_program=train_p, eval_programs=[eval_p]),
+        task=task,
+        input_generators={"Train": _RegressionInput(),
+                          "Test": _RegressionInput(seed=9)})
+    trial = CountingTrial()
+    ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task,
+                                  trial=trial)
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 10  # stopped early, not 100
+    assert len(trial.reports) == 2
+    assert "loss" in trial.reports[0][1]
+
+
+class TestInputBenchmark:
+
+  def test_reports_throughput(self, tmp_path):
+    task_p = _TaskParams()
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    p = program_lib.InputBenchmarkProgram.Params().Set(
+        task=task_p, logdir=str(tmp_path), steps_per_loop=10)
+    prog = program_lib.InputBenchmarkProgram(
+        p, task=task, input_generator=_RegressionInput())
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    _, result = prog.Run(state)
+    assert result["batches_per_second"] > 0
+    assert result["examples_per_second"] >= result["batches_per_second"]
