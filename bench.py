@@ -251,7 +251,19 @@ def main():
   flops_per_step = matmul_flops + softmax_flops + attn_flops
 
   step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
-  # warmup/compile
+  # Compile ONCE; read XLA's cost analysis off the same executable as a
+  # cross-check of the analytic FLOPs formula (None when unavailable).
+  xla_flops = None
+  try:
+    compiled = step_fn.lower(state, batch).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+      analysis = analysis[0]
+    if analysis and "flops" in analysis:
+      xla_flops = float(analysis["flops"])
+  except Exception as e:  # noqa: BLE001
+    print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+  # warmup (reuses the compilation cache)
   state, out = step_fn(state, batch)
   jax.block_until_ready(jax.tree_util.tree_leaves(state.theta)[0])
 
@@ -272,6 +284,8 @@ def main():
       "step_time_s": round(step_time, 4),
       "tokens_per_sec": round(tokens_per_sec, 1),
       "flops_per_step_g": round(flops_per_step / 1e9, 1),
+      "xla_flops_per_step_g": (round(xla_flops / 1e9, 1)
+                               if xla_flops is not None else None),
       "peak_tflops": peak / 1e12,
       "loss": round(loss, 3),
   }

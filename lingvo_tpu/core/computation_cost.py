@@ -1,0 +1,42 @@
+"""Static computation-cost estimates (ref `lingvo/core/computation_cost.py`).
+
+The reference walks layer FPropMeta metadata to sum FLOPs; under XLA the
+compiler itself is the authority — `Compiled.cost_analysis()` reports the
+flops/bytes of the exact program that will run (fusions included). This
+module wraps that for any jittable fn and derives MFU given a step time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+def CostAnalysis(fn: Callable, *args, **kwargs) -> dict[str, float]:
+  """Compiles fn(*args) abstractly and returns XLA's cost analysis.
+
+  Keys of interest: 'flops', 'bytes accessed', 'transcendentals'.
+  """
+  compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+  analysis = compiled.cost_analysis()
+  if isinstance(analysis, (list, tuple)):  # per-device list on some backends
+    analysis = analysis[0]
+  return dict(analysis) if analysis else {}
+
+
+def Flops(fn: Callable, *args, **kwargs) -> float:
+  return float(CostAnalysis(fn, *args, **kwargs).get("flops", 0.0))
+
+
+def Mfu(flops_per_step: float, step_time_s: float,
+        peak_flops: float) -> float:
+  """Model FLOPs utilization for a measured step time."""
+  if step_time_s <= 0 or peak_flops <= 0:
+    return 0.0
+  return flops_per_step / (step_time_s * peak_flops)
+
+
+def TrainStepCost(task, state, batch) -> dict[str, float]:
+  """Cost analysis of a task's full TrainStep (fwd+bwd+optimizer)."""
+  return CostAnalysis(task.TrainStep, state, batch)

@@ -149,30 +149,59 @@ class SequenceBatcher:
                bucket_upper_bound: Sequence[int],
                bucket_batch_limit: Sequence[int],
                pad_field_to_bucket: Sequence[str] = ("ids", "paddings",
-                                                     "labels")):
+                                                     "labels"),
+               flush_every_n: int = 0):
+    """flush_every_n: if >0, partially-filled buckets are emitted after
+    this many processed records since the bucket's oldest entry (ref
+    record_batcher.cc flush timeouts — bounded staleness for rare
+    buckets, in records instead of wall-clock for determinism)."""
     assert len(bucket_upper_bound) == len(bucket_batch_limit)
     self._source = source
     self._processor = processor
     self._bounds = list(bucket_upper_bound)
     self._limits = list(bucket_batch_limit)
     self._pad_fields = set(pad_field_to_bucket)
+    self._flush_every_n = flush_every_n
+    # stats (ref RecordBatcher stats logging)
+    self.stats = {
+        "records": 0, "dropped_too_long": 0, "batches": 0,
+        "flushed_partial": 0,
+    }
 
   def __iter__(self):
     buckets: list[list[NestedMap]] = [[] for _ in self._bounds]
+    oldest: list[int] = [0] * len(self._bounds)
     for record in self._source:
       ex = self._processor(record)
       if ex is None:
         continue
+      self.stats["records"] += 1
       key = int(ex.bucket_key)
       idx = bisect.bisect_left(self._bounds, key)
       if idx >= len(self._bounds):
+        self.stats["dropped_too_long"] += 1
         continue  # longer than the largest bucket: dropped (ref behavior)
+      if not buckets[idx]:
+        oldest[idx] = self.stats["records"]
       buckets[idx].append(ex)
       if len(buckets[idx]) >= self._limits[idx]:
+        self.stats["batches"] += 1
         yield self._Assemble(buckets[idx], self._bounds[idx])
         buckets[idx] = []
+      if self._flush_every_n:
+        # sweep EVERY bucket: a rare bucket must not hold its entries
+        # forever while traffic lands elsewhere
+        for j, bucket in enumerate(buckets):
+          if bucket and (self.stats["records"] - oldest[j]
+                         >= self._flush_every_n):
+            self.stats["batches"] += 1
+            self.stats["flushed_partial"] += 1
+            yield self._Assemble(bucket, self._bounds[j])
+            buckets[j] = []
     for idx, bucket in enumerate(buckets):  # final flush
       if bucket:
+        self.stats["batches"] += 1
+        self.stats["flushed_partial"] += 1
         yield self._Assemble(bucket, self._bounds[idx])
 
   def _Assemble(self, examples: list[NestedMap], bound: int) -> NestedMap:

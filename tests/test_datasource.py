@@ -85,3 +85,42 @@ class TestSequenceBatcher:
         datasource.SequenceBatcher(src, processor, [8], [4]))
     assert len(batches) == 1
     assert batches[0].ids.shape == (1, 8)  # only the len-3 record survived
+
+
+class TestBatcherFlushAndStats:
+
+  def test_flush_every_n_and_stats(self):
+    """Rare buckets flush after N records (ref record_batcher.cc flush
+    timeouts) and the batcher tracks stats."""
+    from lingvo_tpu.core import datasource as ds
+    import numpy as np
+    from lingvo_tpu.core.nested_map import NestedMap
+
+    # 20 short records, one rare long record early, one overlong record
+    records = [b"s"] * 10 + [b"L"] + [b"s"] * 10 + [b"XXL"]
+
+    def processor(rec):
+      n = {b"s": 2, b"L": 8, b"XXL": 99}[rec]
+      return NestedMap(ids=np.arange(n, dtype=np.int32),
+                       paddings=np.zeros(n, np.float32), bucket_key=n)
+
+    batcher = ds.SequenceBatcher(
+        records, processor, bucket_upper_bound=[4, 10],
+        bucket_batch_limit=[4, 4], flush_every_n=6)
+    emitted = []
+    long_flush_position = None
+    for i, b in enumerate(batcher):
+      emitted.append(b)
+      if b.ids.shape[1] == 10 and long_flush_position is None:
+        long_flush_position = batcher.stats["records"]
+    # the lone long record was flushed partial (batch size 1) MID-STREAM
+    # (after ~6 records of unrelated traffic), not by the end-of-stream
+    # final flush at record 22
+    long_batches = [b for b in emitted if b.ids.shape[1] == 10]
+    assert long_batches and long_batches[0].ids.shape[0] == 1
+    assert long_flush_position is not None and long_flush_position < 22, (
+        long_flush_position)
+    assert batcher.stats["dropped_too_long"] == 1
+    assert batcher.stats["flushed_partial"] >= 1
+    assert batcher.stats["records"] == 22
+    assert batcher.stats["batches"] == len(emitted)
