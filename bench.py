@@ -255,11 +255,10 @@ def main():
   # cross-check of the analytic FLOPs formula (None when unavailable).
   xla_flops = None
   try:
+    from lingvo_tpu.core import computation_cost
     compiled = step_fn.lower(state, batch).compile()
-    analysis = compiled.cost_analysis()
-    if isinstance(analysis, (list, tuple)):
-      analysis = analysis[0]
-    if analysis and "flops" in analysis:
+    analysis = computation_cost.CostAnalysisOf(compiled)
+    if "flops" in analysis:
       xla_flops = float(analysis["flops"])
   except Exception as e:  # noqa: BLE001
     print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)

@@ -13,16 +13,24 @@ from typing import Any, Callable
 import jax
 
 
-def CostAnalysis(fn: Callable, *args, **kwargs) -> dict[str, float]:
-  """Compiles fn(*args) abstractly and returns XLA's cost analysis.
+def CostAnalysisOf(compiled) -> dict[str, float]:
+  """Normalizes a jax Compiled's cost_analysis() to a plain dict.
 
   Keys of interest: 'flops', 'bytes accessed', 'transcendentals'.
   """
-  compiled = jax.jit(fn).lower(*args, **kwargs).compile()
   analysis = compiled.cost_analysis()
   if isinstance(analysis, (list, tuple)):  # per-device list on some backends
     analysis = analysis[0]
   return dict(analysis) if analysis else {}
+
+
+def CostAnalysis(fn: Callable, *args, **kwargs) -> dict[str, float]:
+  """Compiles fn(*args) abstractly and returns XLA's cost analysis.
+
+  When you already hold a jitted+compiled fn, use CostAnalysisOf on its
+  Compiled instead (avoids a second compilation).
+  """
+  return CostAnalysisOf(jax.jit(fn).lower(*args, **kwargs).compile())
 
 
 def Flops(fn: Callable, *args, **kwargs) -> float:

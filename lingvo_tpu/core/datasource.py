@@ -176,6 +176,17 @@ class SequenceBatcher:
       if ex is None:
         continue
       self.stats["records"] += 1
+      if self._flush_every_n:
+        # sweep EVERY bucket on EVERY processed record (even ones about to
+        # be dropped): a rare bucket must not hold its entries past the
+        # staleness bound while other traffic flows
+        for j, bucket in enumerate(buckets):
+          if bucket and (self.stats["records"] - oldest[j]
+                         >= self._flush_every_n):
+            self.stats["batches"] += 1
+            self.stats["flushed_partial"] += 1
+            yield self._Assemble(bucket, self._bounds[j])
+            buckets[j] = []
       key = int(ex.bucket_key)
       idx = bisect.bisect_left(self._bounds, key)
       if idx >= len(self._bounds):
@@ -188,16 +199,6 @@ class SequenceBatcher:
         self.stats["batches"] += 1
         yield self._Assemble(buckets[idx], self._bounds[idx])
         buckets[idx] = []
-      if self._flush_every_n:
-        # sweep EVERY bucket: a rare bucket must not hold its entries
-        # forever while traffic lands elsewhere
-        for j, bucket in enumerate(buckets):
-          if bucket and (self.stats["records"] - oldest[j]
-                         >= self._flush_every_n):
-            self.stats["batches"] += 1
-            self.stats["flushed_partial"] += 1
-            yield self._Assemble(bucket, self._bounds[j])
-            buckets[j] = []
     for idx, bucket in enumerate(buckets):  # final flush
       if bucket:
         self.stats["batches"] += 1

@@ -1,0 +1,129 @@
+"""Specialty math layers/ops (ref the `lingvo/core` long tail: `entmax.py`,
+`differentiable_assignment.py` (Sinkhorn), `reversible_layers.py`)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def Entmax15(logits, axis: int = -1):
+  """1.5-entmax: sparse softmax (ref `entmax.py`; Peters et al. 2019).
+
+  Exact algorithm via sorting: p_i = max(0, (z_i - tau))^2 where z = x/2
+  and tau solves sum(p) = 1.
+  """
+  x = logits.astype(jnp.float32) / 2.0
+  x = x - jnp.max(x, axis=axis, keepdims=True)
+  sort = jnp.flip(jnp.sort(x, axis=axis), axis=axis)
+  k = jnp.arange(1, x.shape[axis] + 1, dtype=jnp.float32)
+  shape = [1] * x.ndim
+  shape[axis] = -1
+  k = k.reshape(shape)
+  mean = jnp.cumsum(sort, axis=axis) / k
+  mean_sq = jnp.cumsum(sort ** 2, axis=axis) / k
+  ss = k * (mean_sq - mean ** 2)
+  delta = (1.0 - ss) / k
+  # masked sqrt: sqrt(0)'s infinite VJP would NaN the whole gradient for
+  # any sparse output (delta clamps to exactly 0 off-support)
+  pos = delta > 0
+  delta = jnp.maximum(delta, 0.0)
+  tau = mean - jnp.sqrt(jnp.where(pos, delta, 1.0)) * pos.astype(
+      delta.dtype)
+  support = (tau <= sort).astype(jnp.float32)
+  k_star = jnp.sum(support, axis=axis, keepdims=True)
+  # gather tau at the support size
+  idx = jnp.clip(k_star.astype(jnp.int32) - 1, 0, x.shape[axis] - 1)
+  tau_star = jnp.take_along_axis(tau, idx, axis=axis)
+  out = jnp.maximum(x - tau_star, 0.0) ** 2
+  return out / jnp.maximum(jnp.sum(out, axis=axis, keepdims=True), 1e-12)
+
+
+def SinkhornAssignment(scores, num_iters: int = 20, temperature: float = 1.0):
+  """Differentiable (soft) assignment via Sinkhorn iterations in log space
+  (ref `differentiable_assignment.py`): returns a doubly-stochastic-ish
+  matrix from a [.., n, m] score matrix."""
+  log_p = scores.astype(jnp.float32) / temperature
+
+  def _Iter(log_p, _):
+    log_p = log_p - jax.nn.logsumexp(log_p, axis=-1, keepdims=True)
+    log_p = log_p - jax.nn.logsumexp(log_p, axis=-2, keepdims=True)
+    return log_p, ()
+
+  log_p, _ = jax.lax.scan(_Iter, log_p, None, length=num_iters)
+  return jnp.exp(log_p)
+
+
+class ReversibleLayer(base_layer.BaseLayer):
+  """RevNet-style reversible residual block (ref `reversible_layers.py`):
+
+    y1 = x1 + F(x2) ; y2 = x2 + G(y1)
+
+  The backward pass RECONSTRUCTS (x1, x2) from (y1, y2) instead of storing
+  them — O(1) activation memory in depth when stacked. F/G are arbitrary
+  sub-layers with signature FProp(theta, x) -> same-shape output.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("f", None, "F sub-layer Params.")
+    p.Define("g", None, "G sub-layer Params.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    assert self.p.f is not None and self.p.g is not None
+    self.CreateChild("f", self.p.f)
+    self.CreateChild("g", self.p.g)
+
+  def FProp(self, theta, x1, x2):
+    f_fn = lambda th, x: self.f.FProp(th, x)
+    g_fn = lambda th, x: self.g.FProp(th, x)
+    return _ReversibleCall(f_fn, g_fn, theta.f, theta.g, x1, x2)
+
+  def Reverse(self, theta, y1, y2):
+    """Exact input reconstruction (used by the custom vjp; also handy for
+    tests/invertible-flow uses)."""
+    x2 = y2 - self.g.FProp(theta.g, y1)
+    x1 = y1 - self.f.FProp(theta.f, x2)
+    return x1, x2
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ReversibleCall(f_fn, g_fn, theta_f, theta_g, x1, x2):
+  y1 = x1 + f_fn(theta_f, x2)
+  y2 = x2 + g_fn(theta_g, y1)
+  return y1, y2
+
+
+def _ReversibleFwd(f_fn, g_fn, theta_f, theta_g, x1, x2):
+  y1, y2 = _ReversibleCall(f_fn, g_fn, theta_f, theta_g, x1, x2)
+  # store only OUTPUTS: inputs are reconstructed in the bwd pass
+  return (y1, y2), (theta_f, theta_g, y1, y2)
+
+
+def _ReversibleBwd(f_fn, g_fn, res, grads):
+  theta_f, theta_g, y1, y2 = res
+  dy1, dy2 = grads
+  # ONE vjp trace of G serves both the reconstruction (primal gy1) and the
+  # backprop through y2 = x2 + G(y1)
+  gy1, g_vjp = jax.vjp(lambda th, y: g_fn(th, y), theta_g, y1)
+  x2 = y2 - gy1
+  fx2, f_vjp_x = jax.vjp(lambda th, x: f_fn(th, x), theta_f, x2)
+  x1 = y1 - fx2
+  d_theta_g, dy1_from_g = g_vjp(dy2)
+  dy1_total = dy1 + dy1_from_g
+  d_theta_f, dx2_from_f = f_vjp_x(dy1_total)
+  dx1 = dy1_total
+  dx2 = dy2 + dx2_from_f
+  return d_theta_f, d_theta_g, dx1, dx2
+
+
+_ReversibleCall.defvjp(_ReversibleFwd, _ReversibleBwd)

@@ -480,3 +480,44 @@ class DistributedShampoo(BaseOptimizer):
                                       is_leaf=lambda x: isinstance(x, tuple)),
         accum=new_accum)
     return new_params, new_state
+
+
+class AdaGraft(BaseOptimizer):
+  """Grafts one optimizer's step MAGNITUDE onto another's DIRECTION
+  (ref `optimizer.py:803` AdaGraft / the adagraft.py paper recipe):
+  per-tensor, update = |delta_M| * delta_D / |delta_D|."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("magnitude_optimizer", None, "Optimizer supplying step size.")
+    p.Define("direction_optimizer", None, "Optimizer supplying direction.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.magnitude_optimizer is not None
+    assert p.direction_optimizer is not None
+    self.CreateChild("mag", p.magnitude_optimizer)
+    self.CreateChild("dir", p.direction_optimizer)
+
+  def InitState(self, params):
+    return NestedMap(mag=self.mag.InitState(params),
+                     dir=self.dir.InitState(params))
+
+  def Update(self, state, grads, params, lr, step):
+    mag_params, mag_state = self.mag.Update(state.mag, grads, params, lr,
+                                            step)
+    dir_params, dir_state = self.dir.Update(state.dir, grads, params, lr,
+                                            step)
+
+    def _Graft(w, wm, wd):
+      dm = (wm - w).astype(jnp.float32)
+      dd = (wd - w).astype(jnp.float32)
+      dd_norm = jnp.maximum(jnp.linalg.norm(dd), 1e-16)
+      step_len = jnp.linalg.norm(dm)
+      return (w + (step_len * dd / dd_norm).astype(w.dtype))
+
+    new_params = _TreeMap(_Graft, params, mag_params, dir_params)
+    return new_params, NestedMap(mag=mag_state, dir=dir_state)

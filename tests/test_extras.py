@@ -1,0 +1,136 @@
+"""AdaGraft optimizer + entmax/Sinkhorn/reversible layers (ref lingvo/core
+long tail: adagraft.py, entmax.py, differentiable_assignment.py,
+reversible_layers.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import extras
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(31)
+
+
+class TestEntmax:
+
+  def test_simplex_and_sparsity(self):
+    x = jnp.asarray([[2.0, 1.0, 0.1, -2.0, -3.0]])
+    p = extras.Entmax15(x)
+    np.testing.assert_allclose(float(p.sum()), 1.0, atol=1e-5)
+    assert float(p[0, -1]) == 0.0  # sparse tail, unlike softmax
+    assert float(p[0, 0]) > float(p[0, 1])  # order preserved
+
+  def test_uniform_input_uniform_output(self):
+    p = extras.Entmax15(jnp.zeros((1, 6)))
+    np.testing.assert_allclose(np.asarray(p), 1.0 / 6, atol=1e-5)
+
+  def test_differentiable_with_sparse_output(self):
+    # regression: sqrt(0) off-support used to NaN the whole gradient for
+    # any input whose entmax output is actually sparse
+    x = jnp.asarray([[2.0, 1.0, 0.1, -2.0, -3.0]])
+    assert float(extras.Entmax15(x)[0, -1]) == 0.0  # sparse indeed
+    g = jax.grad(lambda x: extras.Entmax15(x)[0, 0])(x)
+    assert np.all(np.isfinite(np.asarray(g))), np.asarray(g)
+    g2 = jax.grad(lambda x: extras.Entmax15(x)[0, 0])(
+        jnp.asarray([[1.0, 0.5, 0.0]]))
+    assert np.all(np.isfinite(np.asarray(g2)))
+
+
+class TestSinkhorn:
+
+  def test_doubly_stochastic(self):
+    s = jax.random.normal(KEY, (5, 5))
+    a = extras.SinkhornAssignment(s, num_iters=60)
+    np.testing.assert_allclose(np.asarray(a.sum(-1)), 1.0, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.sum(-2)), 1.0, atol=1e-3)
+
+  def test_low_temperature_approaches_permutation(self):
+    s = jnp.asarray([[10.0, 0.0], [0.0, 10.0]])
+    a = extras.SinkhornAssignment(s, num_iters=50, temperature=0.1)
+    np.testing.assert_allclose(np.asarray(a), np.eye(2), atol=1e-3)
+
+
+class TestReversible:
+
+  def _layer(self):
+    fp = layers_lib.ProjectionLayer.Params().Set(
+        name="f", input_dim=8, output_dim=8, activation="TANH")
+    gp = layers_lib.ProjectionLayer.Params().Set(
+        name="g", input_dim=8, output_dim=8, activation="TANH")
+    rp = extras.ReversibleLayer.Params().Set(name="rev", f=fp, g=gp)
+    layer = rp.Instantiate()
+    layer.FinalizePaths()
+    return layer, layer.InstantiateVariables(KEY)
+
+  def test_exact_inverse(self):
+    layer, theta = self._layer()
+    x1 = jax.random.normal(KEY, (2, 8))
+    x2 = jax.random.normal(jax.random.PRNGKey(2), (2, 8))
+    y1, y2 = layer.FProp(theta, x1, x2)
+    rx1, rx2 = layer.Reverse(theta, y1, y2)
+    np.testing.assert_allclose(np.asarray(rx1), np.asarray(x1), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(rx2), np.asarray(x2), atol=1e-5)
+
+  def test_gradients_match_plain_residual(self):
+    layer, theta = self._layer()
+    x1 = jax.random.normal(KEY, (2, 8))
+    x2 = jax.random.normal(jax.random.PRNGKey(2), (2, 8))
+
+    def loss_rev(theta, x1, x2):
+      y1, y2 = layer.FProp(theta, x1, x2)
+      return jnp.sum(y1 ** 2) + jnp.sum(y2 ** 2)
+
+    def loss_ref(theta, x1, x2):
+      y1 = x1 + layer.f.FProp(theta.f, x2)
+      y2 = x2 + layer.g.FProp(theta.g, y1)
+      return jnp.sum(y1 ** 2) + jnp.sum(y2 ** 2)
+
+    g1 = jax.grad(loss_rev, argnums=(0, 1, 2))(theta, x1, x2)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(theta, x1, x2)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+  def test_jittable(self):
+    layer, theta = self._layer()
+    x1 = jax.random.normal(KEY, (2, 8))
+    y1, y2 = jax.jit(layer.FProp)(theta, x1, x1)
+    assert np.all(np.isfinite(np.asarray(y1)))
+
+
+class TestAdaGraft:
+
+  def test_magnitude_from_one_direction_from_other(self):
+    p = opt_lib.AdaGraft.Params().Set(
+        magnitude_optimizer=opt_lib.SGD.Params(),
+        direction_optimizer=opt_lib.Adam.Params())
+    opt = p.Instantiate()
+    opt.FinalizePaths()
+    params = NestedMap(w=jnp.ones((4, 4)))
+    state = opt.InitState(params)
+    grads = NestedMap(w=jnp.full((4, 4), 0.5))
+    new_params, state = jax.jit(opt.Update)(state, grads, params, 0.1, 0)
+    delta = np.asarray(new_params.w - params.w)
+    # magnitude == SGD step norm (lr * |g|)
+    sgd_delta = -0.1 * np.full((4, 4), 0.5)
+    np.testing.assert_allclose(np.linalg.norm(delta),
+                               np.linalg.norm(sgd_delta), rtol=1e-5)
+
+  def test_trains(self):
+    p = opt_lib.AdaGraft.Params().Set(
+        magnitude_optimizer=opt_lib.SGD.Params(),
+        direction_optimizer=opt_lib.Adam.Params())
+    opt = p.Instantiate()
+    opt.FinalizePaths()
+    params = NestedMap(w=jnp.ones((6, 3)))
+    target = jax.random.normal(KEY, (6, 3))
+    state = opt.InitState(params)
+    update = jax.jit(opt.Update)
+    for step in range(200):
+      g = NestedMap(w=(params.w - target))
+      params, state = update(state, g, params, 0.05, step)
+    assert float(jnp.sum((params.w - target) ** 2)) < 0.05
