@@ -63,8 +63,12 @@ class ReversibleLayer(base_layer.BaseLayer):
     y1 = x1 + F(x2) ; y2 = x2 + G(y1)
 
   The backward pass RECONSTRUCTS (x1, x2) from (y1, y2) instead of storing
-  them — O(1) activation memory in depth when stacked. F/G are arbitrary
-  sub-layers with signature FProp(theta, x) -> same-shape output.
+  the inputs, so intra-F/G activations are never kept. Each block still
+  saves its OUTPUT pair as the vjp residual, so a plain Python stack of N
+  blocks stores N boundary pairs (O(depth) boundaries, O(1) interiors);
+  true O(1)-in-depth needs a scan-style driver that re-derives boundaries
+  sequentially. F/G are arbitrary sub-layers with signature
+  FProp(theta, x) -> same-shape output.
   """
 
   @classmethod
@@ -86,8 +90,8 @@ class ReversibleLayer(base_layer.BaseLayer):
     return _ReversibleCall(f_fn, g_fn, theta.f, theta.g, x1, x2)
 
   def Reverse(self, theta, y1, y2):
-    """Exact input reconstruction (used by the custom vjp; also handy for
-    tests/invertible-flow uses)."""
+    """Exact input reconstruction (tests / invertible-flow uses; the custom
+    vjp inlines its own equivalent reconstruction)."""
     x2 = y2 - self.g.FProp(theta.g, y1)
     x1 = y1 - self.f.FProp(theta.f, x2)
     return x1, x2
@@ -116,8 +120,7 @@ def _ReversibleBwd(f_fn, g_fn, res, grads):
   # backprop through y2 = x2 + G(y1)
   gy1, g_vjp = jax.vjp(lambda th, y: g_fn(th, y), theta_g, y1)
   x2 = y2 - gy1
-  fx2, f_vjp_x = jax.vjp(lambda th, x: f_fn(th, x), theta_f, x2)
-  x1 = y1 - fx2
+  _, f_vjp_x = jax.vjp(lambda th, x: f_fn(th, x), theta_f, x2)
   d_theta_g, dy1_from_g = g_vjp(dy2)
   dy1_total = dy1 + dy1_from_g
   d_theta_f, dx2_from_f = f_vjp_x(dy1_total)

@@ -1,0 +1,136 @@
+"""Rotated-box detection AP (ref `lingvo/tasks/car/ap_metric.py` +
+`geometry.py` rotated-IoU): BEV IoU via convex polygon clipping
+(Sutherland–Hodgman), greedy score-ordered matching, all-point
+average precision.
+
+Host-side numpy (decode postprocess), like the reference's metric code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def BoxCorners(box: np.ndarray) -> np.ndarray:
+  """[cx, cy, l, w, theta] -> [4, 2] corners (counter-clockwise)."""
+  cx, cy, l, w, theta = box[:5] if len(box) == 5 else (
+      box[0], box[1], box[3], box[4], box[6])
+  dx, dy = l / 2.0, w / 2.0
+  corners = np.array([[dx, dy], [-dx, dy], [-dx, -dy], [dx, -dy]])
+  c, s = np.cos(theta), np.sin(theta)
+  rot = np.array([[c, -s], [s, c]])
+  return corners @ rot.T + np.array([cx, cy])
+
+
+def _PolygonArea(poly: np.ndarray) -> float:
+  if len(poly) < 3:
+    return 0.0
+  x, y = poly[:, 0], poly[:, 1]
+  return 0.5 * abs(float(np.dot(x, np.roll(y, -1)) -
+                         np.dot(y, np.roll(x, -1))))
+
+
+def _ClipPolygon(poly, a, b):
+  """Clips polygon by the half-plane left of edge a->b (Sutherland–Hodgman)."""
+  out = []
+  n = len(poly)
+  for i in range(n):
+    cur, nxt = poly[i], poly[(i + 1) % n]
+    cur_in = _Cross(a, b, cur) >= 0
+    nxt_in = _Cross(a, b, nxt) >= 0
+    if cur_in:
+      out.append(cur)
+      if not nxt_in:
+        out.append(_Intersect(a, b, cur, nxt))
+    elif nxt_in:
+      out.append(_Intersect(a, b, cur, nxt))
+  return np.asarray(out) if out else np.zeros((0, 2))
+
+
+def _Cross(a, b, p):
+  return (b[0] - a[0]) * (p[1] - a[1]) - (b[1] - a[1]) * (p[0] - a[0])
+
+
+def _Intersect(a, b, p, q):
+  d1 = _Cross(a, b, p)
+  d2 = _Cross(a, b, q)
+  t = d1 / (d1 - d2) if d1 != d2 else 0.0
+  return p + t * (q - p)
+
+
+def RotatedIou(box1: np.ndarray, box2: np.ndarray) -> float:
+  """BEV IoU of two rotated boxes [cx, cy, l, w, theta] (or 7-dof)."""
+  p1 = BoxCorners(np.asarray(box1, np.float64))
+  p2 = BoxCorners(np.asarray(box2, np.float64))
+  inter = p1
+  for i in range(4):
+    if len(inter) == 0:
+      break
+    inter = _ClipPolygon(inter, p2[i], p2[(i + 1) % 4])
+  ai = _PolygonArea(inter)
+  a1, a2 = _PolygonArea(p1), _PolygonArea(p2)
+  union = a1 + a2 - ai
+  return ai / union if union > 0 else 0.0
+
+
+def AveragePrecision(matches: list[tuple[float, bool]],
+                     num_gt: int) -> float:
+  """All-point AP from (score, is_true_positive) detections.
+
+  matches: every detection with its score and whether it matched a gt.
+  """
+  if num_gt == 0:
+    return 0.0
+  if not matches:
+    return 0.0
+  matches = sorted(matches, key=lambda m: -m[0])
+  tp = np.cumsum([1.0 if m[1] else 0.0 for m in matches])
+  fp = np.cumsum([0.0 if m[1] else 1.0 for m in matches])
+  recall = tp / num_gt
+  precision = tp / np.maximum(tp + fp, 1e-9)
+  # all-point interpolation: precision envelope integrated over recall
+  prec_env = np.maximum.accumulate(precision[::-1])[::-1]
+  ap = 0.0
+  prev_r = 0.0
+  for r, p in zip(recall, prec_env):
+    ap += (r - prev_r) * p
+    prev_r = r
+  return float(ap)
+
+
+class ApMetric:
+  """Accumulates rotated-IoU-matched detections across batches."""
+
+  def __init__(self, iou_threshold: float = 0.5):
+    self._iou = iou_threshold
+    self._matches: list[tuple[float, bool]] = []
+    self._num_gt = 0
+
+  def Update(self, pred_boxes: np.ndarray, pred_scores: np.ndarray,
+             gt_boxes: np.ndarray):
+    """pred_boxes [P, 5+], pred_scores [P], gt_boxes [G, 5+] (one scene);
+    greedy score-ordered matching, one detection per gt."""
+    self._num_gt += len(gt_boxes)
+    order = np.argsort(-np.asarray(pred_scores))
+    taken = set()
+    for i in order:
+      best_iou, best_j = 0.0, -1
+      for j in range(len(gt_boxes)):
+        if j in taken:
+          continue
+        iou = RotatedIou(pred_boxes[i], gt_boxes[j])
+        if iou > best_iou:
+          best_iou, best_j = iou, j
+      if best_iou >= self._iou and best_j >= 0:
+        taken.add(best_j)
+        self._matches.append((float(pred_scores[i]), True))
+      else:
+        self._matches.append((float(pred_scores[i]), False))
+
+  @property
+  def value(self) -> float:
+    return AveragePrecision(self._matches, self._num_gt)
+
+  @property
+  def num_ground_truth(self) -> int:
+    return self._num_gt

@@ -173,19 +173,34 @@ class PointPillarsModel(base_model.BaseTask):
     top_cls = jnp.take_along_axis(jnp.argmax(probs, -1), top_cells, axis=1)
     return NestedMap(scores=top_scores, cells=top_cells, boxes=top_boxes,
                      classes=top_cls,
-                     gt_cls_targets=input_batch.cls_targets)
+                     gt_cls_targets=input_batch.cls_targets,
+                     gt_reg_targets=input_batch.reg_targets,
+                     gt_reg_weights=input_batch.reg_weights)
 
   def CreateDecoderMetrics(self):
     from lingvo_tpu.core import metrics as metrics_lib
+    from lingvo_tpu.models.car import ap_metric
     return {"cell_precision": metrics_lib.AverageMetric(),
-            "cell_recall": metrics_lib.AverageMetric()}
+            "cell_recall": metrics_lib.AverageMetric(),
+            "ap": ap_metric.ApMetric(iou_threshold=0.5)}
+
+  def _CellToBox(self, cell: int, residual) -> list:
+    """Cell index + [dx, dy, z, l, w, h, theta] residual -> BEV rotated
+    box [cx, cy, l, w, theta] (the target-encoding inverse)."""
+    g = self.p.backbone.grid_size
+    cy, cx = divmod(int(cell), g)
+    return [cx + 0.5 + float(residual[0]), cy + 0.5 + float(residual[1]),
+            float(residual[3]), float(residual[4]), float(residual[6])]
 
   def PostProcessDecodeOut(self, decode_out, decoder_metrics):
-    """Cell-level detection precision/recall at score>0.5 (the AP slice;
-    full rotated-IoU AP lives with real-data geometry in tools/)."""
+    """Cell-level precision/recall at score>0.5 + rotated-IoU AP@0.5
+    (ref ap_metric.py)."""
     scores = np.asarray(decode_out.scores)
     cells = np.asarray(decode_out.cells)
+    boxes = np.asarray(decode_out.boxes)
     gt = np.asarray(decode_out.gt_cls_targets)
+    gt_reg = np.asarray(decode_out.gt_reg_targets)
+    gt_w = np.asarray(decode_out.gt_reg_weights)
     for i in range(scores.shape[0]):
       pred_cells = set(cells[i][scores[i] > 0.5].tolist())
       gt_cells = set(np.nonzero(gt[i])[0].tolist())
@@ -195,3 +210,11 @@ class PointPillarsModel(base_model.BaseTask):
       if gt_cells:
         decoder_metrics["cell_recall"].Update(
             len(pred_cells & gt_cells) / len(gt_cells))
+      # rotated-IoU AP over decoded absolute boxes
+      pred_boxes = np.asarray(
+          [self._CellToBox(cells[i, k], boxes[i, k])
+           for k in range(cells.shape[1])])
+      gt_boxes = np.asarray(
+          [self._CellToBox(c, gt_reg[i, c])
+           for c in np.nonzero(gt_w[i] > 0)[0]])
+      decoder_metrics["ap"].Update(pred_boxes, scores[i], gt_boxes)

@@ -89,3 +89,39 @@ class TestCar:
     pads3 = jnp.ones((1, 2, 4))
     out3 = layer.FProp(theta, pts, pads3)
     np.testing.assert_allclose(np.asarray(out3), 0.0, atol=1e-6)
+
+
+class TestRotatedIouAp:
+
+  def test_rotated_iou_exact_cases(self):
+    from lingvo_tpu.models.car import ap_metric as ap
+    assert abs(ap.RotatedIou([0, 0, 2, 2, 0], [0, 0, 2, 2, 0]) - 1.0) < 1e-6
+    assert ap.RotatedIou([0, 0, 2, 2, 0], [10, 10, 2, 2, 0]) == 0.0
+    # half-shifted axis-aligned squares: inter 2, union 6
+    assert abs(ap.RotatedIou([0, 0, 2, 2, 0], [1, 0, 2, 2, 0]) - 1/3) < 1e-6
+    # 45-degree rotated square vs itself: octagon intersection, known value
+    iou45 = ap.RotatedIou([0, 0, 2, 2, 0], [0, 0, 2, 2, np.pi / 4])
+    inter = 8 * (2 ** 0.5) - 8
+    expect = inter / (8 - inter)
+    assert abs(iou45 - expect) < 1e-3
+
+  def test_ap_metric_matching(self):
+    from lingvo_tpu.models.car import ap_metric as ap
+    m = ap.ApMetric(iou_threshold=0.5)
+    gt = np.array([[0, 0, 2, 2, 0], [5, 5, 2, 2, 0]])
+    preds = np.array([[0.1, 0, 2, 2, 0], [5, 5.1, 2, 2, 0], [9, 9, 2, 2, 0]])
+    m.Update(preds, np.array([0.9, 0.8, 0.7]), gt)
+    assert m.value == 1.0  # both gt found before the false positive
+    # a second scene with a missed gt drags AP below 1
+    m.Update(np.zeros((0, 5)), np.zeros((0,)), np.array([[3, 3, 2, 2, 0]]))
+    assert m.value < 1.0
+
+  def test_car_decode_reports_ap(self):
+    task, state, _, _, gen = _train("car.kitti.PointPillarsCar", 30)
+    import jax
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    m = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(jax.tree_util.tree_map(np.asarray, dec), m)
+    res = task.DecodeFinalize(m)
+    assert "ap" in res and 0.0 <= res["ap"] <= 1.0
