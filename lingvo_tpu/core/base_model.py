@@ -22,6 +22,7 @@ from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import hyperparams
 from lingvo_tpu.core import learner as learner_lib
 from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import tpu_summary
 from lingvo_tpu.core.nested_map import NestedMap
 
 
@@ -175,6 +176,7 @@ class BaseTask(base_layer.BaseLayer):
     all_stats = NestedMap()
     metrics = per_example = None
     fwd_updates: dict = {}
+    summaries = NestedMap()
     for i, lrn in enumerate(self.learners):
 
       def _Loss(trainable, frozen_rest, lrn=lrn):
@@ -182,7 +184,8 @@ class BaseTask(base_layer.BaseLayer):
         with py_utils.StepSeedContext(step_key), \
              py_utils.GlobalStepContext(state.step):
           with py_utils.ForwardStateContext() as fwd:
-            with py_utils.AuxLossContext() as aux_losses:
+            with py_utils.AuxLossContext() as aux_losses, \
+                 tpu_summary.Context() as summaries_:
               metrics_, per_example_ = self.FProp(full_theta, input_batch)
         loss_val, loss_w = metrics_[lrn.p.loss_name]
         total = jnp.asarray(loss_val, jnp.float32)
@@ -194,11 +197,12 @@ class BaseTask(base_layer.BaseLayer):
           metrics_.aux_loss = (aux_total, loss_w)
         reg = lrn.RegularizationLoss(trainable)
         # fwd updates are tracers from this trace: they MUST exit via aux.
-        return total + reg, (metrics_, per_example_, fwd)
+        return total + reg, (metrics_, per_example_, fwd,
+                             tpu_summary.Merged(summaries_))
 
       trainable = self._TrainableSubset(theta, lrn)
-      (_, (metrics, per_example, fwd_updates)), grads = jax.value_and_grad(
-          _Loss, has_aux=True)(trainable, theta)
+      (_, (metrics, per_example, fwd_updates, summaries)), grads = (
+          jax.value_and_grad(_Loss, has_aux=True)(trainable, theta))
       new_trainable, new_opt_state, stats = lrn.Apply(
           trainable, grads, state.step, state.opt_states[i])
       theta = self._MergeSubset(theta, new_trainable)
@@ -238,7 +242,8 @@ class BaseTask(base_layer.BaseLayer):
             state.ema_theta, theta, ema_mask)
     out_metrics = metrics.Copy() if metrics is not None else NestedMap()
     out_metrics_stats = NestedMap(metrics=out_metrics, stats=all_stats,
-                                  per_example=per_example or NestedMap())
+                                  per_example=per_example or NestedMap(),
+                                  summaries=summaries)
     return new_state, out_metrics_stats
 
   def EvalStep(self, theta: NestedMap, input_batch: NestedMap,

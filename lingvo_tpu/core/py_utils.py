@@ -397,7 +397,6 @@ def DoEval() -> bool:
   return stack[-1] if stack else False
 
 
-@contextlib.contextmanager
 def ForwardStateContext():
   """Collects state updates emitted during FProp (BN moving stats etc.).
 
@@ -409,13 +408,7 @@ def ForwardStateContext():
       loss = task.FProp(theta, batch)
     new_theta = py_utils.ApplyForwardStateUpdates(theta, updates, root_layer)
   """
-  stack = _Stack("fwd_state")
-  collected: dict[str, Any] = {}
-  stack.append(collected)
-  try:
-    yield collected
-  finally:
-    stack.pop()
+  return NamedCollectionContext("fwd_state")
 
 
 def AddForwardStateUpdate(path: str, value: Any) -> None:
@@ -427,19 +420,34 @@ def AddForwardStateUpdate(path: str, value: Any) -> None:
 
 
 @contextlib.contextmanager
-def AuxLossContext():
-  """Collects auxiliary losses (MoE load-balancing etc.) emitted in FProp.
-
-  Yields a dict {path: scalar}; the train step adds their sum to the
-  optimized loss (ref: gshard aux_loss accumulation).
-  """
-  stack = _Stack("aux_loss")
+def NamedCollectionContext(name: str):
+  """Generic trace-time collection stack (aux losses, in-loop summaries)."""
+  stack = _Stack(name)
   collected: dict[str, Any] = {}
   stack.append(collected)
   try:
     yield collected
   finally:
     stack.pop()
+
+
+def NamedCollectionTop(name: str):
+  """The innermost active collection dict for `name`, or None."""
+  stack = _Stack(name)
+  return stack[-1] if stack else None
+
+
+def NamedCollectionActive(name: str) -> bool:
+  return bool(_Stack(name))
+
+
+def AuxLossContext():
+  """Collects auxiliary losses (MoE load-balancing etc.) emitted in FProp.
+
+  Yields a dict {path: scalar}; the train step adds their sum to the
+  optimized loss (ref: gshard aux_loss accumulation).
+  """
+  return NamedCollectionContext("aux_loss")
 
 
 def AddAuxLoss(path: str, value: Any) -> None:

@@ -1,0 +1,351 @@
+"""Step API: layers that process sequences one timestep at a time.
+
+Re-designs the reference's Step abstraction (`lingvo/core/step.py:40`,
+`lingvo/core/steps/{rnn,attention,embedding}_steps.py`) the TPU-native way.
+A Step is a layer with three phases:
+
+  prepared = step.PrepareExternalInputs(theta, external_inputs)   # once
+  state0   = step.ZeroState(theta, prepared, batch_size)          # once
+  out, s1  = step.FProp(theta, prepared, step_inputs, padding, s) # per step
+
+All state is a NestedMap of fixed-shape arrays, so a Step composes directly
+with `jax.lax.scan` (see `RunOverSequence`) and with jit'd autoregressive
+decode loops — the reference needed its hand-written `recurrent.Recurrent`
+while-loop wrapper (`step.py:660` RecurrentStepWrapper) for the same thing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import rnn_cell
+from lingvo_tpu.core import seq_attention
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class Step(base_layer.BaseLayer):
+  """A layer processing input sequences step-by-step (ref `step.py:40`)."""
+
+  def PrepareExternalInputs(self, theta, external_inputs):
+    """Precomputes per-sequence quantities (e.g. packed attention source).
+
+    Default: recursively prepares Step children, keyed by child name
+    (ref `step.py:65`).
+    """
+    external_inputs = external_inputs or NestedMap()
+    packed = NestedMap()
+    for name, child in self.children.items():
+      if isinstance(child, Step):
+        packed[name] = child.PrepareExternalInputs(
+            self.ChildTheta(theta, name),
+            external_inputs.get(name, NestedMap()))
+      elif isinstance(child, list) and child and isinstance(child[0], Step):
+        ctheta = self.ChildTheta(theta, name)
+        packed[name] = [
+            c.PrepareExternalInputs(ctheta[i],
+                                    external_inputs.get(name, NestedMap()))
+            for i, c in enumerate(child)
+        ]
+    return packed
+
+  def ZeroState(self, theta, prepared_inputs, batch_size):
+    """Initial recurrent state; default recurses over Step children."""
+    state0 = NestedMap()
+    for name, child in self.children.items():
+      if isinstance(child, Step):
+        state0[name] = child.ZeroState(
+            self.ChildTheta(theta, name), prepared_inputs.get(name),
+            batch_size)
+      elif isinstance(child, list) and child and isinstance(child[0], Step):
+        ctheta = self.ChildTheta(theta, name)
+        state0[name] = [
+            c.ZeroState(ctheta[i], prepared_inputs[name][i], batch_size)
+            for i, c in enumerate(child)
+        ]
+    return state0
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    """One step. Returns (output NestedMap, state1 NestedMap).
+
+    step_inputs.inputs is a list of [b, ...] tensors for this timestep;
+    padding is [b] (1.0 = padded).
+    """
+    raise NotImplementedError(type(self).__name__)
+
+
+class StatelessLayerStep(Step):
+  """Wraps any stateless layer as a Step (ref `step.py:168`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("layer", None, "Params of the layer to wrap.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("layer", self.p.layer)
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    del prepared_inputs, padding
+    out = self.layer.FProp(
+        self.ChildTheta(theta, "layer"), *step_inputs.inputs)
+    return NestedMap(output=out), state0
+
+
+class StackStep(Step):
+  """Sequential composition of steps with optional residual connections.
+
+  Output of step i feeds step i+1's inputs. With residuals on
+  (`residual_start >= 0`), for i >= residual_start:
+  `output[i] = sub[i](output[i-1]) + output[i - residual_stride]` where
+  `output[-1]` is the stack's step input (ref `step.py:212-247`). An optional
+  `step_inputs.context` tensor is fed to every layer.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("sub", [], "List of sub-step Params.")
+    p.Define("residual_start", -1,
+             "Index at which residual connections start; <0 disables.")
+    p.Define("residual_stride", 1, "Distance between residual endpoints.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChildren("sub", list(self.p.sub))
+
+  def PrepareExternalInputs(self, theta, external_inputs):
+    external_inputs = external_inputs or NestedMap()
+    ctheta = self.ChildTheta(theta, "sub")
+    return NestedMap(sub=[
+        s.PrepareExternalInputs(ctheta[i], external_inputs)
+        for i, s in enumerate(self.sub)
+    ])
+
+  def ZeroState(self, theta, prepared_inputs, batch_size):
+    ctheta = self.ChildTheta(theta, "sub")
+    return NestedMap(sub=[
+        s.ZeroState(ctheta[i], prepared_inputs.sub[i], batch_size)
+        for i, s in enumerate(self.sub)
+    ])
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    p = self.p
+    ctheta = self.ChildTheta(theta, "sub")
+    inputs = list(step_inputs.inputs)
+    additional = [step_inputs.context] if "context" in step_inputs else []
+    # residual_outputs[j+1] = output of layer j; [0] = the stack's input.
+    residual_outputs = [jnp.concatenate(inputs, axis=-1)
+                        if len(inputs) > 1 else inputs[0]]
+    state1 = NestedMap(sub=[])
+    for i, s in enumerate(self.sub):
+      out, sub_state = s.FProp(ctheta[i], prepared_inputs.sub[i],
+                               NestedMap(inputs=inputs + additional), padding,
+                               state0.sub[i])
+      state1.sub.append(sub_state)
+      output = out.output
+      if p.residual_start >= 0 and i >= p.residual_start:
+        idx = i + 1 - p.residual_stride
+        if idx < 0:
+          raise ValueError(
+              f"residual connection at layer {i} would reach before the "
+              f"stack input (residual_stride={p.residual_stride}); set "
+              f"residual_start >= residual_stride - 1")
+        output = output + residual_outputs[idx]
+      residual_outputs.append(output)
+      inputs = [output]
+    return NestedMap(output=inputs[0]), state1
+
+
+class ParallelStep(Step):
+  """Runs several steps on the same input; concatenates outputs on the last
+  dim (ref `step.py:341`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("sub", [], "List of sub-step Params.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChildren("sub", list(self.p.sub))
+
+  def PrepareExternalInputs(self, theta, external_inputs):
+    external_inputs = external_inputs or NestedMap()
+    ctheta = self.ChildTheta(theta, "sub")
+    return NestedMap(sub=[
+        s.PrepareExternalInputs(ctheta[i], external_inputs)
+        for i, s in enumerate(self.sub)
+    ])
+
+  def ZeroState(self, theta, prepared_inputs, batch_size):
+    ctheta = self.ChildTheta(theta, "sub")
+    return NestedMap(sub=[
+        s.ZeroState(ctheta[i], prepared_inputs.sub[i], batch_size)
+        for i, s in enumerate(self.sub)
+    ])
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    ctheta = self.ChildTheta(theta, "sub")
+    outs, state1 = [], NestedMap(sub=[])
+    for i, s in enumerate(self.sub):
+      out, sub_state = s.FProp(ctheta[i], prepared_inputs.sub[i], step_inputs,
+                               padding, state0.sub[i])
+      outs.append(out.output)
+      state1.sub.append(sub_state)
+    return NestedMap(output=jnp.concatenate(outs, axis=-1)), state1
+
+
+class IteratorStep(Step):
+  """Iterates over the time dim of a tensor provided as an external input;
+  state is the time index (ref `step.py:572`)."""
+
+  def PrepareExternalInputs(self, theta, external_inputs):
+    return external_inputs  # .inputs [b, t, ...], .paddings [b, t]
+
+  def ZeroState(self, theta, prepared_inputs, batch_size):
+    del theta, batch_size
+    return NestedMap(t=jnp.zeros((), jnp.int32))
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    del theta, step_inputs, padding
+    t = state0.t
+    out = jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_index_in_dim(x, t, axis=1, keepdims=False),
+        prepared_inputs.inputs)
+    pad = jax.lax.dynamic_index_in_dim(
+        prepared_inputs.paddings, t, axis=1, keepdims=False)
+    return NestedMap(output=out, padding=pad), NestedMap(t=t + 1)
+
+
+class RnnStep(Step):
+  """An RNN cell as a Step (ref `steps/rnn_steps.py:21`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("cell", rnn_cell.LSTMCellSimple.Params(), "The RNN cell.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("cell", self.p.cell)
+
+  def ZeroState(self, theta, prepared_inputs, batch_size):
+    del theta, prepared_inputs
+    return self.cell.InitState(batch_size)
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    del prepared_inputs
+    x = step_inputs.inputs[0]
+    if len(step_inputs.inputs) > 1:
+      x = jnp.concatenate(step_inputs.inputs, axis=-1)
+    state1 = self.cell.FProp(self.ChildTheta(theta, "cell"), state0, x,
+                             padding)
+    return NestedMap(output=self.cell.GetOutput(state1)), state1
+
+
+def RnnStackStep(cell_tpl, num_layers, residual_start=1):
+  """A stack of RnnSteps with residuals (ref `steps/rnn_steps.py:99`)."""
+  subs = []
+  for i in range(num_layers):
+    subs.append(RnnStep.Params().Set(name=f"rnn_{i}", cell=cell_tpl.Copy()))
+  return StackStep.Params().Set(sub=subs, residual_start=residual_start)
+
+
+class AttentionStep(Step):
+  """Per-step attention over a fixed source sequence
+  (ref `steps/attention_steps.py:23`).
+
+  external_inputs: .src [b, t, d], .paddings [b, t] (optionally .context).
+  step_inputs: [query [b, q]]. Output: .context [b, d], .probs [b, t].
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("atten", seq_attention.AdditiveAttention.Params(),
+             "Sequence attention params.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("atten", self.p.atten)
+
+  def PrepareExternalInputs(self, theta, external_inputs):
+    packed = self.atten.PackSource(
+        self.ChildTheta(theta, "atten"), external_inputs.src,
+        external_inputs.paddings)
+    return NestedMap(packed=packed,
+                     src_len=external_inputs.src.shape[1])
+
+  def ZeroState(self, theta, prepared_inputs, batch_size):
+    del theta
+    return NestedMap(
+        atten=self.atten.ZeroAttentionState(batch_size,
+                                            prepared_inputs.src_len))
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    del padding
+    query = step_inputs.inputs[0]
+    context, probs, atten_state = self.atten.ComputeContextVector(
+        self.ChildTheta(theta, "atten"), prepared_inputs.packed, query,
+        state0.atten)
+    return (NestedMap(output=context, context=context, probs=probs),
+            NestedMap(atten=atten_state))
+
+
+class EmbeddingStep(Step):
+  """Per-step embedding lookup (ref `steps/embedding_steps.py:23`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    from lingvo_tpu.core import layers  # local to avoid import cycle
+    p.Define("emb", layers.SimpleEmbeddingLayer.Params(), "Embedding layer.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self.CreateChild("emb", self.p.emb)
+
+  def FProp(self, theta, prepared_inputs, step_inputs, padding, state0):
+    del prepared_inputs, padding
+    out = self.emb.EmbLookup(self.ChildTheta(theta, "emb"),
+                             step_inputs.inputs[0])
+    return NestedMap(output=out), state0
+
+
+def RunOverSequence(step, theta, prepared_inputs, inputs, paddings,
+                    state0=None, extra_step_inputs=None):
+  """Drives a Step over a [b, t, ...] sequence with `jax.lax.scan`.
+
+  The TPU-native replacement for the reference's RecurrentStepWrapper
+  (`step.py:660`): one compiled scan, differentiable, no host loop.
+
+  Returns (outputs NestedMap with leaves [b, t, ...], final state).
+  """
+  b, t = paddings.shape[0], paddings.shape[1]
+  if state0 is None:
+    state0 = step.ZeroState(theta, prepared_inputs, b)
+  xs = NestedMap(
+      inp=jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), inputs),
+      pad=jnp.swapaxes(paddings, 0, 1))
+
+  def _Body(state, xs_t):
+    step_inputs = NestedMap(inputs=list(xs_t.inp) if isinstance(
+        xs_t.inp, (list, tuple)) else [xs_t.inp])
+    if extra_step_inputs:
+      step_inputs.inputs.extend(extra_step_inputs)
+    out, state1 = step.FProp(theta, prepared_inputs, step_inputs, xs_t.pad,
+                             state)
+    return state1, out
+
+  final_state, outs = jax.lax.scan(_Body, state0, xs, length=t)
+  outs = jax.tree_util.tree_map(lambda x: jnp.swapaxes(x, 0, 1), outs)
+  return outs, final_state

@@ -1,0 +1,96 @@
+"""In-loop summaries: scalars/tensors recorded inside the compiled step.
+
+Re-designs `lingvo/core/tpu_summary.py` (scalar/tensor collected by a
+context and hoisted out of `tf.while_loop` via `RewriteLoopContext:99`,
+`merge_all:227`) the JAX way: model code calls `tpu_summary.scalar(...)`
+anywhere inside FProp; a trace-time context collects the (tracer) values and
+the train/eval step returns them as part of its output pytree — under jit
+there is no graph surgery to do, values simply flow out as results. The
+program layer writes them to TensorBoard next to the regular metrics.
+
+Like the reference, which could only merge summaries emitted inside its
+training while-loop, values recorded inside a `lax.scan` body are local to
+that trace: scan-over-layers code must carry them out of the scan itself
+(the same contract as `py_utils.AddAuxLoss`; see `CollectSummaries`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+_STACK_NAME = "tpu_summary"
+
+
+def _SafeName(name: str) -> str:
+  """Summary names travel as NestedMap keys: map '/'/'.'-scoped names (the
+  reference's convention) onto valid identifiers."""
+  safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+  if not safe or safe[0].isdigit():
+    safe = "s_" + safe
+  return safe
+
+
+def Context():
+  """Context collecting summaries emitted by FProp; yields the live dict."""
+  return py_utils.NamedCollectionContext(_STACK_NAME)
+
+
+def enabled() -> bool:
+  return py_utils.NamedCollectionActive(_STACK_NAME)
+
+
+def scalar(name: str, value: Any) -> None:
+  """Records a scalar summary; repeated emissions merge into a mean.
+
+  Matches the reference semantics where one summary name emitted at several
+  points (or several microbatches) produces one merged value
+  (`tpu_summary.py:227` merge_all).
+  """
+  collected = py_utils.NamedCollectionTop(_STACK_NAME)
+  if collected is None:
+    return
+  name = _SafeName(name)
+  v = jnp.asarray(value, jnp.float32)
+  prev = collected.get(name)
+  if prev is None:
+    collected[name] = (v, jnp.asarray(1.0, jnp.float32))
+  else:
+    ps, pc = prev
+    collected[name] = (ps + v, pc + 1.0)
+
+
+def tensor(name: str, value: Any) -> None:
+  """Records a full tensor summary (last emission wins)."""
+  collected = py_utils.NamedCollectionTop(_STACK_NAME)
+  if collected is None:
+    return
+  collected[_SafeName(name)] = (jnp.asarray(value), None)
+
+
+def Merged(collected: dict) -> NestedMap:
+  """Merges a collected dict into {name: value} (means for scalars)."""
+  out = NestedMap()
+  for name, (val, count) in collected.items():
+    out[name] = val if count is None else val / count
+  return out
+
+
+def CollectSummaries(fn):
+  """Wraps a scan/vmap body so its summaries exit via the return value.
+
+  Returns a callable whose result is `(fn(...), summaries NestedMap)`; the
+  caller re-emits each entry with `scalar`/`tensor` AFTER the scan (e.g. on
+  the aggregated carry), keeping tracers inside their trace.
+  """
+
+  def _Wrapped(*args, **kwargs):
+    with Context() as collected:
+      out = fn(*args, **kwargs)
+    return out, Merged(collected)
+
+  return _Wrapped

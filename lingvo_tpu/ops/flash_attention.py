@@ -39,6 +39,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1.0e30
 
+# Per-row stats (running max, denominator, logsumexp, delta) are stored
+# broadcast across a 128-lane minor dim: TPU VMEM/HBM are (8, 128)-tiled and
+# the Mosaic lowering rejects 2D blocks whose minor dims aren't tile-aligned
+# (the round-1 on-hardware failure; same layout as jax's own TPU flash
+# kernel's l/m residuals).
+LANES = 128
+
 
 def _ApplyCausalMask(s, q_start, k_start, block_q: int, block_k: int):
   q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -60,14 +67,14 @@ def _RecomputePandDs(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   k = k_ref[0].astype(jnp.float32)                      # [block_k, h]
   v = v_ref[0].astype(jnp.float32)                      # [block_k, h]
   do = do_ref[0].astype(jnp.float32)                    # [block_q, h]
-  lse = lse_ref[0]                                      # [block_q]
-  delta = delta_ref[0]                                  # [block_q]
+  lse = lse_ref[0][:, :1]                               # [block_q, 1]
+  delta = delta_ref[0][:, :1]                           # [block_q, 1]
   s = (q @ k.T) * sm_scale
   if causal:
     s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
-  p = jnp.exp(s - lse[:, None])                         # [block_q, block_k]
+  p = jnp.exp(s - lse)                                  # [block_q, block_k]
   dp = do @ v.T                                         # [block_q, block_k]
-  ds = p * (dp - delta[:, None]) * sm_scale
+  ds = p * (dp - delta) * sm_scale
   return q, k, do, p, ds
 
 
@@ -95,15 +102,16 @@ def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
     s = (q @ k.T) * sm_scale                            # [block_q, block_k]
     if causal:
       s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
-    m_prev = m_scr[:]
-    l_prev = l_scr[:]
-    m_cur = jnp.max(s, axis=-1)
+    m_prev = m_scr[:, :1]                               # [block_q, 1]
+    l_prev = l_scr[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new[:, None])
+    p = jnp.exp(s - m_new)
     alpha = jnp.exp(m_prev - m_new)
-    m_scr[:] = m_new
-    l_scr[:] = alpha * l_prev + jnp.sum(p, axis=-1)
-    acc_scr[:] = acc_scr[:] * alpha[:, None] + p @ v
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape)
+    acc_scr[:] = acc_scr[:] * alpha + p @ v
 
   if causal:
     pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
@@ -119,16 +127,15 @@ def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
 
   @pl.when(is_last)
   def _Emit():
-    l = l_scr[:]
-    out_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-20)[:, None]).astype(
-        out_ref.dtype)
-    lse_ref[0] = (m_scr[:] + jnp.log(jnp.maximum(l, 1e-20))).astype(
-        lse_ref.dtype)
+    l = jnp.maximum(l_scr[:, :1], 1e-20)                # [block_q, 1]
+    out_ref[0] = (acc_scr[:] / l).astype(out_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                  lse_ref.shape[1:]).astype(lse_ref.dtype)
 
 
 def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
                   interpret: bool):
-  """q/k/v: [bn, t, h] -> (out [bn, t, h], lse [bn, t])."""
+  """q/k/v: [bn, t, h] -> (out [bn, t, h], lse [bn, t, LANES])."""
   bn, t, h = q.shape
   sm_scale = 1.0 / math.sqrt(h)
   nq, nk = t // block_q, t // block_k
@@ -146,7 +153,7 @@ def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
       kernel,
       out_shape=[
           jax.ShapeDtypeStruct((bn, t, h), q.dtype),
-          jax.ShapeDtypeStruct((bn, t), jnp.float32),
+          jax.ShapeDtypeStruct((bn, t, LANES), jnp.float32),
       ],
       grid=(bn, nq, nk),
       in_specs=[
@@ -156,11 +163,11 @@ def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
       ],
       out_specs=[
           pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
-          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+          pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
       ],
       scratch_shapes=[
-          pltpu.VMEM((block_q,), jnp.float32),
-          pltpu.VMEM((block_q,), jnp.float32),
+          pltpu.VMEM((block_q, LANES), jnp.float32),
+          pltpu.VMEM((block_q, LANES), jnp.float32),
           pltpu.VMEM((block_q, h), jnp.float32),
       ],
       interpret=interpret,
@@ -234,8 +241,9 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
   bn, t, h = q.shape
   sm_scale = 1.0 / math.sqrt(h)
   nq, nk = t // block_q, t // block_k
-  delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                  axis=-1)                              # [bn, t]
+  delta = jnp.broadcast_to(
+      jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+              keepdims=True), (bn, t, LANES))           # [bn, t, LANES]
   if causal:
     kv_idx = lambda b, i, j: (
         b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
@@ -247,7 +255,7 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
   else:
     qi_of = lambda j, i: i
   q_idx = lambda b, j, i: (b, qi_of(j, i), 0)
-  row_idx = lambda b, j, i: (b, qi_of(j, i))
+  row_idx = lambda b, j, i: (b, qi_of(j, i), 0)
   dk, dv = pl.pallas_call(
       functools.partial(
           _DkDvKernel, block_q=block_q, block_k=block_k, nq=nq,
@@ -262,8 +270,8 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
           pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # k
           pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # v
           pl.BlockSpec((1, block_q, h), q_idx),                      # do
-          pl.BlockSpec((1, block_q), row_idx),                       # lse
-          pl.BlockSpec((1, block_q), row_idx),                       # delta
+          pl.BlockSpec((1, block_q, LANES), row_idx),                # lse
+          pl.BlockSpec((1, block_q, LANES), row_idx),                # delta
       ],
       out_specs=[
           pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),
@@ -287,8 +295,8 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
           pl.BlockSpec((1, block_k, h), kv_idx),                     # k
           pl.BlockSpec((1, block_k, h), kv_idx),                     # v
           pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # do
-          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),        # lse
-          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),        # delta
+          pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # lse
+          pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # delta
       ],
       out_specs=pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
       scratch_shapes=[pltpu.VMEM((block_q, h), jnp.float32)],

@@ -27,6 +27,21 @@ from lingvo_tpu.core import py_utils
 from lingvo_tpu.core.nested_map import NestedMap
 
 
+def _ScalarSummaryPairs(train_out: NestedMap) -> dict:
+  """In-loop `tpu_summary.scalar` values as accumulable (value, 1.0) pairs.
+
+  Scalars recorded inside FProp (ref tpu_summary.py) ride the same
+  fixed-shape metric accumulators as stats. Non-scalar tensor summaries are
+  skipped: in on_device_loop mode they never leave the scan; in per-step
+  mode a host can read the last step's from train_out.summaries.
+  """
+  out = {}
+  for k, v in train_out.get("summaries", NestedMap()).FlattenItems():
+    if getattr(v, "ndim", None) == 0:
+      out[f"summary_{k}"] = (v, 1.0)
+  return out
+
+
 class BaseProgram:
   """Shared program machinery (ref BaseProgram, program.py:75)."""
 
@@ -206,6 +221,7 @@ class TrainProgram(BaseProgram):
           acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
           stats = NestedMap(
               {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+          stats.update(_ScalarSummaryPairs(out))
           stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats)
           return (state, acc, stats_acc), ()
 
@@ -218,6 +234,8 @@ class TrainProgram(BaseProgram):
         acc0 = zeros(out_shape.metrics)
         stats0 = NestedMap({k: jnp.zeros((2,), jnp.float32)
                             for k, _ in out_shape.stats.FlattenItems()})
+        stats0.update({k: jnp.zeros((2,), jnp.float32)
+                       for k in _ScalarSummaryPairs(out_shape)})
         (state, acc, stats_acc), _ = jax.lax.scan(
             _Body, (state, acc0, stats0), stacked_batches)
         return state, acc, stats_acc
@@ -259,6 +277,7 @@ class TrainProgram(BaseProgram):
           acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
           stats_pairs = NestedMap(
               {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+          stats_pairs.update(_ScalarSummaryPairs(out))
           stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
         # One host sync per loop (ref: one session.run per steps_per_loop);
         # inside the profiler scope so traces capture the device work.

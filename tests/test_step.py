@@ -1,0 +1,163 @@
+"""Step API tests (ref step_test.py / steps/*_test.py coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import layers, rnn_cell, rnn_layers, seq_attention, step
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(7)
+B, T, D, H = 2, 6, 4, 5
+
+
+def _Materialize(p):
+  s = p.Instantiate()
+  s.FinalizePaths()
+  return s, s.InstantiateVariables(KEY)
+
+
+class TestRnnStep:
+
+  def test_matches_frnn(self):
+    """Driving an RnnStep over a sequence == FRNN.FProp on the same weights."""
+    cell_p = rnn_cell.LSTMCellSimple.Params().Set(
+        num_input_nodes=D, num_output_nodes=H, random_seed=3)
+    frnn, frnn_theta = _Materialize(
+        rnn_layers.FRNN.Params().Set(name="frnn", cell=cell_p.Copy()))
+    st, st_theta = _Materialize(
+        step.RnnStep.Params().Set(name="frnn", cell=cell_p.Copy()))
+    # random_seed pins the var init so both copies share weights
+    np.testing.assert_allclose(
+        np.asarray(frnn_theta.cell.wm), np.asarray(st_theta.cell.wm))
+
+    x = jax.random.normal(KEY, (B, T, D))
+    pad = jnp.zeros((B, T))
+    ref_out, _ = frnn.FProp(frnn_theta, x, pad)
+
+    prepared = st.PrepareExternalInputs(st_theta, NestedMap())
+    outs, _ = step.RunOverSequence(st, st_theta, prepared, x, pad)
+    np.testing.assert_allclose(
+        np.asarray(ref_out), np.asarray(outs.output), rtol=1e-5, atol=1e-5)
+
+
+class TestStackStep:
+
+  def test_residual_stack(self):
+    cell_p = rnn_cell.LSTMCellSimple.Params().Set(
+        num_input_nodes=D, num_output_nodes=D)
+    p = step.RnnStackStep(cell_p, num_layers=3, residual_start=1)
+    p.name = "stack"
+    st, theta = _Materialize(p)
+    prepared = st.PrepareExternalInputs(theta, NestedMap())
+    state = st.ZeroState(theta, prepared, B)
+    assert len(state.sub) == 3
+    out, state1 = st.FProp(theta, prepared,
+                           NestedMap(inputs=[jnp.ones((B, D))]),
+                           jnp.zeros((B,)), state)
+    assert out.output.shape == (B, D)
+    # residual changes output vs no-residual stack
+    p2 = step.RnnStackStep(cell_p, num_layers=3, residual_start=-1)
+    p2.name = "stack"
+    st2, theta2 = _Materialize(p2)
+    prepared2 = st2.PrepareExternalInputs(theta2, NestedMap())
+    out2, _ = st2.FProp(theta2, prepared2,
+                        NestedMap(inputs=[jnp.ones((B, D))]),
+                        jnp.zeros((B,)), st2.ZeroState(theta2, prepared2, B))
+    assert not np.allclose(np.asarray(out.output), np.asarray(out2.output))
+
+
+class TestParallelStep:
+
+  def test_concat_outputs(self):
+    cell_p = rnn_cell.GRUCell.Params().Set(
+        num_input_nodes=D, num_output_nodes=H)
+    p = step.ParallelStep.Params().Set(
+        name="par",
+        sub=[step.RnnStep.Params().Set(cell=cell_p.Copy()) for _ in range(2)])
+    st, theta = _Materialize(p)
+    prepared = st.PrepareExternalInputs(theta, NestedMap())
+    state = st.ZeroState(theta, prepared, B)
+    out, _ = st.FProp(theta, prepared, NestedMap(inputs=[jnp.ones((B, D))]),
+                      jnp.zeros((B,)), state)
+    assert out.output.shape == (B, 2 * H)
+
+
+class TestIteratorStep:
+
+  def test_iterates_time_dim(self):
+    st, theta = _Materialize(step.IteratorStep.Params().Set(name="it"))
+    x = jax.random.normal(KEY, (B, T, D))
+    pad = jnp.zeros((B, T))
+    prepared = st.PrepareExternalInputs(
+        theta, NestedMap(inputs=x, paddings=pad))
+    state = st.ZeroState(theta, prepared, B)
+    for t in range(3):
+      out, state = st.FProp(theta, prepared, NestedMap(inputs=[]),
+                            None, state)
+      np.testing.assert_allclose(np.asarray(out.output), np.asarray(x[:, t]))
+
+
+class TestAttentionStep:
+
+  def test_context_over_source(self):
+    atten_p = seq_attention.AdditiveAttention.Params().Set(
+        source_dim=D, query_dim=H, hidden_dim=6)
+    st, theta = _Materialize(
+        step.AttentionStep.Params().Set(name="att", atten=atten_p))
+    src = jax.random.normal(KEY, (B, T, D))
+    pad = jnp.zeros((B, T))
+    prepared = st.PrepareExternalInputs(
+        theta, NestedMap(src=src, paddings=pad))
+    state = st.ZeroState(theta, prepared, B)
+    q = jax.random.normal(KEY, (B, H))
+    out, state1 = st.FProp(theta, prepared, NestedMap(inputs=[q]),
+                           jnp.zeros((B,)), state)
+    assert out.context.shape == (B, D)
+    assert out.probs.shape == (B, T)
+    np.testing.assert_allclose(np.asarray(jnp.sum(out.probs, -1)),
+                               np.ones(B), rtol=1e-5)
+
+
+class TestEmbeddingAndStateless:
+
+  def test_embedding_step(self):
+    emb_p = layers.SimpleEmbeddingLayer.Params().Set(
+        vocab_size=11, embedding_dim=D)
+    st, theta = _Materialize(
+        step.EmbeddingStep.Params().Set(name="emb", emb=emb_p))
+    prepared = st.PrepareExternalInputs(theta, NestedMap())
+    state = st.ZeroState(theta, prepared, B)
+    out, _ = st.FProp(theta, prepared,
+                      NestedMap(inputs=[jnp.array([1, 2])]), None, state)
+    assert out.output.shape == (B, D)
+
+  def test_stateless_layer_step(self):
+    fc = layers.FCLayer.Params().Set(input_dim=D, output_dim=H)
+    st, theta = _Materialize(
+        step.StatelessLayerStep.Params().Set(name="fc", layer=fc))
+    prepared = st.PrepareExternalInputs(theta, NestedMap())
+    out, _ = st.FProp(theta, prepared,
+                      NestedMap(inputs=[jnp.ones((B, D))]), None,
+                      st.ZeroState(theta, prepared, B))
+    assert out.output.shape == (B, H)
+
+
+class TestComposition:
+
+  def test_scan_full_decoder_loop(self):
+    """Embedding -> RNN -> attention composed as steps, run under scan."""
+    cell_p = rnn_cell.LSTMCellSimple.Params().Set(
+        num_input_nodes=D, num_output_nodes=H)
+    stack = step.StackStep.Params().Set(
+        name="dec",
+        sub=[step.RnnStep.Params().Set(cell=cell_p)])
+    st, theta = _Materialize(stack)
+    x = jax.random.normal(KEY, (B, T, D))
+    pad = jnp.zeros((B, T))
+    prepared = st.PrepareExternalInputs(theta, NestedMap())
+    outs, final = jax.jit(
+        lambda th, x, pad: step.RunOverSequence(
+            st, th, prepared, x, pad))(theta, x, pad)
+    assert outs.output.shape == (B, T, H)
+    assert np.all(np.isfinite(np.asarray(outs.output)))
