@@ -1,0 +1,106 @@
+"""Gradient Sign Dropout (GradDrop) for multi-task shared representations.
+
+Re-designs `lingvo/core/graddrop.py` (the NeurIPS-2020 GradDrop algorithm)
+functionally: the reference wraps an identity op with a custom gradient and
+needs `SetLosses` + `tf.gradients` graph surgery to obtain per-loss
+gradients at that point. In JAX the same effect falls out of `custom_vjp`
+on a "split" primitive: `GradDropSplit(x, key, n)` hands each downstream
+task its own copy of the shared tensor, so the backward pass naturally
+receives one cotangent per task and can combine them with sign dropout
+before passing a single gradient to the trunk.
+
+Usage::
+
+  xs = graddrop.GradDropSplit(shared, step_key, len(task_heads), cfg)
+  losses = [head_i(xs[i]) for i ...]   # per-task heads / losses
+  total = sum(losses)                  # backprop as usual
+
+Head weights get ordinary gradients; only d(total)/d(shared) is modified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GradDropConfig:
+  """Static GradDrop knobs (ref `graddrop.py` Params)."""
+
+  keep_prob_function: str = "linear"    # 'linear' | 'sigmoid'
+  keep_prob_function_scale: float = 1.0
+  use_input_sign_only: bool = True
+  keep_gradnorm_constant: bool = True
+  marginalize_batch_dim: bool = True
+  epsilon: float = 1e-7
+  leak_ratios: tuple = ()               # per-task; () = all zeros
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def GradDropSplit(x, key, n: int, cfg: GradDropConfig):
+  """Returns n copies of x whose combined backward grad is sign-dropped."""
+  del key, cfg
+  return (x,) * n
+
+
+def _Fwd(x, key, n, cfg):
+  return (x,) * n, (x, key)
+
+
+def _Bwd(n, cfg, res, gs):
+  x, key = res
+  eps = cfg.epsilon
+  per_loss_grads = [g.astype(jnp.float32) for g in gs]
+
+  # Signal used for sign decisions: grad * input (or input sign only).
+  x32 = x.astype(jnp.float32)
+  if cfg.use_input_sign_only:
+    x_abs = jnp.abs((jnp.abs(x32) <= eps).astype(jnp.float32) + x32)
+    signal = [g * (x32 / x_abs) for g in per_loss_grads]
+  else:
+    signal = [g * x32 for g in per_loss_grads]
+  if cfg.marginalize_batch_dim:
+    signal = [jnp.sum(s, axis=0, keepdims=True) for s in signal]
+
+  sign_pos = [(s > 0.0).astype(jnp.float32) for s in signal]
+  sign_neg = [(s < 0.0).astype(jnp.float32) for s in signal]
+
+  # Purity (eq. 1 of the paper): probability of keeping positive signs.
+  abs_sum = sum(jnp.abs(s) for s in signal)
+  prob_pos = sum(signal) / (2.0 * abs_sum + eps)
+  prob_pos = prob_pos * cfg.keep_prob_function_scale
+  if cfg.keep_prob_function == "sigmoid":
+    # sigmoid'(0) = 0.25, so 4x matches the linear slope at 0
+    prob_pos = jax.nn.sigmoid(4.0 * prob_pos)
+  elif cfg.keep_prob_function == "linear":
+    prob_pos = prob_pos + 0.5
+  else:
+    raise ValueError(cfg.keep_prob_function)
+
+  u = jax.random.uniform(key, prob_pos.shape)
+  choose_pos = (prob_pos >= u).astype(jnp.float32) - 0.5   # +-0.5
+  masks = [((sp - sn) * choose_pos >= 0).astype(jnp.float32)
+           for sp, sn in zip(sign_pos, sign_neg)]
+
+  leaks = cfg.leak_ratios or (0.0,) * n
+  if len(leaks) != n:
+    raise ValueError(
+        f"leak_ratios has {len(leaks)} entries for {n} tasks")
+  transformed = [
+      g * (leak + (1.0 - leak) * mask)
+      for leak, g, mask in zip(leaks, per_loss_grads, masks)
+  ]
+  combined = sum(transformed)
+
+  if cfg.keep_gradnorm_constant:
+    original = sum(per_loss_grads)
+    combined = combined * (jnp.linalg.norm(original) /
+                           (jnp.linalg.norm(combined) + eps))
+  return combined.astype(x.dtype), None
+
+
+GradDropSplit.defvjp(_Fwd, _Bwd)

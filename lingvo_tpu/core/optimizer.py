@@ -482,6 +482,90 @@ class DistributedShampoo(BaseOptimizer):
     return new_params, new_state
 
 
+class EGDD(BaseOptimizer):
+  """Exponentiated Gradient Delta-Delta: momentum with per-weight adaptive
+  gain and a per-tensor adaptive lr scale (ref `egdd.py:29`).
+
+  momentum <- mu * momentum + lr * gain * grad
+  w        <- w - lr_scale * momentum
+  with gain/lr_scale updated by unnormalized exponentiated gradient [KW97]:
+  gain by sign agreement between grad and its EMA (gbar); lr_scale by the
+  inner product of the (normalized) grad and previous momentum.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("momentum", 0.9, "Momentum coefficient (mu).")
+    p.Define("beta", 0.9, "Decay of the gradient EMA (gbar).")
+    p.Define("gain_learning_rate", 0.01, "EG step on per-weight gains.")
+    p.Define("scale_learning_rate", 0.001, "EG step on per-tensor lr scale.")
+    p.Define("initial_gain", 1.0, "Initial per-weight gain.")
+    p.Define("min_gain", 1e-2, "Gain lower clip.")
+    p.Define("max_gain", 1e2, "Gain upper clip.")
+    p.Define("initial_scale", 1.0, "Initial lr scale.")
+    p.Define("min_scale", 1e-1, "lr scale lower clip.")
+    p.Define("max_scale", 1e1, "lr scale upper clip.")
+    p.Define("use_directions", True,
+             "lr-scale update from normalized grad/momentum directions.")
+    p.Define("use_signs", True,
+             "Gain update from sign(grad)*sign(gbar) instead of magnitudes.")
+    return p
+
+  def InitState(self, params):
+    # All slots in f32 regardless of param dtype: the EG exponent math needs
+    # the precision, and a stable state dtype keeps lax.scan carries and
+    # donated buffers happy when params are bf16.
+    p = self.p
+    f32 = lambda x: jnp.zeros(x.shape, jnp.float32)
+    return NestedMap(
+        m=_TreeMap(f32, params),
+        gbar=_TreeMap(f32, params),
+        gain=_TreeMap(
+            lambda x: jnp.full(x.shape, p.initial_gain, jnp.float32), params),
+        lr_scale=_TreeMap(
+            lambda x: jnp.asarray(p.initial_scale, jnp.float32), params))
+
+  def Update(self, state, grads, params, lr, step):
+    p = self.p
+    t = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+
+    def _One(w, g, m, gbar, gain, lr_scale):
+      g = g.astype(jnp.float32)
+      m32 = m.astype(jnp.float32)
+      if p.use_directions:
+        gn = g / (jnp.linalg.norm(g) + 1e-10)
+        mn = m32 / (jnp.linalg.norm(m32) + 1e-10)
+        inner = jnp.sum(gn * mn)
+      else:
+        inner = jnp.sum(g * m32)
+      new_scale = jnp.clip(
+          lr_scale * jnp.exp(p.scale_learning_rate * inner), p.min_scale,
+          p.max_scale)
+      corrected_gbar = gbar / (1.0 - p.beta ** jnp.maximum(t - 1.0, 1.0))
+      if p.use_signs:
+        gain_grad = jnp.sign(g) * jnp.sign(gbar)
+      else:
+        gain_grad = g * corrected_gbar
+      new_gain = jnp.clip(gain * jnp.exp(p.gain_learning_rate * gain_grad),
+                          p.min_gain, p.max_gain)
+      new_m = p.momentum * m32 + lr * new_gain * g
+      new_gbar = p.beta * gbar + (1.0 - p.beta) * g
+      new_w = w - (new_scale * new_m).astype(w.dtype)
+      return new_w, new_m, new_gbar, new_gain, new_scale
+
+    outs = _TreeMap(_One, params, grads, state.m, state.gbar, state.gain,
+                    state.lr_scale)
+    # outs is a tree of 5-tuples at the leaves; split into five trees
+    def _Pick(i):
+      return jax.tree_util.tree_map(
+          lambda tup: tup[i], outs,
+          is_leaf=lambda x: isinstance(x, tuple) and len(x) == 5)
+
+    return _Pick(0), NestedMap(m=_Pick(1), gbar=_Pick(2), gain=_Pick(3),
+                               lr_scale=_Pick(4))
+
+
 class AdaGraft(BaseOptimizer):
   """Grafts one optimizer's step MAGNITUDE onto another's DIRECTION
   (ref `optimizer.py:803` AdaGraft / the adagraft.py paper recipe):

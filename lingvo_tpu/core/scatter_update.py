@@ -1,0 +1,42 @@
+"""Scatter updates (ref `lingvo/core/scatter_update.py`).
+
+The reference toggles between `tf.tensor_scatter_nd_update` and
+`tf.InplaceUpdate` because in-place semantics mattered for TF grappler; in
+JAX `x.at[...]` is already functional AND buffer-donating under jit, so the
+inplace flag is a documented no-op kept for call-site parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def SetInplaceUpdate(inplace_update: bool):
+  """Parity shim (ref `scatter_update.py:26`): XLA decides buffer reuse."""
+  del inplace_update
+  yield
+
+
+def Update(x, i, v, *, inplace_update=None):
+  """Returns x with x[i] = v (ref `scatter_update.py:41`).
+
+  i: int scalar or [n] indices into dim 0; v: matching update slice(s).
+  """
+  del inplace_update
+  return x.at[i].set(v)
+
+
+def Add(x, i, v):
+  """Returns x with x[i] += v."""
+  return x.at[i].add(v)
+
+
+def UpdateSlice(x, start_indices, update):
+  """Dynamic-slice update (lax.dynamic_update_slice wrapper)."""
+  import jax
+  return jax.lax.dynamic_update_slice(x, update.astype(x.dtype),
+                                      tuple(jnp.asarray(s) for s in
+                                            start_indices))

@@ -164,3 +164,71 @@ class ExponentialDecay(BaseSchedule):
     p = self.p
     x = jnp.maximum(jnp.asarray(step, jnp.float32) - p.start_step, 0.0)
     return jnp.maximum(0.5**(x / p.half_life_steps), p.min)
+
+
+class DevBasedSchedule(BaseSchedule):
+  """Anneal-on-plateau: decay the LR multiplier when the dev metric stalls
+  (ref `schedule.py:728` DevBasedSchedule).
+
+  The trigger lives on the HOST: the evaler writes a metric history file
+  (`early_stop.MetricHistory`), and between program runs the trainer calls
+  `UpdateFromHistory(...)`, which applies the reference's algorithm::
+
+    ref_step = max(ref_step, best_step)
+    if last_step - ref_step > window:
+      cur_factor = max(cur_factor * decay, min_factor); ref_step = last_step
+
+  `Value(step)` returns the current multiplier as a trace-time constant —
+  programs watch `HostStateKey()` and re-jit when it changes (rare: a
+  handful of decays per run), which replaces the reference's mutable
+  cur_factor variable without any in-graph file reads.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("history_path", "",
+             "MetricHistory jsonl path (set by the trainer wiring).")
+    p.Define("tolerance", 0.0, "Minimum significant metric improvement.")
+    p.Define("window", 10000, "Steps since best/last decay before decaying.")
+    p.Define("decay", 0.5, "Multiplier decay factor.")
+    p.Define("min_factor", 0.01, "Multiplier floor.")
+    p.Define("minimize", True, "Lower metric is better.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._cur_factor = 1.0
+    self._ref_step = 0
+    self._history_path = self.p.history_path or None
+
+  def SetMetricHistory(self, metric_history) -> None:
+    """Points this schedule at a live early_stop.MetricHistory."""
+    self._history_path = metric_history.path
+
+  def UpdateFromHistory(self) -> bool:
+    """Host-side decay check; returns True if the multiplier changed."""
+    from lingvo_tpu.core import early_stop
+    p = self.p
+    if not self._history_path:
+      return False
+    best_step, last_step = early_stop.BestStep(
+        self._history_path, p.tolerance, p.minimize)
+    if last_step == 0:
+      return False
+    self._ref_step = max(self._ref_step, best_step)
+    if last_step - self._ref_step > p.window:
+      new_factor = max(self._cur_factor * p.decay, p.min_factor)
+      changed = new_factor != self._cur_factor
+      self._cur_factor = new_factor
+      self._ref_step = last_step
+      return changed
+    return False
+
+  def HostStateKey(self):
+    """Changes whenever jitted consumers must re-trace."""
+    return self._cur_factor
+
+  def Value(self, step):
+    del step
+    return jnp.asarray(self._cur_factor, jnp.float32)

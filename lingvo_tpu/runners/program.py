@@ -243,9 +243,30 @@ class TrainProgram(BaseProgram):
       self._loop_fn = jax.jit(_Loop, donate_argnums=(0,))
     return self._loop_fn
 
+  def _RefreshHostSchedules(self) -> None:
+    """Host-driven schedules (DevBasedSchedule anneal-on-plateau) may change
+    between runs; their values are trace-time constants, so a change must
+    drop the cached jitted functions (rare — a few decays per run)."""
+    key = []
+    for lrn in getattr(self._task, "learners", []):
+      sched = getattr(lrn, "lr_sched", None)
+      if sched is None:
+        continue
+      if hasattr(sched, "UpdateFromHistory"):
+        sched.UpdateFromHistory()
+      if hasattr(sched, "HostStateKey"):
+        key.append(sched.HostStateKey())
+    key = tuple(key)
+    if key != getattr(self, "_host_sched_key", None):
+      if getattr(self, "_host_sched_key", None) is not None:
+        self._loop_fn = None
+        self._step_fn = None
+      self._host_sched_key = key
+
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
     p = self.p
     t0 = time.time()
+    self._RefreshHostSchedules()
     if p.on_device_loop:
       # host: prefetch + stack steps_per_loop batches; device: one program
       batches = [self.input_generator.GetPreprocessedInputBatch()

@@ -1,0 +1,227 @@
+"""EGDD, GradDrop, gradient combiners, DevBasedSchedule, scatter_update."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import (graddrop, gradient_combiner, optimizer,
+                             scatter_update, schedule)
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(11)
+
+
+class TestEGDD:
+
+  def _opt(self):
+    return optimizer.EGDD.Params().Set(name="egdd").Instantiate()
+
+  def test_reduces_quadratic_loss(self):
+    opt = self._opt()
+    params = NestedMap(w=jnp.array([2.0, -3.0, 1.0]))
+    state = opt.InitState(params)
+
+    def loss(p):
+      return jnp.sum(p.w ** 2)
+
+    l0 = float(loss(params))
+    for step in range(30):
+      grads = jax.grad(loss)(params)
+      params, state = opt.Update(state, grads, params, 0.05, step)
+    assert float(loss(params)) < 0.2 * l0
+
+  def test_bf16_params_scan_stable_state(self):
+    """Optimizer state dtypes must be stable across steps (lax.scan carry)."""
+    opt = self._opt()
+    params = NestedMap(w=jnp.ones((4,), jnp.bfloat16))
+    state0 = opt.InitState(params)
+
+    def body(carry, _):
+      params, state = carry
+      grads = NestedMap(w=jnp.full((4,), 0.1, jnp.bfloat16))
+      params, state = opt.Update(state, grads, params, 0.01, 0)
+      return (params, state), ()
+
+    (params, _), _ = jax.lax.scan(body, (params, state0), None, length=3)
+    assert params.w.dtype == jnp.bfloat16
+
+  def test_gain_and_scale_clipped(self):
+    opt = self._opt()
+    params = NestedMap(w=jnp.ones((4,)))
+    state = opt.InitState(params)
+    for step in range(50):
+      grads = NestedMap(w=jnp.full((4,), 100.0))  # consistent huge grads
+      params, state = opt.Update(state, grads, params, 0.01, step)
+    assert float(jnp.max(state.gain.w)) <= opt.p.max_gain + 1e-6
+    assert float(state.lr_scale.w) <= opt.p.max_scale + 1e-6
+
+
+class TestGradDrop:
+
+  def test_forward_is_identity(self):
+    x = jax.random.normal(KEY, (4, 8))
+    cfg = graddrop.GradDropConfig()
+    xs = graddrop.GradDropSplit(x, KEY, 3, cfg)
+    assert len(xs) == 3
+    for xi in xs:
+      np.testing.assert_allclose(np.asarray(xi), np.asarray(x))
+
+  def test_agreeing_grads_pass_through_norm_preserved(self):
+    """Two identical losses: no sign conflicts, combined grad keeps the
+    original direction and norm."""
+    x = jax.random.normal(KEY, (4, 8))
+    cfg = graddrop.GradDropConfig()
+
+    def total(x):
+      a, b = graddrop.GradDropSplit(x, KEY, 2, cfg)
+      return jnp.sum(a * 2.0) + jnp.sum(b * 2.0)
+
+    g = jax.grad(total)(x)
+    g_ref = jax.grad(lambda x: jnp.sum(x * 4.0))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-5)
+
+  def test_conflicting_grads_are_sign_dropped(self):
+    """Opposite-sign per-task grads: each element keeps only one task's
+    contribution (up to the gradnorm rescale), never the zero sum."""
+    x = jnp.ones((2, 4))
+    cfg = graddrop.GradDropConfig(keep_gradnorm_constant=False,
+                                  marginalize_batch_dim=False,
+                                  use_input_sign_only=True)
+
+    def total(x):
+      a, b = graddrop.GradDropSplit(x, KEY, 2, cfg)
+      return jnp.sum(a) - jnp.sum(b)  # grads +1 and -1 everywhere
+
+    g = np.asarray(jax.grad(total)(x))
+    # plain backprop would give exactly 0; GradDrop picks a sign per element
+    assert np.all(np.abs(g) == 1.0), g
+
+  def test_leak_passes_original(self):
+    x = jnp.ones((2, 4))
+    cfg = graddrop.GradDropConfig(leak_ratios=(1.0, 1.0),
+                                  keep_gradnorm_constant=False)
+
+    def total(x):
+      a, b = graddrop.GradDropSplit(x, KEY, 2, cfg)
+      return jnp.sum(a) - jnp.sum(b)
+
+    g = np.asarray(jax.grad(total)(x))
+    np.testing.assert_allclose(g, 0.0)  # full leak = plain sum = 0
+
+
+class TestGradientCombiners:
+
+  def _lg(self, gdicts):
+    out = {}
+    for name, g in gdicts.items():
+      out[name] = NestedMap(loss_metric=(jnp.asarray(1.0), 1.0),
+                            grads=NestedMap(w=jnp.asarray(g)))
+    return out
+
+  def test_linear(self):
+    comb = gradient_combiner.LinearCombiner.Params().Instantiate()
+    vmap = NestedMap(w=jnp.zeros(2))
+    out = comb.Combine(vmap, self._lg({"a": [1.0, 0.0], "b": [0.0, 2.0]}))
+    np.testing.assert_allclose(np.asarray(out.w), [1.0, 2.0])
+
+  def test_pcgrad_projects_conflict(self):
+    comb = gradient_combiner.PCGradCombiner.Params().Instantiate()
+    vmap = NestedMap(w=jnp.zeros(2))
+    # g_a = (1, 0); g_b = (-1, 1): conflicting (<g_a, g_b> = -1)
+    out = comb.Combine(vmap, self._lg({"a": [1.0, 0.0], "b": [-1.0, 1.0]}))
+    # PCGrad: a' = a - (a.b/|b|^2) b = (0.5, 0.5); b' = b - (b.a/|a|^2) a
+    # = (0, 1); sum = (0.5, 1.5)
+    np.testing.assert_allclose(np.asarray(out.w), [0.5, 1.5], rtol=1e-5)
+
+  def test_pcgrad_no_conflict_is_sum(self):
+    comb = gradient_combiner.PCGradCombiner.Params().Instantiate()
+    vmap = NestedMap(w=jnp.zeros(2))
+    out = comb.Combine(vmap, self._lg({"a": [1.0, 0.0], "b": [0.0, 1.0]}))
+    np.testing.assert_allclose(np.asarray(out.w), [1.0, 1.0], rtol=1e-5)
+
+
+class TestDevBasedSchedule:
+
+  def test_decays_on_plateau(self, tmp_path):
+    from lingvo_tpu.core import early_stop
+    mh = early_stop.MetricHistory(str(tmp_path), "eval", "loss")
+    sched = schedule.DevBasedSchedule.Params().Set(
+        window=100, decay=0.5, min_factor=0.1).Instantiate()
+    sched.SetMetricHistory(mh)
+
+    mh.ConditionalAppend(10, 1.0)   # best at step 10
+    mh.ConditionalAppend(50, 1.2)
+    assert not sched.UpdateFromHistory()      # 50 - 10 < window
+    assert float(sched.Value(0)) == 1.0
+
+    mh.ConditionalAppend(200, 1.3)            # 200 - 10 > window -> decay
+    assert sched.UpdateFromHistory()
+    assert float(sched.Value(0)) == 0.5
+    assert sched.HostStateKey() == 0.5
+
+    mh.ConditionalAppend(250, 1.4)            # ref_step moved to 200
+    assert not sched.UpdateFromHistory()
+    mh.ConditionalAppend(350, 1.5)
+    assert sched.UpdateFromHistory()
+    assert float(sched.Value(0)) == 0.25
+
+  def test_floor(self, tmp_path):
+    from lingvo_tpu.core import early_stop
+    mh = early_stop.MetricHistory(str(tmp_path), "eval", "loss")
+    sched = schedule.DevBasedSchedule.Params().Set(
+        window=10, decay=0.1, min_factor=0.3).Instantiate()
+    sched.SetMetricHistory(mh)
+    mh.ConditionalAppend(1, 1.0)
+    step = 1
+    for _ in range(5):
+      step += 100
+      mh.ConditionalAppend(step, 2.0)
+      sched.UpdateFromHistory()
+    assert abs(float(sched.Value(0)) - 0.3) < 1e-6
+
+  def test_program_refresh_drops_cached_fn(self, tmp_path):
+    """A multiplier change must invalidate TrainProgram's jitted loop."""
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    from lingvo_tpu.core import early_stop
+    from lingvo_tpu.runners import program as program_lib
+
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    mh = early_stop.MetricHistory(str(tmp_path), "eval", "loss")
+    mp.task.train.learner.lr_schedule = (
+        schedule.DevBasedSchedule.Params().Set(window=10, decay=0.5,
+                                               history_path=mh.path))
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    tp = program_lib.TrainProgram.Params().Set(
+        task=mp.task, logdir=str(tmp_path), steps_per_loop=2,
+        on_device_loop=False)
+    prog = program_lib.TrainProgram(tp, task=task,
+                                    input_generator=mp.input.Instantiate())
+    state, _ = prog.Run(state)
+    fn1 = prog._step_fn
+    assert fn1 is not None
+    state, _ = prog.Run(state)
+    assert prog._step_fn is fn1          # unchanged -> cache kept
+    mh.ConditionalAppend(1, 1.0)
+    mh.ConditionalAppend(100, 2.0)       # plateau > window -> decay
+    state, _ = prog.Run(state)
+    assert prog._step_fn is not fn1      # cache dropped and rebuilt
+
+
+class TestScatterUpdate:
+
+  def test_update_and_add(self):
+    x = jnp.zeros((4, 3))
+    y = scatter_update.Update(x, 2, jnp.ones((3,)))
+    assert float(y[2, 0]) == 1.0 and float(y[0, 0]) == 0.0
+    z = scatter_update.Add(y, 2, jnp.ones((3,)))
+    assert float(z[2, 1]) == 2.0
+
+  def test_inplace_context_noop(self):
+    with scatter_update.SetInplaceUpdate(True):
+      x = scatter_update.Update(jnp.zeros((2,)), 0, 5.0)
+    assert float(x[0]) == 5.0
