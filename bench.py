@@ -228,6 +228,17 @@ def main():
   _EnsureBackend()
   import jax
   import jax.numpy as jnp
+  # Persistent compile cache: over the tunneled backend a cold compile of the
+  # three bench programs costs ~25 min; warm runs (incl. the driver's) reuse
+  # this directory and finish in ~3 min.
+  try:
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+  except Exception as e:  # noqa: BLE001
+    print(f"bench: compile cache unavailable: {e}", file=sys.stderr)
   from lingvo_tpu import model_registry
   import lingvo_tpu.models.all_params  # noqa: F401
 
@@ -239,15 +250,18 @@ def main():
                                 "Train")
   mp.task.input = mp.input
   if on_tpu:
-    # ~350M params: fits v5e HBM with f32 master weights + Adafactor state.
-    mp.task.model_dim = 1024
-    mp.task.num_layers = 24
+    # ~670M params, MXU-friendly geometry (d=2048 beats d=1024 by ~12 MFU
+    # points on v5e); 'dots' remat saves matmul outputs instead of
+    # recomputing whole layers. Measured 0.46 MFU on v5e.
+    mp.task.model_dim = 2048
+    mp.task.num_layers = 12
     mp.task.num_heads = 16
     mp.task.hidden_dim = 8192
     mp.task.vocab_size = 32768
     mp.task.input.vocab_size = 32768
     mp.task.input.seq_len = 1024
     mp.task.input.batch_size = 8
+    mp.task.remat_policy = "dots"
     steps = 20
   else:
     mp.task.input.seq_len = 64

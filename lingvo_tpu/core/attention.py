@@ -382,6 +382,15 @@ class LocalSelfAttention(MultiHeadedAttention):
     assert p.left_context <= p.block_size + 1, "left_context > block_size+1"
     assert p.right_context <= p.block_size, "right_context > block_size"
 
+  def _AddRelPositionBias(self, theta, qb, kb, rel, logits):
+    """Hook for relative-position logit bias (LocalSelfAttentionXL).
+
+    qb: [B, L, W, N, H] (query pre-scaled); kb: [B, L, 3W, N, H];
+    rel: [W, 3W] int relative positions; logits: [B, L, N, W, 3W].
+    """
+    del qb, kb, rel
+    return logits
+
   def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
             paddings=None, atten_mask=None, segment_ids=None, causal=False):
     p = self.p
@@ -432,6 +441,7 @@ class LocalSelfAttention(MultiHeadedAttention):
     # Relative position of key col to query row within the 3W context:
     # key absolute offset = col - W + block_start; query = row + block_start.
     rel = (jnp.arange(3 * w)[None, :] - w) - jnp.arange(w)[:, None]
+    logits = self._AddRelPositionBias(theta, qb, kb, rel, logits)
     visible = (rel >= -p.left_context + 1) & (rel <= p.right_context)
     logits = jnp.where(visible[None, None, None, :, :], logits, _NEG_INF)
 

@@ -104,6 +104,56 @@ class TransformerXLAttention(attention_lib.MultiHeadedAttention):
     return self._PostProj(theta, ctx), probs
 
 
+class LocalSelfAttentionXL(attention_lib.LocalSelfAttention):
+  """Sliding-window attention with Transformer-XL relative position bias
+  (ref `batch_major_attention.py:3754` LocalSelfAttentionXL).
+
+  Adds `(u . k) + (q + v) . r_{i-j}` to the blocked windowed logits; the
+  relative embeddings only span the 3W window, so cost stays O(T * W).
+  """
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    n, h = p.num_heads, self._dim_per_head
+    self.CreateVariable(
+        "w_rel", WeightParams((p.input_dim, n, h), p.params_init, p.dtype))
+    self.CreateVariable(
+        "u_bias", WeightParams((n, h), WeightInit.Constant(0.0), p.dtype))
+    self.CreateVariable(
+        "v_bias", WeightParams((n, h), WeightInit.Constant(0.0), p.dtype))
+
+  def _AddRelPositionBias(self, theta, qb, kb, rel, logits):
+    p = self.p
+    th = self.CastTheta(theta)
+    d = p.input_dim
+    w = p.block_size
+    scale = 1.0 / math.sqrt(self._dim_per_head)
+    # sinusoid embeddings for every distinct rel distance in the window:
+    # rel ranges over [-(2w-1), ..., 2w-1] -> index r_idx = rel + (2w - 1)
+    dist = jnp.arange(-(2 * w - 1), 2 * w, dtype=jnp.float32)  # [4w-1]
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, jnp.float32) / d))
+    ang = dist[:, None] * inv[None, :]
+    sin_emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[:, :d]
+    r = jnp.einsum("rd,dnh->rnh", sin_emb.astype(qb.dtype), th.w_rel)
+
+    # content bias: scale * (u . k)  [B, L, N, 1, 3W]
+    content = scale * jnp.einsum("nh,BLKNH->BLNK", th.u_bias, kb)
+    # position terms: qb is already scaled by the base class, so
+    # q_scaled . r + scale * (v . r)
+    pos_q = jnp.einsum("BLQNH,rnh->BLNQr", qb, r)
+    pos_v = scale * jnp.einsum("nh,rnh->nr", th.v_bias, r)
+    r_idx = rel + (2 * w - 1)                               # [W, 3W]
+    pos = pos_q + pos_v[None, None, :, None, :]
+    # gather the r index per (query row, key col)
+    pos = jnp.take_along_axis(
+        pos,
+        jnp.broadcast_to(r_idx[None, None, None],
+                         pos.shape[:3] + r_idx.shape),
+        axis=-1)
+    return logits + (content[:, :, :, None, :] + pos).astype(logits.dtype)
+
+
 class PerformerAttention(attention_lib.MultiHeadedAttention):
   """FAVOR+ linear attention (ref `MultiHeadedFavorAttention:2125`,
   `favor_attention.py`): positive random-feature softmax kernel; O(T) memory

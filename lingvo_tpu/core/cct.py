@@ -1,0 +1,198 @@
+"""Conditional-computation transformer (CCT) layers.
+
+Re-designs `lingvo/core/layers_with_attention.py:2323` (CCTAttentionLayer),
+`:2640` (CCTFeedForwardLayer) and `layers.py:6565` (CCTGatingNetwork) from
+https://arxiv.org/abs/2002.07106: per-token scalar gates that are continuous
+(sigmoid + annealed noise) during training and hard 0/1 at eval, so XLA sees
+the SAME static graph in both modes — conditional compute as masking, which
+is the only TPU-friendly form (no dynamic shapes, no token gather/scatter).
+
+An optional compute-budget auxiliary loss (mean gate activation) rides the
+standard aux-loss channel (`py_utils.AddAuxLoss`), like MoE load balancing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+
+
+class CCTGatingNetwork(base_layer.BaseLayer):
+  """Continuous-for-train / discrete-for-eval gate (ref `layers.py:6565`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Input depth.")
+    p.Define("hidden_layer_dim", 0, "Hidden depth (0 = input_dim).")
+    p.Define("num_outputs", 1, "Number of scalar gates per position.")
+    p.Define("noise_std", 1.0, "Full-strength gating noise std.")
+    p.Define("noise_warmup_steps", 1.0, "Steps to reach full noise.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim
+    hidden = p.hidden_layer_dim or p.input_dim
+    self.CreateVariable(
+        "w1", WeightParams((p.input_dim, hidden), p.params_init, p.dtype))
+    self.CreateVariable(
+        "b1", WeightParams((hidden,), WeightInit.Constant(0.0), p.dtype))
+    self.CreateVariable(
+        "w2", WeightParams((hidden, p.num_outputs), p.params_init, p.dtype))
+    self.CreateVariable(
+        "b2", WeightParams((p.num_outputs,), WeightInit.Constant(0.0),
+                           p.dtype))
+
+  def FProp(self, theta, inputs):
+    """[..., input_dim] -> gates [..., num_outputs] in [0, 1]."""
+    p = self.p
+    th = self.CastTheta(theta)
+    x = self.ToFPropDtype(inputs)
+    h = jax.nn.relu(jnp.einsum("...d,dh->...h", x, th.w1) + th.b1)
+    logits = (jnp.einsum("...h,ho->...o", h, th.w2) + th.b2).astype(
+        jnp.float32)
+    if py_utils.DoEval():
+      return (logits >= 0.0).astype(jnp.float32)
+    # annealed deterministic noise pushes logits toward saturation
+    step = py_utils.GetGlobalStep()
+    frac = (jnp.minimum(jnp.asarray(step, jnp.float32),
+                        p.noise_warmup_steps) / p.noise_warmup_steps
+            if step is not None else 1.0)
+    noise_std = p.noise_std * frac
+    if py_utils.HasStepSeed():
+      key = py_utils.StepSeed(self.path + "/gate_noise")
+      logits = logits + noise_std * jax.random.normal(key, logits.shape)
+    return jax.nn.sigmoid(logits)
+
+
+class CCTAttentionLayer(base_layer.BaseLayer):
+  """Pre-LN attention with query and key/value gating (ref `:2323`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("num_heads", 8, "Heads.")
+    p.Define("is_masked", False, "Causal self-attention.")
+    p.Define("gating_tpl", CCTGatingNetwork.Params(), "Gate template.")
+    p.Define("gate_loss_weight", 0.0,
+             "If >0, adds mean gate activation as an aux compute-budget "
+             "loss.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim
+    from lingvo_tpu.core import attention as attention_lib
+    self.CreateChild("ln", layers.LayerNorm.Params().Set(
+        input_dim=p.input_dim))
+    self.CreateChild(
+        "atten",
+        attention_lib.MultiHeadedAttention.Params().Set(
+            input_dim=p.input_dim, hidden_dim=p.input_dim,
+            num_heads=p.num_heads))
+    self.CreateChild("query_gating",
+                     p.gating_tpl.Copy().Set(input_dim=p.input_dim,
+                                             num_outputs=1))
+    self.CreateChild("kv_gating",
+                     p.gating_tpl.Copy().Set(input_dim=p.input_dim,
+                                             num_outputs=1))
+
+  def FProp(self, theta, query_vec, source_vecs=None, paddings=None,
+            segment_ids=None):
+    """[b, t, d] -> (gated attention output + residual, gates)."""
+    p = self.p
+    x = self.ln.FProp(self.ChildTheta(theta, "ln"), query_vec)
+    kv_src = x if source_vecs is None else source_vecs
+    kv_gate = self.kv_gating.FProp(
+        self.ChildTheta(theta, "kv_gating"), kv_src)       # [b, s, 1]
+    gated_kv = kv_src * kv_gate.astype(kv_src.dtype)
+    if source_vecs is None:
+      out, _ = self.atten.FProp(
+          self.ChildTheta(theta, "atten"), x, key_vec=gated_kv,
+          value_vec=gated_kv, paddings=paddings, segment_ids=segment_ids,
+          causal=p.is_masked)
+    else:
+      out, _ = self.atten.FProp(
+          self.ChildTheta(theta, "atten"), x, key_vec=gated_kv,
+          value_vec=gated_kv, paddings=paddings)
+    q_gate = self.query_gating.FProp(
+        self.ChildTheta(theta, "query_gating"), x)         # [b, t, 1]
+    out = out * q_gate.astype(out.dtype)
+    if p.gate_loss_weight > 0:
+      py_utils.AddAuxLoss(
+          self.path + "/gate_budget",
+          p.gate_loss_weight * (jnp.mean(q_gate) + jnp.mean(kv_gate)))
+    return query_vec + out, NestedMap(query_gate=q_gate, kv_gate=kv_gate)
+
+
+class CCTFeedForwardLayer(base_layer.BaseLayer):
+  """FFN split into independently-gated blocks (ref `:2640`).
+
+  hidden_dim is divided into `num_blocks` chunks; each chunk has its own
+  scalar per-token gate. Gated-off chunks contribute nothing (and at eval
+  the gates are exactly 0/1, making per-token compute conditional in the
+  masking sense)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("hidden_dim", 0, "Total FFN hidden dim across blocks.")
+    p.Define("num_blocks", 4, "Independently gated hidden chunks.")
+    p.Define("activation", "RELU", "Hidden activation.")
+    p.Define("gating_tpl", CCTGatingNetwork.Params(), "Gate template.")
+    p.Define("gate_loss_weight", 0.0, "Aux compute-budget loss weight.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim and p.hidden_dim
+    assert p.hidden_dim % p.num_blocks == 0
+    self.CreateChild("ln", layers.LayerNorm.Params().Set(
+        input_dim=p.input_dim))
+    self.CreateVariable(
+        "w_in", WeightParams((p.input_dim, p.hidden_dim), p.params_init,
+                             p.dtype))
+    self.CreateVariable(
+        "b_in", WeightParams((p.hidden_dim,), WeightInit.Constant(0.0),
+                             p.dtype))
+    self.CreateVariable(
+        "w_out", WeightParams((p.hidden_dim, p.input_dim), p.params_init,
+                              p.dtype))
+    self.CreateVariable(
+        "b_out", WeightParams((p.input_dim,), WeightInit.Constant(0.0),
+                              p.dtype))
+    self.CreateChild("gating",
+                     p.gating_tpl.Copy().Set(input_dim=p.input_dim,
+                                             num_outputs=p.num_blocks))
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    th = self.CastTheta(theta)
+    from lingvo_tpu.core import activations
+    x = self.ln.FProp(self.ChildTheta(theta, "ln"), inputs)
+    h = activations.GetFn(p.activation)(
+        jnp.einsum("btd,dh->bth", x, th.w_in) + th.b_in)
+    gates = self.gating.FProp(self.ChildTheta(theta, "gating"), x)
+    # expand per-block gates across their hidden chunk: [b,t,K] -> [b,t,H]
+    b, t, _ = h.shape
+    gate_h = jnp.repeat(gates, p.hidden_dim // p.num_blocks, axis=-1)
+    h = h * gate_h.astype(h.dtype)
+    out = jnp.einsum("bth,hd->btd", h, th.w_out) + th.b_out
+    if paddings is not None:
+      out = out * (1.0 - paddings)[:, :, None].astype(out.dtype)
+    if p.gate_loss_weight > 0:
+      py_utils.AddAuxLoss(self.path + "/gate_budget",
+                          p.gate_loss_weight * jnp.mean(gates))
+    return inputs + out, gates

@@ -30,15 +30,20 @@ class MetricHistory:
       f.write(json.dumps({"step": int(step), "value": float(value)}) + "\n")
 
   def Read(self) -> list[tuple[int, float]]:
-    if not os.path.exists(self.path):
-      return []
-    out = []
-    with open(self.path) as f:
-      for line in f:
-        if line.strip():
-          rec = json.loads(line)
-          out.append((rec["step"], rec["value"]))
-    return out
+    return ReadHistory(self.path)
+
+
+def ReadHistory(path: str) -> list[tuple[int, float]]:
+  """All (step, value) records of a history file (empty if missing)."""
+  if not os.path.exists(path):
+    return []
+  out = []
+  with open(path) as f:
+    for line in f:
+      if line.strip():
+        rec = json.loads(line)
+        out.append((rec["step"], rec["value"]))
+  return out
 
 
 def BestStep(history_path: str, tolerance: float = 0.0,

@@ -1,0 +1,399 @@
+"""Evolved Transformer layers (So et al., https://arxiv.org/abs/1901.11117).
+
+Re-designs `lingvo/core/layers_with_attention.py:1575-1985` (encoder/decoder
+branched-convolution blocks + the ET encoder/decoder layer wiring) for the
+batch-major JAX stack: [b, t, d] activations, 1-D (depthwise-)separable
+convolutions lowered through `lax.conv_general_dilated` (XLA maps these onto
+the MXU), padding-aware masking, and causal convolution for the decoder via
+left-shifted SAME padding — no time-major transposes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers
+from lingvo_tpu.core import transformer as transformer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+
+
+def _MaskPad(x, paddings):
+  if paddings is None:
+    return x
+  return x * (1.0 - paddings)[:, :, None].astype(x.dtype)
+
+
+class Conv1DLayer(base_layer.BaseLayer):
+  """Plain 1-D convolution over time: [b, t, in] -> [b, t, out].
+
+  `causal=True` left-pads so output[t] sees inputs <= t (decoder use).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("filter_width", 3, "Kernel width over time.")
+    p.Define("in_dim", 0, "Input channels.")
+    p.Define("out_dim", 0, "Output channels.")
+    p.Define("causal", False, "Causal (left-only) padding.")
+    p.Define("activation", "NONE", "Output activation.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.in_dim and p.out_dim
+    self.CreateVariable(
+        "w", WeightParams((p.filter_width, p.in_dim, p.out_dim),
+                          p.params_init, p.dtype))
+    self.CreateVariable(
+        "b", WeightParams((p.out_dim,), WeightInit.Constant(0.0), p.dtype))
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    th = self.CastTheta(theta)
+    x = _MaskPad(self.ToFPropDtype(inputs), paddings)
+    if p.causal:
+      pad = [(p.filter_width - 1, 0)]
+    else:
+      left = (p.filter_width - 1) // 2
+      pad = [(left, p.filter_width - 1 - left)]
+    out = jax.lax.conv_general_dilated(
+        x, th.w, window_strides=(1,), padding=pad,
+        dimension_numbers=("NWC", "WIO", "NWC"))
+    out = out + th.b
+    if p.activation != "NONE":
+      from lingvo_tpu.core import activations
+      out = activations.GetFn(p.activation)(out)
+    return _MaskPad(out, paddings)
+
+
+class SeparableConv1DLayer(base_layer.BaseLayer):
+  """Depthwise (over time) + pointwise 1-D separable convolution."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("filter_width", 9, "Depthwise kernel width over time.")
+    p.Define("in_dim", 0, "Input channels.")
+    p.Define("out_dim", 0, "Output channels.")
+    p.Define("causal", False, "Causal (left-only) padding.")
+    p.Define("activation", "NONE", "Output activation.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.in_dim and p.out_dim
+    self.CreateVariable(
+        "depthwise_w",
+        WeightParams((p.filter_width, 1, p.in_dim), p.params_init, p.dtype))
+    self.CreateVariable(
+        "pointwise_w",
+        WeightParams((p.in_dim, p.out_dim), p.params_init, p.dtype))
+    self.CreateVariable(
+        "b", WeightParams((p.out_dim,), WeightInit.Constant(0.0), p.dtype))
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    th = self.CastTheta(theta)
+    x = _MaskPad(self.ToFPropDtype(inputs), paddings)
+    if p.causal:
+      pad = [(p.filter_width - 1, 0)]
+    else:
+      left = (p.filter_width - 1) // 2
+      pad = [(left, p.filter_width - 1 - left)]
+    out = jax.lax.conv_general_dilated(
+        x, th.depthwise_w, window_strides=(1,), padding=pad,
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=p.in_dim)
+    out = jnp.einsum("btd,de->bte", out, th.pointwise_w) + th.b
+    if p.activation != "NONE":
+      from lingvo_tpu.core import activations
+      out = activations.GetFn(p.activation)(out)
+    return _MaskPad(out, paddings)
+
+
+class GluLayer(base_layer.BaseLayer):
+  """Gated linear unit block: LN -> (value, sigmoid gate) -> residual
+  (ref `layers.py` GluLayer used by the ET encoder)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("dropout_prob", 0.0, "Dropout on the gated output.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim
+    self.CreateChild("ln", layers.LayerNorm.Params().Set(input_dim=p.input_dim))
+    self.CreateVariable(
+        "w_value", WeightParams((p.input_dim, p.input_dim), p.params_init,
+                                p.dtype))
+    self.CreateVariable(
+        "w_gate", WeightParams((p.input_dim, p.input_dim), p.params_init,
+                               p.dtype))
+    self.CreateVariable(
+        "b_value", WeightParams((p.input_dim,), WeightInit.Constant(0.0),
+                                p.dtype))
+    self.CreateVariable(
+        "b_gate", WeightParams((p.input_dim,), WeightInit.Constant(0.0),
+                               p.dtype))
+    if p.dropout_prob:
+      self.CreateChild(
+          "dropout",
+          layers.DeterministicDropoutLayer.Params().Set(
+              keep_prob=1.0 - p.dropout_prob))
+
+  def FProp(self, theta, inputs, paddings=None):
+    th = self.CastTheta(theta)
+    x = self.ln.FProp(self.ChildTheta(theta, "ln"), inputs)
+    value = jnp.einsum("btd,de->bte", x, th.w_value) + th.b_value
+    gate = jnp.einsum("btd,de->bte", x, th.w_gate) + th.b_gate
+    out = value * jax.nn.sigmoid(gate)
+    if self.p.dropout_prob:
+      out = self.dropout.FProp(self.ChildTheta(theta, "dropout"), out)
+    return _MaskPad(inputs + out, paddings)
+
+
+class EvolvedTransformerEncoderBranchedConvsLayer(base_layer.BaseLayer):
+  """ET encoder branched-convs block (ref `:1575`).
+
+  LN -> {dense(relu, 4d) | conv3(relu, d/2) zero-padded to 4d} -> sum
+  -> LN -> sepconv9 (4d -> d) -> + residual.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("activation", "RELU", "Branch activation.")
+    p.Define("dropout_prob", 0.0, "Dropout after each branch.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    d = p.input_dim
+    assert d
+    self.CreateChild("first_ln", layers.LayerNorm.Params().Set(input_dim=d))
+    self.CreateChild("second_ln",
+                     layers.LayerNorm.Params().Set(input_dim=4 * d))
+    self.CreateChild(
+        "dense_layer",
+        layers.FCLayer.Params().Set(input_dim=d, output_dim=4 * d,
+                                    activation=p.activation))
+    self.CreateChild(
+        "conv_layer",
+        Conv1DLayer.Params().Set(filter_width=3, in_dim=d, out_dim=d // 2,
+                                 activation=p.activation))
+    self.CreateChild(
+        "separable_conv_layer",
+        SeparableConv1DLayer.Params().Set(filter_width=9, in_dim=4 * d,
+                                          out_dim=d))
+    if p.dropout_prob:
+      self.CreateChild(
+          "dropout",
+          layers.DeterministicDropoutLayer.Params().Set(
+              keep_prob=1.0 - p.dropout_prob))
+
+  def _Dropout(self, theta, x):
+    if self.p.dropout_prob:
+      return self.dropout.FProp(self.ChildTheta(theta, "dropout"), x)
+    return x
+
+  def FProp(self, theta, inputs, paddings=None):
+    p = self.p
+    d = p.input_dim
+    x = self.first_ln.FProp(self.ChildTheta(theta, "first_ln"), inputs)
+    left = self._Dropout(
+        theta, self.dense_layer.FProp(self.ChildTheta(theta, "dense_layer"), x))
+    right = self._Dropout(
+        theta,
+        self.conv_layer.FProp(self.ChildTheta(theta, "conv_layer"), x,
+                              paddings))
+    right = jnp.pad(right, ((0, 0), (0, 0), (0, 4 * d - d // 2)))
+    h = left + right
+    h = self.second_ln.FProp(self.ChildTheta(theta, "second_ln"), h)
+    h = self.separable_conv_layer.FProp(
+        self.ChildTheta(theta, "separable_conv_layer"), h, paddings)
+    return _MaskPad(inputs + h, paddings)
+
+
+class EvolvedTransformerDecoderBranchedConvsLayer(base_layer.BaseLayer):
+  """ET decoder branched-convs block, causal (ref `:1687`).
+
+  LN -> {sepconv11(relu, 2d) | sepconv7(none, d/2) zero-padded to 2d} -> sum
+  -> LN -> sepconv7 (2d -> d) -> + residual. All convs are causal.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("activation", "RELU", "Left-branch activation.")
+    p.Define("dropout_prob", 0.0, "Dropout after each conv.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    d = p.input_dim
+    assert d
+    self.CreateChild("first_ln", layers.LayerNorm.Params().Set(input_dim=d))
+    self.CreateChild("second_ln",
+                     layers.LayerNorm.Params().Set(input_dim=2 * d))
+    self.CreateChild(
+        "sep_conv_11",
+        SeparableConv1DLayer.Params().Set(filter_width=11, in_dim=d,
+                                          out_dim=2 * d, causal=True,
+                                          activation=p.activation))
+    self.CreateChild(
+        "sep_conv_7a",
+        SeparableConv1DLayer.Params().Set(filter_width=7, in_dim=d,
+                                          out_dim=d // 2, causal=True))
+    self.CreateChild(
+        "sep_conv_7b",
+        SeparableConv1DLayer.Params().Set(filter_width=7, in_dim=2 * d,
+                                          out_dim=d, causal=True))
+    if p.dropout_prob:
+      self.CreateChild(
+          "dropout",
+          layers.DeterministicDropoutLayer.Params().Set(
+              keep_prob=1.0 - p.dropout_prob))
+
+  def _Dropout(self, theta, x):
+    if self.p.dropout_prob:
+      return self.dropout.FProp(self.ChildTheta(theta, "dropout"), x)
+    return x
+
+  def FProp(self, theta, inputs, paddings=None):
+    d = self.p.input_dim
+    x = self.first_ln.FProp(self.ChildTheta(theta, "first_ln"), inputs)
+    left = self._Dropout(
+        theta,
+        self.sep_conv_11.FProp(self.ChildTheta(theta, "sep_conv_11"), x,
+                               paddings))
+    right = self._Dropout(
+        theta,
+        self.sep_conv_7a.FProp(self.ChildTheta(theta, "sep_conv_7a"), x,
+                               paddings))
+    right = jnp.pad(right, ((0, 0), (0, 0), (0, 2 * d - d // 2)))
+    h = left + right
+    h = self.second_ln.FProp(self.ChildTheta(theta, "second_ln"), h)
+    h = self._Dropout(
+        theta,
+        self.sep_conv_7b.FProp(self.ChildTheta(theta, "sep_conv_7b"), h,
+                               paddings))
+    return _MaskPad(inputs + h, paddings)
+
+
+class EvolvedTransformerEncoderLayer(base_layer.BaseLayer):
+  """ET encoder layer: GLU -> branched convs -> transformer (ref `:1807`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("num_heads", 8, "Attention heads.")
+    p.Define("hidden_dim", 0, "Transformer FFN dim (0 = 4*input_dim).")
+    p.Define("dropout_prob", 0.0, "Dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim
+    self.CreateChild(
+        "glu_layer",
+        GluLayer.Params().Set(input_dim=p.input_dim,
+                              dropout_prob=p.dropout_prob))
+    self.CreateChild(
+        "branched_convs",
+        EvolvedTransformerEncoderBranchedConvsLayer.Params().Set(
+            input_dim=p.input_dim, dropout_prob=p.dropout_prob))
+    self.CreateChild(
+        "transformer_layer",
+        transformer_lib.TransformerLayer.Params().Set(
+            input_dim=p.input_dim, num_heads=p.num_heads,
+            hidden_dim=p.hidden_dim or 4 * p.input_dim))
+
+  def FProp(self, theta, inputs, paddings=None, segment_ids=None):
+    x = self.glu_layer.FProp(self.ChildTheta(theta, "glu_layer"), inputs,
+                             paddings)
+    x = self.branched_convs.FProp(self.ChildTheta(theta, "branched_convs"), x,
+                                  paddings)
+    return self.transformer_layer.FProp(
+        self.ChildTheta(theta, "transformer_layer"), x, paddings,
+        segment_ids=segment_ids)
+
+
+class EvolvedTransformerDecoderLayer(base_layer.BaseLayer):
+  """ET decoder layer (ref `:1885`): double-heads self-attention and encoder
+  attention branches summed with the residual, then causal branched convs,
+  then a transformer layer (SWISH FFN)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim.")
+    p.Define("num_heads", 8, "Attention heads (double-heads branch uses 2x).")
+    p.Define("hidden_dim", 0, "Transformer FFN dim (0 = 4*input_dim).")
+    p.Define("has_aux_atten", True, "Attend to encoder outputs.")
+    p.Define("dropout_prob", 0.0, "Dropout.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim
+    self.CreateChild(
+        "self_atten_double_heads",
+        transformer_lib.TransformerAttentionLayer.Params().Set(
+            input_dim=p.input_dim, num_heads=2 * p.num_heads, is_masked=True))
+    if p.has_aux_atten:
+      self.CreateChild(
+          "attend_to_encoder",
+          transformer_lib.TransformerAttentionLayer.Params().Set(
+              input_dim=p.input_dim, num_heads=p.num_heads))
+    self.CreateChild(
+        "branched_convs",
+        EvolvedTransformerDecoderBranchedConvsLayer.Params().Set(
+            input_dim=p.input_dim, dropout_prob=p.dropout_prob))
+    ff = transformer_lib.TransformerFeedForwardLayer.Params().Set(
+        activation="SWISH")
+    self.CreateChild(
+        "transformer_layer",
+        transformer_lib.TransformerLayer.Params().Set(
+            input_dim=p.input_dim, num_heads=p.num_heads,
+            hidden_dim=p.hidden_dim or 4 * p.input_dim,
+            mask_self_atten=True, has_aux_atten=p.has_aux_atten,
+            tr_fflayer_tpl=ff))
+
+  def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
+            aux_paddings=None, segment_ids=None):
+    p = self.p
+    left, _ = self.self_atten_double_heads.FProp(
+        self.ChildTheta(theta, "self_atten_double_heads"), inputs,
+        paddings=paddings, segment_ids=segment_ids)
+    # TransformerAttentionLayer returns residual-added output; recover the
+    # branch delta so both branches sum with ONE residual (ref `:1981-1985`).
+    h = left
+    if p.has_aux_atten:
+      assert aux_vecs is not None
+      right, _ = self.attend_to_encoder.FProp(
+          self.ChildTheta(theta, "attend_to_encoder"), inputs,
+          source_vecs=aux_vecs, paddings=aux_paddings)
+      h = left + right - inputs
+    h = self.branched_convs.FProp(
+        self.ChildTheta(theta, "branched_convs"), h, paddings)
+    return self.transformer_layer.FProp(
+        self.ChildTheta(theta, "transformer_layer"), h, paddings,
+        aux_vecs=aux_vecs, aux_paddings=aux_paddings,
+        segment_ids=segment_ids)

@@ -1,0 +1,82 @@
+"""Host-side op equivalents: cached calls, random permutation sequences.
+
+Re-designs the small CPU kernels the reference registers as TF ops:
+`ops/functional_ops_kernels.cc` (CachedCall: run a function once, replay the
+cached tensors) and `ops/random_ops_kernels.cc` (RandomPermutationSequence:
+epoch-wise shuffled id batches for sampling-without-replacement input
+pipelines). In the JAX stack these run on the host by construction, so they
+are plain Python with numpy RNG — no kernel registry needed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class CachedCall:
+  """Calls `fn` once; replays its result afterwards (ref CachedCall op).
+
+  Thread-safe; `Reset()` drops the cache (ref op is per-session-run
+  persistent, which a process-lifetime cache subsumes).
+  """
+
+  def __init__(self, fn):
+    self._fn = fn
+    self._lock = threading.Lock()
+    self._has_result = False
+    self._result = None
+
+  def __call__(self):
+    with self._lock:
+      if not self._has_result:
+        self._result = self._fn()
+        self._has_result = True
+      return self._result
+
+  def Reset(self):
+    with self._lock:
+      self._has_result = False
+      self._result = None
+
+
+class RandomPermutationSequence:
+  """Batches of a random permutation of [0, num) (ref
+  `random_ops_kernels.cc:27`).
+
+  Each epoch is one shuffled permutation, consumed `batch` ids at a time
+  (the final slice of an epoch may be short). With `repeat=False`,
+  `GetNext()` raises StopIteration at epoch end; with `repeat=True` a fresh
+  permutation starts seamlessly.
+  """
+
+  def __init__(self, num: int, batch: int, repeat: bool = False,
+               seed: int = 0):
+    assert num > 0 and batch > 0
+    self._num = num
+    self._batch = batch
+    self._repeat = repeat
+    self._rng = np.random.default_rng(seed if seed else None)
+    self._lock = threading.Lock()
+    self._ids: list[int] = []
+    self._Fill()
+
+  def _Fill(self):
+    self._ids = list(self._rng.permutation(self._num))
+
+  def GetNext(self) -> np.ndarray:
+    with self._lock:
+      if not self._ids:
+        if not self._repeat:
+          raise StopIteration("Epoch ended.")
+        self._Fill()
+      take = self._ids[:self._batch]
+      del self._ids[:len(take)]
+      return np.asarray(take, np.int64)
+
+  def __iter__(self):
+    return self
+
+  def __next__(self) -> np.ndarray:
+    return self.GetNext()

@@ -289,6 +289,12 @@ class InstantiableParams(Params):
   def cls(self) -> type:
     return self.__dict__["_cls"]
 
+  def SetClass(self, cls: type) -> "InstantiableParams":
+    """Rebinds the class to instantiate (e.g. policy wrappers subclassing
+    the original cls, ref input_policy.py); returns self for chaining."""
+    self.__dict__["_cls"] = cls
+    return self
+
   def Instantiate(self, **kwargs: Any):
     """Constructs the bound class with this params tree."""
     if self.cls is None:

@@ -1,0 +1,37 @@
+"""Input-placement policy (ref `lingvo/core/input_policy.py`).
+
+The reference wraps an input generator's params so its graph nodes land on
+`cluster.input_device` (a TF device string). In the JAX stack, input
+generators run host-side by construction and batches move to devices via
+explicit `jax.device_put` with a sharding (see `parallel/mesh.PutBatch`), so
+device placement needs no subclass surgery. `Apply` remains the hook: it
+consults the current cluster and, for multi-host runs, wraps the generator
+so each process reads only its per-host shard (the `InfeedContextScope`
+host-sharding concept, ref `cluster.py:47-59`).
+"""
+
+from __future__ import annotations
+
+
+def Apply(input_params):
+  """Possibly updates input_params according to the cluster's input policy.
+
+  On multi-host clusters, stamps this process's (host_index, num_hosts)
+  into the generator params before instantiation — file-based generators
+  shard their file list with them (`FileBasedSequenceInputGenerator`
+  routes them into the native yielder), synthetic ones fold them into
+  their seed. A generator without those params on a multi-host run fails
+  loudly: every host silently reading the full stream corrupts epoch and
+  global-batch accounting.
+  """
+  from lingvo_tpu.core import cluster as cluster_lib
+  current = cluster_lib.Current()
+  if current is None or current.num_infeed_hosts <= 1:
+    return input_params
+  shard, num_shards = current.InputShardParams()
+  if "num_hosts" not in input_params or "host_index" not in input_params:
+    raise ValueError(
+        f"{input_params.cls.__name__} has no num_hosts/host_index params "
+        f"but the cluster has {num_shards} infeed hosts; add them (see "
+        f"BaseInputGenerator) or run single-host input.")
+  return input_params.Copy().Set(num_hosts=num_shards, host_index=shard)

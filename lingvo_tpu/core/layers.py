@@ -724,6 +724,55 @@ class SimpleFullSoftmax(base_layer.BaseLayer):
     return out
 
 
+class SingleShardFullSoftmax(SimpleFullSoftmax):
+  """Full softmax for huge vocabularies (ref `layers.py:4494`).
+
+  Two memory levers, composable:
+  - vocab-dim sharding: set `weight_split_dims_mapping=(None, 'model')` and
+    the [D, V] table plus each logits chunk shard over the model axis
+    (GSPMD inserts the collectives) — the reference's SingleShard* family;
+  - `chunk_size`: computes per-example xent `chunk_size` rows at a time
+    with `lax.map`, never materializing the full [B*T, V] logits
+    (ref `layers.py:3991-4040` chunked xent).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("chunk_size", 0,
+             "If >0, rows per xent chunk (memory over one big matmul).")
+    return p
+
+  def FProp(self, theta, inputs, class_ids=None, class_probabilities=None,
+            label_smoothing=0.0):
+    p = self.p
+    if p.chunk_size <= 0 or class_ids is None:
+      return super().FProp(theta, inputs, class_ids, class_probabilities,
+                           label_smoothing)
+    assert class_probabilities is None, "chunked path needs class_ids"
+    lead_shape = class_ids.shape
+    m = int(math.prod(lead_shape))
+    x = inputs.reshape(m, inputs.shape[-1])
+    ids = class_ids.reshape(m)
+    pad = (-m) % p.chunk_size
+    if pad:
+      x = jnp.pad(x, ((0, pad), (0, 0)))
+      ids = jnp.pad(ids, (0, pad))
+    xc = x.reshape(-1, p.chunk_size, x.shape[-1])
+    idc = ids.reshape(-1, p.chunk_size)
+
+    def _Chunk(args):
+      xi, idi = args
+      logits = self.Logits(theta, xi)
+      out = XentLossFromLogits(logits, p.num_classes, class_ids=idi,
+                               label_smoothing=label_smoothing)
+      return out.per_example_xent
+
+    xent = jax.lax.map(_Chunk, (xc, idc)).reshape(-1)[:m]
+    return NestedMap(per_example_xent=xent.reshape(lead_shape),
+                     log_probs=None, logits=None)
+
+
 class SharedEmbeddingSoftmaxLayer(base_layer.BaseLayer):
   """Ties input embedding and softmax weights (common LM configuration)."""
 

@@ -95,6 +95,153 @@ class SymmetricQDomain(QDomain):
     return FakeQuant(x, scale.astype(x.dtype), p.bits)
 
 
+def FakeQuantAsym(x, scale, zero_point, bits: int = 8):
+  """Asymmetric quantize-dequantize with STE (ref PassiveAsymQDomain).
+
+  q = clip(round(x/scale) + zp) mapped back; backward is identity.
+  """
+  qmax = 2.0 ** bits - 1
+  scale = jnp.maximum(scale, 1e-8)
+  q = jnp.clip(jnp.round(x / scale) + zero_point, 0.0, qmax)
+  dq = (q - zero_point) * scale
+  return x + jax.lax.stop_gradient(dq - x)
+
+
+class PassiveAsymQDomain(QDomain):
+  """Asymmetric per-tensor fake quant with tracked min/max ranges (ref
+  `quant_utils.py` PassiveAsymQDomain): activations carry EMA min and max
+  (not just max-abs), giving a zero point — the right domain for
+  post-RELU/softmax tensors whose range is one-sided."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("ema_decay", 0.99, "Range EMA decay.")
+    p.Define("act_names", ("act",), "Tracked activation hooks.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    for name in self.p.act_names:
+      self.CreateVariable(
+          f"min_{name}",
+          WeightParams((), WeightInit.Constant(0.0), jnp.float32,
+                       collections=("non_trainable", "moving_stats")))
+      self.CreateVariable(
+          f"max_{name}",
+          WeightParams((), WeightInit.Constant(1.0), jnp.float32,
+                       collections=("non_trainable", "moving_stats")))
+
+  def QuantizeWeight(self, theta, w):
+    # weights stay symmetric (zero-centered by construction)
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (
+        2.0 ** (self.p.bits - 1) - 1)
+    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+
+  def QuantizeAct(self, theta, name: str, x):
+    p = self.p
+    assert name in p.act_names, (name, p.act_names)
+    th = self.CastTheta(theta)
+    ema_min = th[f"min_{name}"].astype(jnp.float32)
+    ema_max = th[f"max_{name}"].astype(jnp.float32)
+    if not py_utils.DoEval():
+      bmin = jnp.min(x.astype(jnp.float32))
+      bmax = jnp.max(x.astype(jnp.float32))
+      new_min = p.ema_decay * ema_min + (1.0 - p.ema_decay) * bmin
+      new_max = p.ema_decay * ema_max + (1.0 - p.ema_decay) * bmax
+      py_utils.AddForwardStateUpdate(f"{self.path}/min_{name}", new_min)
+      py_utils.AddForwardStateUpdate(f"{self.path}/max_{name}", new_max)
+      lo, hi = new_min, new_max
+    else:
+      lo, hi = ema_min, ema_max
+    hi = jnp.maximum(hi, lo + 1e-6)
+    scale = (hi - lo) / (2.0 ** p.bits - 1)
+    zero_point = jnp.round(-lo / scale)
+    return FakeQuantAsym(x, scale.astype(x.dtype),
+                         zero_point.astype(x.dtype), p.bits)
+
+
+class PerChannelSymmetricQDomain(SymmetricQDomain):
+  """Symmetric fake quant with per-output-channel weight scales (the
+  standard int8 deployment recipe; ref quant domains' per-channel option).
+  Channel axis = last weight dim."""
+
+  def QuantizeWeight(self, theta, w):
+    reduce_axes = tuple(range(w.ndim - 1))
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                    keepdims=True) / (2.0 ** (self.p.bits - 1) - 1)
+    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+
+
+# ---------------------------------------------------------------------------
+# Real int8 serving path: quantize once, run integer matmuls on the MXU.
+# ---------------------------------------------------------------------------
+
+
+def Int8QuantizeWeight(w, per_channel: bool = True):
+  """[.., out] float weight -> (int8 weight, f32 scale) for serving.
+
+  The returned pair feeds `Int8Einsum`; per_channel scales over the last
+  dim match PerChannelSymmetricQDomain's QAT simulation.
+  """
+  w32 = w.astype(jnp.float32)
+  if per_channel:
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+  else:
+    amax = jnp.max(jnp.abs(w32))
+  scale = jnp.maximum(amax / 127.0, 1e-8)
+  w_int8 = jnp.clip(jnp.round(w32 / scale), -128, 127).astype(jnp.int8)
+  return w_int8, scale
+
+
+def Int8Einsum(x, w_int8, w_scale):
+  """y = x @ dequant(w) computed as int8 x int8 -> int32 on the MXU.
+
+  Activations are dynamically quantized per call (per-tensor symmetric).
+  x: [..., in]; w_int8: [in, out] int8; w_scale: f32 broadcastable to
+  [1, out]. Returns x.dtype.
+  """
+  x32 = x.astype(jnp.float32)
+  x_scale = jnp.maximum(jnp.max(jnp.abs(x32)) / 127.0, 1e-8)
+  x_int8 = jnp.clip(jnp.round(x32 / x_scale), -128, 127).astype(jnp.int8)
+  acc = jax.lax.dot_general(
+      x_int8, w_int8,
+      dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+      preferred_element_type=jnp.int32)
+  return (acc.astype(jnp.float32) * x_scale *
+          w_scale.reshape((1,) * (acc.ndim - 1) + (-1,))).astype(x.dtype)
+
+
+class QuantizableLayer(base_layer.BaseLayer):
+  """Mixin giving layers the reference's QWeight/QAct convenience surface
+  (ref `quant_utils.QuantizableLayer`): subclasses define a `qdomain` param;
+  calls degrade to identity when no domain is configured."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("qdomain", None, "Optional QDomain params.")
+    return p
+
+  def _CreateQDomain(self):
+    """Call from __init__ after Params are set."""
+    if self.p.qdomain is not None:
+      self.CreateChild("qdomain_child", self.p.qdomain.Copy())
+
+  def QWeight(self, theta, w):
+    if self.p.qdomain is None:
+      return w
+    return self.qdomain_child.QuantizeWeight(
+        self.ChildTheta(theta, "qdomain_child"), w)
+
+  def QAct(self, theta, name, x):
+    if self.p.qdomain is None:
+      return x
+    return self.qdomain_child.QuantizeAct(
+        self.ChildTheta(theta, "qdomain_child"), name, x)
+
+
 class ScheduledClipQDomain(SymmetricQDomain):
   """Adds the reference's clipping-cap schedule (ref ClippingCapSchedule):
   the activation clip range anneals from start_cap to end_cap over

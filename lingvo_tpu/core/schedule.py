@@ -199,7 +199,6 @@ class DevBasedSchedule(BaseSchedule):
   def __init__(self, params):
     super().__init__(params)
     self._cur_factor = 1.0
-    self._ref_step = 0
     self._history_path = self.p.history_path or None
 
   def SetMetricHistory(self, metric_history) -> None:
@@ -207,23 +206,37 @@ class DevBasedSchedule(BaseSchedule):
     self._history_path = metric_history.path
 
   def UpdateFromHistory(self) -> bool:
-    """Host-side decay check; returns True if the multiplier changed."""
+    """Host-side decay check; returns True if the multiplier changed.
+
+    RESTART-SAFE BY REPLAY: instead of checkpointing cur_factor (the
+    reference keeps it in a TF variable), the full decay algorithm is
+    deterministically replayed over the metric-history file — a decay can
+    only trigger when a new record lands, so replaying records reproduces
+    the incremental state exactly, and a restarted job recovers the same
+    multiplier from the same file.
+    """
     from lingvo_tpu.core import early_stop
     p = self.p
     if not self._history_path:
       return False
-    best_step, last_step = early_stop.BestStep(
-        self._history_path, p.tolerance, p.minimize)
-    if last_step == 0:
+    history = early_stop.ReadHistory(self._history_path)
+    if not history:
       return False
-    self._ref_step = max(self._ref_step, best_step)
-    if last_step - self._ref_step > p.window:
-      new_factor = max(self._cur_factor * p.decay, p.min_factor)
-      changed = new_factor != self._cur_factor
-      self._cur_factor = new_factor
-      self._ref_step = last_step
-      return changed
-    return False
+    factor, ref_step = 1.0, 0
+    best_step, best_val = 0, None
+    for step, val in history:
+      better = (best_val is None or
+                (val < best_val - p.tolerance if p.minimize else
+                 val > best_val + p.tolerance))
+      if better:
+        best_val, best_step = val, step
+      ref_step = max(ref_step, best_step)
+      if step - ref_step > p.window:
+        factor = max(factor * p.decay, p.min_factor)
+        ref_step = step
+    changed = factor != self._cur_factor
+    self._cur_factor = factor
+    return changed
 
   def HostStateKey(self):
     """Changes whenever jitted consumers must re-trace."""

@@ -284,6 +284,13 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
     p.Define("body", TransformerLayer.Params(), "The repeated layer.")
     p.Define("per_layer_checkpoint", True,
              "jax.checkpoint each body iteration (remat for long stacks).")
+    p.Define(
+        "remat_policy", "full",
+        "What the per-layer checkpoint saves: 'full' = save only the layer "
+        "boundary and recompute everything in bwd (min memory, ~4/3x "
+        "flops); 'dots' = save matmul outputs and recompute only "
+        "elementwise ops (near-zero extra flops, more memory); 'none' = "
+        "same as per_layer_checkpoint=False.")
     return p
 
   def __init__(self, params):
@@ -322,8 +329,13 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
       return x, aux_sum
 
     body_fn = _Body
-    if p.per_layer_checkpoint:
-      body_fn = jax.checkpoint(_Body)
+    if p.per_layer_checkpoint and p.remat_policy != "none":
+      if p.remat_policy == "dots":
+        body_fn = jax.checkpoint(
+            _Body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+      else:
+        body_fn = jax.checkpoint(_Body)
     out, aux_per_layer = jax.lax.scan(body_fn, inputs,
                                       (theta.body, jnp.arange(p.num_layers)))
     if aux_flag.emitted:

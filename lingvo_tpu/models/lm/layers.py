@@ -37,6 +37,10 @@ class TransformerLm(base_model.BaseTask):
     p.Define("hidden_dim", 2048, "FFN inner dim.")
     p.Define("use_repeat_layer", True,
              "Scan-over-layers (True) vs distinct layers (False).")
+    p.Define("remat_policy", "full",
+             "Per-layer rematerialization under use_repeat_layer: 'full' | "
+             "'dots' (save matmul outputs; ~4/3x fewer bwd flops than "
+             "'full') | 'none'.")
     p.Define("atten_tpl", None, "Optional attention template override.")
     p.Define("use_rotary", True, "RoPE instead of absolute positions.")
     p.Define("bidirectional", False,
@@ -110,12 +114,14 @@ class TransformerLm(base_model.BaseTask):
       self.CreateChild(
           "stack",
           transformer_lib.RepeatedTransformerLayer.Params().Set(
-              num_layers=p.num_layers // 2, body=block))
+              num_layers=p.num_layers // 2, body=block,
+              remat_policy=p.remat_policy))
     elif p.use_repeat_layer:
       self.CreateChild(
           "stack",
           transformer_lib.RepeatedTransformerLayer.Params().Set(
-              num_layers=p.num_layers, body=layer_body))
+              num_layers=p.num_layers, body=layer_body,
+              remat_policy=p.remat_policy))
     else:
       self.CreateChild(
           "stack",

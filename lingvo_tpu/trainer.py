@@ -98,8 +98,13 @@ def main(argv=None):
 
   if args.list_models:
     import lingvo_tpu.models.all_params  # noqa: F401  (populate registry)
+    from lingvo_tpu import datasets as datasets_lib
     for name in sorted(model_registry.GetRegisteredModels()):
-      print(name)
+      try:
+        ds = datasets_lib.GetDatasets(model_registry.GetClass(name))
+      except Exception:  # noqa: BLE001 - listing must never crash
+        ds = []
+      print(f"{name}  [{', '.join(ds)}]" if ds else name)
     return 0
 
   if not args.model:

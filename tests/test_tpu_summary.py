@@ -70,7 +70,7 @@ class TestTpuSummary:
             batch.ids.astype(jnp.float32)))
         return orig_fprop(self, theta, batch)
 
-    mp.task.__dict__["_cls"] = _SummaryLm
+    mp.task.SetClass(_SummaryLm)
     for on_device in (False, True):
       task = mp.task.Instantiate()
       task.FinalizePaths()

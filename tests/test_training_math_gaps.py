@@ -225,3 +225,19 @@ class TestScatterUpdate:
     with scatter_update.SetInplaceUpdate(True):
       x = scatter_update.Update(jnp.zeros((2,)), 0, 5.0)
     assert float(x[0]) == 5.0
+
+  def test_restart_replay_recovers_multiplier(self, tmp_path):
+    """A fresh schedule instance recovers the decayed factor from the
+    history file alone (restart safety; no checkpointed state)."""
+    from lingvo_tpu.core import early_stop
+    mh = early_stop.MetricHistory(str(tmp_path), "eval", "loss")
+    mh.ConditionalAppend(1, 1.0)
+    mh.ConditionalAppend(200, 2.0)   # decay 1
+    mh.ConditionalAppend(400, 2.1)   # decay 2
+    p = schedule.DevBasedSchedule.Params().Set(window=100, decay=0.5,
+                                               min_factor=0.01)
+    s1 = p.Instantiate(); s1.SetMetricHistory(mh)
+    s1.UpdateFromHistory()
+    s2 = p.Instantiate(); s2.SetMetricHistory(mh)  # "restarted" job
+    s2.UpdateFromHistory()
+    assert float(s1.Value(0)) == float(s2.Value(0)) == 0.25
