@@ -26,18 +26,19 @@ from lingvo_tpu.core.py_utils import WeightInit, WeightParams
 _NEG_INF = attention_lib._NEG_INF
 
 
-class TransformerXLAttention(attention_lib.MultiHeadedAttention):
-  """Transformer-XL relative position attention (ref
-  `batch_major_attention.py:2233`):
+def _SinusoidRelEmbedding(dist, d: int):
+  """[len(dist), d] sinusoid embedding of relative distances."""
+  pos = jnp.asarray(dist, jnp.float32)
+  inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, jnp.float32) / d))
+  ang = pos[:, None] * inv[None, :]
+  emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+  return emb[:, :d]
 
-    logits[i,j] = (q_i + u) . k_j + (q_i + v) . r_{i-j}
 
-  with sinusoidal relative embeddings r projected per head and learned
-  content/position biases u/v.
-  """
+class _XLBiasVariables:
+  """Shared w_rel/u_bias/v_bias creation for XL-style attention layers."""
 
-  def __init__(self, params):
-    super().__init__(params)
+  def _CreateXLBiasVariables(self):
     p = self.p
     n, h = p.num_heads, self._dim_per_head
     self.CreateVariable(
@@ -49,14 +50,25 @@ class TransformerXLAttention(attention_lib.MultiHeadedAttention):
                                                WeightInit.Constant(0.0),
                                                p.dtype))
 
+
+class TransformerXLAttention(attention_lib.MultiHeadedAttention,
+                             _XLBiasVariables):
+  """Transformer-XL relative position attention (ref
+  `batch_major_attention.py:2233`):
+
+    logits[i,j] = (q_i + u) . k_j + (q_i + v) . r_{i-j}
+
+  with sinusoidal relative embeddings r projected per head and learned
+  content/position biases u/v.
+  """
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._CreateXLBiasVariables()
+
   def _SinusoidRel(self, t: int):
     """[2t-1, D] sinusoid embedding of relative distance t-1 .. -(t-1)."""
-    d = self.p.input_dim
-    pos = jnp.arange(t - 1, -t, -1, dtype=jnp.float32)    # [2t-1]
-    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, jnp.float32) / d))
-    ang = pos[:, None] * inv[None, :]
-    emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
-    return emb[:, :d]
+    return _SinusoidRelEmbedding(jnp.arange(t - 1, -t, -1), self.p.input_dim)
 
   def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
             paddings=None, atten_mask=None, segment_ids=None, causal=False):
@@ -104,7 +116,8 @@ class TransformerXLAttention(attention_lib.MultiHeadedAttention):
     return self._PostProj(theta, ctx), probs
 
 
-class LocalSelfAttentionXL(attention_lib.LocalSelfAttention):
+class LocalSelfAttentionXL(attention_lib.LocalSelfAttention,
+                           _XLBiasVariables):
   """Sliding-window attention with Transformer-XL relative position bias
   (ref `batch_major_attention.py:3754` LocalSelfAttentionXL).
 
@@ -114,34 +127,24 @@ class LocalSelfAttentionXL(attention_lib.LocalSelfAttention):
 
   def __init__(self, params):
     super().__init__(params)
-    p = self.p
-    n, h = p.num_heads, self._dim_per_head
-    self.CreateVariable(
-        "w_rel", WeightParams((p.input_dim, n, h), p.params_init, p.dtype))
-    self.CreateVariable(
-        "u_bias", WeightParams((n, h), WeightInit.Constant(0.0), p.dtype))
-    self.CreateVariable(
-        "v_bias", WeightParams((n, h), WeightInit.Constant(0.0), p.dtype))
+    self._CreateXLBiasVariables()
 
   def _AddRelPositionBias(self, theta, qb, kb, rel, logits):
     p = self.p
     th = self.CastTheta(theta)
-    d = p.input_dim
     w = p.block_size
     scale = 1.0 / math.sqrt(self._dim_per_head)
     # sinusoid embeddings for every distinct rel distance in the window:
     # rel ranges over [-(2w-1), ..., 2w-1] -> index r_idx = rel + (2w - 1)
-    dist = jnp.arange(-(2 * w - 1), 2 * w, dtype=jnp.float32)  # [4w-1]
-    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, jnp.float32) / d))
-    ang = dist[:, None] * inv[None, :]
-    sin_emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[:, :d]
+    sin_emb = _SinusoidRelEmbedding(
+        jnp.arange(-(2 * w - 1), 2 * w), p.input_dim)
     r = jnp.einsum("rd,dnh->rnh", sin_emb.astype(qb.dtype), th.w_rel)
 
     # content bias: scale * (u . k)  [B, L, N, 1, 3W]
-    content = scale * jnp.einsum("nh,BLKNH->BLNK", th.u_bias, kb)
+    content = scale * jnp.einsum("nh,blknh->blnk", th.u_bias, kb)
     # position terms: qb is already scaled by the base class, so
     # q_scaled . r + scale * (v . r)
-    pos_q = jnp.einsum("BLQNH,rnh->BLNQr", qb, r)
+    pos_q = jnp.einsum("blqnh,rnh->blnqr", qb, r)
     pos_v = scale * jnp.einsum("nh,rnh->nr", th.v_bias, r)
     r_idx = rel + (2 * w - 1)                               # [W, 3W]
     pos = pos_q + pos_v[None, None, :, None, :]

@@ -264,7 +264,8 @@ class BaseTask(base_layer.BaseLayer):
   def CreateInputGenerator(self):
     if self._input_params is None:
       raise ValueError(f"Task {self.p.name} has no input params")
-    return self._input_params.Instantiate()
+    from lingvo_tpu.core import input_policy
+    return input_policy.Apply(self._input_params).Instantiate()
 
 
 class BaseModel(base_layer.BaseLayer):

@@ -108,8 +108,12 @@ class CCTAttentionLayer(base_layer.BaseLayer):
                                              num_outputs=1))
 
   def FProp(self, theta, query_vec, source_vecs=None, paddings=None,
-            segment_ids=None):
-    """[b, t, d] -> (gated attention output + residual, gates)."""
+            source_paddings=None, segment_ids=None):
+    """[b, t, d] -> (gated attention output + residual, gates).
+
+    `paddings` mask the query side; cross-attention masks keys with
+    `source_paddings` (the attention core consumes KEY-side paddings).
+    """
     p = self.p
     x = self.ln.FProp(self.ChildTheta(theta, "ln"), query_vec)
     kv_src = x if source_vecs is None else source_vecs
@@ -124,7 +128,7 @@ class CCTAttentionLayer(base_layer.BaseLayer):
     else:
       out, _ = self.atten.FProp(
           self.ChildTheta(theta, "atten"), x, key_vec=gated_kv,
-          value_vec=gated_kv, paddings=paddings)
+          value_vec=gated_kv, paddings=source_paddings)
     q_gate = self.query_gating.FProp(
         self.ChildTheta(theta, "query_gating"), x)         # [b, t, 1]
     out = out * q_gate.astype(out.dtype)

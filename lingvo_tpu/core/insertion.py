@@ -1,0 +1,162 @@
+"""Insertion-based sequence framework (Insertion Transformer).
+
+Re-designs `lingvo/core/insertion.py` (`SymbolInsertionLayer:130` + sequence
+utilities): sampling a random "canvas" (observed subset) of the target
+sequence and building the slot/token targets an insertion model trains on.
+
+TPU-first deviation from the reference: the reference trims the canvas to
+the batch max length and boolean-masks the target list — both dynamic
+shapes. Here every output keeps the static [b, t] shape with
+paddings/weights doing the masking, so the whole pipeline jits: the canvas
+is [b, t] (padded past each example's sampled length) and targets are dense
+[b, t] token/slot/weight tensors instead of a ragged [num_targets, 3] list.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def SequenceTrimLastToken(x, x_paddings):
+  """Trims the last valid token of each sequence (ref `insertion.py:27`)."""
+  seq_len = jnp.sum(1.0 - x_paddings, axis=1)
+  last = jnp.maximum(seq_len - 1.0, 0.0)
+  keep = (jnp.arange(x.shape[1])[None, :] < last[:, None])
+  return x * keep.astype(x.dtype), jnp.where(keep, x_paddings, 1.0)
+
+
+def SequenceAppendToken(x, x_paddings, token, extend: bool = False):
+  """Appends `token` after the last valid token (ref `insertion.py:48`).
+
+  extend=True grows the time dim by one; otherwise the token must fit in
+  existing padding (the final position is overwritten if the row is full).
+  """
+  if extend:
+    x = jnp.pad(x, ((0, 0), (0, 1)))
+    x_paddings = jnp.pad(x_paddings, ((0, 0), (0, 1)), constant_values=1.0)
+  t = x.shape[1]
+  seq_len = jnp.sum(1.0 - x_paddings, axis=1).astype(jnp.int32)
+  write_at = jnp.minimum(seq_len, t - 1)
+  onehot = jax.nn.one_hot(write_at, t, dtype=x.dtype)
+  x = x * (1 - onehot).astype(x.dtype) + onehot * token
+  new_pad = x_paddings * (1.0 - onehot.astype(x_paddings.dtype))
+  return x, new_pad
+
+
+def SequenceConcat(x, x_paddings, y, y_paddings, pad=0):
+  """Concats y after x's valid tokens (ref `insertion.py:79`).
+
+  Output time dim = x_t + y_t; slots past the combined length hold `pad`.
+  """
+  b, xt = x.shape
+  yt = y.shape[1]
+  t = xt + yt
+  x_len = jnp.sum(1.0 - x_paddings, axis=1).astype(jnp.int32)   # [b]
+  y_len = jnp.sum(1.0 - y_paddings, axis=1).astype(jnp.int32)
+  pos = jnp.arange(t)[None, :]                                  # [1, t]
+  # from x where pos < x_len; from y where x_len <= pos < x_len + y_len
+  x_gather = jnp.clip(pos, 0, xt - 1)
+  y_gather = jnp.clip(pos - x_len[:, None], 0, yt - 1)
+  x_part = jnp.take_along_axis(jnp.pad(x, ((0, 0), (0, t - xt))), x_gather,
+                               axis=1)
+  y_part = jnp.take_along_axis(jnp.pad(y, ((0, 0), (0, t - yt))), y_gather,
+                               axis=1)
+  from_x = pos < x_len[:, None]
+  valid = pos < (x_len + y_len)[:, None]
+  out = jnp.where(from_x, x_part, y_part)
+  out = jnp.where(valid, out, pad)
+  return out, (1.0 - valid.astype(jnp.float32))
+
+
+class SymbolInsertionLayer(base_layer.BaseLayer):
+  """Sampled roll-in canvas + insertion targets (ref `insertion.py:130`)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("rollin_policy", "oracle", "{oracle, uniform}.")
+    p.Define("oracle_policy", "uniform", "{uniform}.")
+    return p
+
+  def FProp(self, theta, x, x_paddings=None, eos_id=1,
+            force_sample_last_token=True, key=None):
+    """x: [b, t] int ids -> NestedMap of canvas + dense targets.
+
+    Returns:
+      canvas [b, t], canvas_indices [b, t] (into x; invalid slots point at
+      t-1), canvas_paddings [b, t]; target_tokens [b, t] (the insertion at
+      each source position, <eos> for observed slots), target_slots [b, t]
+      (which canvas slot each target inserts into), target_weights [b, t]
+      (0 for padded positions and redundant <eos> duplicates).
+    """
+    p = self.p
+    del theta
+    rollin = p.oracle_policy if p.rollin_policy == "oracle" else p.rollin_policy
+    if rollin != "uniform" or p.oracle_policy != "uniform":
+      raise ValueError(f"Unsupported policy: {rollin}/{p.oracle_policy}")
+    b, t = x.shape
+    if x_paddings is None:
+      x_paddings = jnp.zeros((b, t), jnp.float32)
+    if key is None:
+      key = (py_utils.StepSeed(self.path + "/rollin")
+             if py_utils.HasStepSeed()
+             else jax.random.PRNGKey(p.random_seed or 0))
+    k_ratio, k_gumbel = jax.random.split(key)
+
+    x_len = jnp.round(jnp.sum(1.0 - x_paddings, axis=1)).astype(jnp.int32)
+    ratio = jax.random.uniform(k_ratio, (b,))
+    if force_sample_last_token:
+      c_len = jnp.minimum((ratio * x_len).astype(jnp.int32), x_len - 1) + 1
+    else:
+      c_len = jnp.minimum((ratio * (x_len + 1)).astype(jnp.int32), x_len)
+
+    # Gumbel-max over valid positions; optionally force the last token.
+    pos = jnp.arange(t)[None, :]
+    z_logits = jnp.where(pos >= x_len[:, None], -1e9, 0.0)
+    if force_sample_last_token:
+      z_logits = z_logits + jnp.where(pos == (x_len - 1)[:, None], 1e9, 0.0)
+    z = -jnp.log(-jnp.log(
+        jnp.clip(jax.random.uniform(k_gumbel, (b, t)), 1e-20, 1.0)))
+    order = jnp.argsort(-(z_logits + z), axis=1)           # [b, t]
+    # first c_len entries are the sampled canvas; others -> sentinel t-1
+    rank = jnp.arange(t)[None, :]
+    c_indices = jnp.where(rank < c_len[:, None], order, t - 1)
+    c_indices = jnp.sort(c_indices, axis=1)
+    canvas = jnp.take_along_axis(x, c_indices, axis=1)
+    canvas_paddings = (rank >= c_len[:, None]).astype(jnp.float32)
+    canvas = canvas * (1 - canvas_paddings).astype(canvas.dtype)
+
+    # observed flags over x (scatter of the sampled indices)
+    observed = jnp.zeros((b, t), jnp.int32)
+    valid_canvas = (rank < c_len[:, None]).astype(jnp.int32)
+    observed = jax.vmap(
+        lambda obs, idx, val: obs.at[idx].max(val))(observed, c_indices,
+                                                    valid_canvas)
+    # slot of each x position = # observed tokens strictly before it
+    x_segments = jnp.cumsum(observed, axis=1) - observed
+
+    observed_b = observed.astype(bool)
+    prev_observed = jnp.pad(observed_b[:, :-1], ((0, 0), (1, 0)),
+                            constant_values=True)
+    x_valid = (1.0 - x_paddings).astype(bool)
+
+    target_tokens = jnp.where(observed_b, eos_id, x).astype(jnp.int32)
+    target_weights = jnp.ones((b, t), jnp.float32)
+    # an observed token whose predecessor is unobserved shares its slot with
+    # a real insertion -> its <eos> target gets weight 0 (ref `:300-309`)
+    target_weights = jnp.where(observed_b & ~prev_observed, 0.0,
+                               target_weights)
+    target_weights = jnp.where(x_valid, target_weights, 0.0)
+
+    return NestedMap(
+        canvas=canvas,
+        canvas_indices=c_indices,
+        canvas_paddings=canvas_paddings,
+        target_tokens=target_tokens,
+        target_slots=x_segments,
+        target_weights=target_weights)

@@ -42,6 +42,7 @@ class SyntheticCarInput(base_input_generator.BaseInputGenerator):
     cls_t = np.zeros((b, g * g), np.int32)
     reg_t = np.zeros((b, g * g, 7), np.float32)
     reg_w = np.zeros((b, g * g), np.float32)
+    boxes = [[] for _ in range(b)]
     for i in range(b):
       pillar = 0
       for _ in range(p.num_objects):
@@ -50,6 +51,8 @@ class SyntheticCarInput(base_input_generator.BaseInputGenerator):
         l, w, h = rng.uniform(0.5, 2.0, 3)
         theta = rng.uniform(-np.pi, np.pi)
         cls = rng.randint(1, p.num_classes + 1)
+        boxes[i].append((np.array([cx, cy, cz, l, w, h, theta], np.float32),
+                         cls))
         cell = int(cy) * g + int(cx)
         cls_t[i, cell] = cls
         # residuals relative to the cell center (standard encoding)
@@ -70,6 +73,19 @@ class SyntheticCarInput(base_input_generator.BaseInputGenerator):
           py = int(np.clip(pts[i, pillar, 0, 1], 0, g - 1))
           cells[i, pillar] = py * g + px
           pillar += 1
+    # Flat "laser" view + ground-truth boxes (what point-based detectors
+    # like StarNet consume; the pillar view above serves PointPillars).
+    m = p.max_pillars * p.points_per_pillar
+    lasers = pts.reshape(b, m, 4)
+    laser_paddings = ppad.reshape(b, m)
+    gt_boxes = np.zeros((b, p.num_objects, 7), np.float32)
+    gt_classes = np.zeros((b, p.num_objects), np.int32)
+    for i in range(b):
+      for j, (box, cls) in enumerate(boxes[i][:p.num_objects]):
+        gt_boxes[i, j] = box
+        gt_classes[i, j] = cls
     return NestedMap(
         pillar_points=pts, point_paddings=ppad, pillar_cells=cells,
-        cls_targets=cls_t, reg_targets=reg_t, reg_weights=reg_w)
+        cls_targets=cls_t, reg_targets=reg_t, reg_weights=reg_w,
+        lasers=lasers, laser_paddings=laser_paddings,
+        gt_boxes=gt_boxes, gt_classes=gt_classes)

@@ -39,3 +39,50 @@ class PointPillarsCar(base_model_params.SingleTaskModelParams):
         lr_schedule=sched_lib.Constant.Params())
     p.train.tpu_steps_per_loop = 50
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class StarNetCar(base_model_params.SingleTaskModelParams):
+  """StarNet point-based detector (ref `kitti.py` StarNetCarModel0701)."""
+
+  BATCH_SIZE = 8
+
+  def Train(self):
+    return input_generator.SyntheticCarInput.Params().Set(
+        batch_size=self.BATCH_SIZE)
+
+  def Test(self):
+    return self.Train().Set(seed=99)
+
+  def Task(self):
+    from lingvo_tpu.models.car import starnet
+    p = starnet.StarNetModel.Params()
+    p.name = "starnet_car"
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params(),
+        lr_schedule=sched_lib.Constant.Params())
+    p.train.tpu_steps_per_loop = 50
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class StarNetCarTiny(StarNetCar):
+  """CPU-smoke scale."""
+
+  BATCH_SIZE = 2
+
+  def Train(self):
+    return super().Train().Set(max_pillars=16, points_per_pillar=4,
+                               num_objects=2)
+
+  def Task(self):
+    p = super().Task()
+    p.num_centers = 8
+    p.featurizer.num_neighbors = 8
+    p.featurizer.mlp_dims = (16, 16)
+    p.hidden_dim = 16
+    p.max_detections = 4
+    p.train.max_steps = 60
+    p.train.tpu_steps_per_loop = 20
+    return p

@@ -121,3 +121,63 @@ class WmtEnDeTransformerBpe(WmtEnDeTransformerBase):
   def Test(self):
     p = self._Input("newstest2014.en-de.tsv", seed=7)
     return p.Set(shuffle=False, max_epochs=1, require_sequential_order=True)
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeRNMTPlus(base_model_params.SingleTaskModelParams):
+  """RNMT+ recurrent encoder-decoder (ref the reference's RNMT MT family;
+  arXiv:1804.09849 recipe)."""
+
+  BATCH_SIZE = 64
+  VOCAB = 32000
+  MODEL_DIM = 512
+  NUM_LAYERS = 4
+  SRC_LEN = 96
+  TGT_LEN = 96
+
+  def Train(self):
+    return input_generator.SyntheticMtInput.Params().Set(
+        batch_size=self.BATCH_SIZE, vocab_size=self.VOCAB,
+        src_seq_len=self.SRC_LEN, tgt_seq_len=self.TGT_LEN)
+
+  def Test(self):
+    return self.Train().Set(seed=123)
+
+  def Task(self):
+    from lingvo_tpu.models.mt import rnmt
+    p = rnmt.RNMTModel.Params()
+    p.name = "wmt14_en_de_rnmt"
+    p.encoder.vocab_size = self.VOCAB
+    p.encoder.model_dim = self.MODEL_DIM
+    p.encoder.num_layers = self.NUM_LAYERS
+    p.decoder.vocab_size = self.VOCAB
+    p.decoder.model_dim = self.MODEL_DIM
+    p.decoder.num_layers = self.NUM_LAYERS
+    p.decoder.max_decode_len = self.TGT_LEN
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params().Set(beta2=0.98),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=4000, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=5.0)
+    p.train.tpu_steps_per_loop = 100
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeRNMTPlusTiny(WmtEnDeRNMTPlus):
+  """CPU-smoke scale."""
+
+  BATCH_SIZE = 4
+  VOCAB = 64
+  MODEL_DIM = 16
+  NUM_LAYERS = 2
+  SRC_LEN = 10
+  TGT_LEN = 10
+
+  def Task(self):
+    p = super().Task()
+    p.decoder.atten_hidden_dim = 16
+    p.train.max_steps = 60
+    p.train.tpu_steps_per_loop = 20
+    return p

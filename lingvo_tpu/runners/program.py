@@ -90,7 +90,8 @@ class BaseProgram:
       ip = self.p.task.input
       if ip is None:
         raise ValueError(f"Program {self.p.name}: no input params")
-      self._input = ip.Instantiate()
+      from lingvo_tpu.core import input_policy
+      self._input = input_policy.Apply(ip).Instantiate()
     return self._input
 
   def _PutBatch(self, batch: NestedMap) -> NestedMap:

@@ -47,7 +47,8 @@ def _BuildSchedule(model_params, args):
     ep = program_lib.EvalProgram.Params().Set(
         task=task_p, logdir=args.logdir, dataset_name=ds,
         name=f"eval_{ds.lower()}")
-    input_generators[ds] = ds_params.Instantiate()
+    from lingvo_tpu.core import input_policy
+    input_generators[ds] = input_policy.Apply(ds_params).Instantiate()
     eval_programs.append(ep)
     if has_decode and ds == "Test":
       eval_programs.append(program_lib.DecodeProgram.Params().Set(

@@ -1,0 +1,108 @@
+"""Insertion framework tests (ref insertion_test.py coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import insertion
+
+KEY = jax.random.PRNGKey(17)
+
+
+class TestSequenceUtils:
+
+  def test_trim_last_token(self):
+    x = jnp.array([[1, 2, 3, 0], [4, 5, 0, 0]])
+    pads = jnp.array([[0, 0, 0, 1], [0, 0, 1, 1]], jnp.float32)
+    y, ypads = insertion.SequenceTrimLastToken(x, pads)
+    np.testing.assert_array_equal(np.asarray(y), [[1, 2, 0, 0], [4, 0, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(ypads),
+                                  [[0, 0, 1, 1], [0, 1, 1, 1]])
+
+  def test_append_token(self):
+    x = jnp.array([[1, 2, 0, 0]])
+    pads = jnp.array([[0, 0, 1, 1]], jnp.float32)
+    y, ypads = insertion.SequenceAppendToken(x, pads, 9)
+    np.testing.assert_array_equal(np.asarray(y), [[1, 2, 9, 0]])
+    np.testing.assert_array_equal(np.asarray(ypads), [[0, 0, 0, 1]])
+
+  def test_append_token_extend(self):
+    x = jnp.array([[1, 2]])
+    pads = jnp.zeros((1, 2), jnp.float32)
+    y, ypads = insertion.SequenceAppendToken(x, pads, 9, extend=True)
+    np.testing.assert_array_equal(np.asarray(y), [[1, 2, 9]])
+    np.testing.assert_array_equal(np.asarray(ypads), [[0, 0, 0]])
+
+  def test_concat(self):
+    x = jnp.array([[1, 2, 0]])
+    xp = jnp.array([[0, 0, 1]], jnp.float32)
+    y = jnp.array([[7, 8]])
+    yp = jnp.array([[0, 1]], jnp.float32)
+    z, zp = insertion.SequenceConcat(x, xp, y, yp)
+    np.testing.assert_array_equal(np.asarray(z), [[1, 2, 7, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(zp), [[0, 0, 0, 1, 1]])
+
+
+class TestSymbolInsertionLayer:
+
+  def _mk(self):
+    layer = insertion.SymbolInsertionLayer.Params().Set(
+        name="ins").Instantiate()
+    layer.FinalizePaths()
+    return layer
+
+  def test_canvas_is_subset_preserving_order(self):
+    layer = self._mk()
+    x = jnp.array([[11, 12, 13, 14, 15, 16], [21, 22, 23, 0, 0, 0]])
+    pads = jnp.array([[0, 0, 0, 0, 0, 0], [0, 0, 0, 1, 1, 1]], jnp.float32)
+    out = layer.FProp(None, x, pads, key=KEY)
+    c = np.asarray(out.canvas)
+    cp = np.asarray(out.canvas_paddings)
+    for b in range(2):
+      valid = c[b][cp[b] == 0]
+      # canvas tokens appear in x's order
+      src = list(np.asarray(x)[b])
+      idx = [src.index(v) for v in valid]
+      assert idx == sorted(idx)
+      assert len(valid) >= 1
+
+  def test_force_last_token_in_canvas(self):
+    layer = self._mk()
+    x = jnp.array([[11, 12, 13, 14]])
+    pads = jnp.zeros((1, 4), jnp.float32)
+    for seed in range(5):
+      out = layer.FProp(None, x, pads, key=jax.random.PRNGKey(seed))
+      valid = np.asarray(out.canvas)[0][np.asarray(out.canvas_paddings)[0]
+                                        == 0]
+      assert 14 in valid  # last token always observed
+
+  def test_targets_cover_unobserved_tokens(self):
+    layer = self._mk()
+    x = jnp.array([[11, 12, 13, 14, 15]])
+    pads = jnp.zeros((1, 5), jnp.float32)
+    out = layer.FProp(None, x, pads, eos_id=2, key=KEY)
+    tt = np.asarray(out.target_tokens)[0]
+    tw = np.asarray(out.target_weights)[0]
+    canvas_valid = np.asarray(out.canvas)[0][
+        np.asarray(out.canvas_paddings)[0] == 0]
+    xs = np.asarray(x)[0]
+    for i, tok in enumerate(xs):
+      if tok in canvas_valid:
+        assert tt[i] == 2  # observed -> eos target
+      else:
+        assert tt[i] == tok and tw[i] == 1.0  # real insertion target
+
+  def test_jits(self):
+    layer = self._mk()
+    x = jnp.array([[11, 12, 13, 14]])
+    pads = jnp.zeros((1, 4), jnp.float32)
+    out = jax.jit(lambda x, p: layer.FProp(None, x, p, key=KEY))(x, pads)
+    assert out.canvas.shape == (1, 4)
+
+  def test_slots_monotonic(self):
+    layer = self._mk()
+    x = jnp.arange(1, 9)[None, :]
+    pads = jnp.zeros((1, 8), jnp.float32)
+    out = layer.FProp(None, x, pads, key=KEY)
+    slots = np.asarray(out.target_slots)[0]
+    assert np.all(np.diff(slots) >= 0)

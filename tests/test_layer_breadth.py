@@ -145,6 +145,38 @@ class TestLocalSelfAttentionXL:
     np.testing.assert_allclose(
         np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5)
 
+  def test_bias_math_matches_reference_loop(self):
+    """The einsum bias == an explicit per-head loop (guards subscript
+    typos: einsum is case-sensitive, so 'nh' vs 'NH' silently sums out
+    the head axes instead of contracting them)."""
+    import math as pymath
+    layer, theta = self._mk_xl()
+    w = layer.p.block_size
+    n, h = layer.p.num_heads, layer._dim_per_head
+    B, L = 1, 2
+    qb = jax.random.normal(KEY, (B, L, w, n, h))
+    kb = jax.random.normal(jax.random.PRNGKey(8), (B, L, 3 * w, n, h))
+    rel = (jnp.arange(3 * w)[None, :] - w) - jnp.arange(w)[:, None]
+    out = layer._AddRelPositionBias(theta, qb, kb, rel,
+                                    jnp.zeros((B, L, n, w, 3 * w)))
+    # reference: loop over heads/positions
+    th = theta
+    scale = 1.0 / pymath.sqrt(h)
+    sin_emb = attention_variants._SinusoidRelEmbedding(
+        jnp.arange(-(2 * w - 1), 2 * w), layer.p.input_dim)
+    r = jnp.einsum("rd,dnh->rnh", sin_emb, th.w_rel)
+    expect = np.zeros((B, L, n, w, 3 * w), np.float32)
+    for ni in range(n):
+      for qi in range(w):
+        for ki in range(3 * w):
+          ridx = int(rel[qi, ki]) + 2 * w - 1
+          content = scale * float(th.u_bias[ni] @ kb[0, 0, ki, ni])
+          pos = float((qb[0, 0, qi, ni] + scale * th.v_bias[ni])
+                      @ r[ridx, ni])
+          expect[0, 0, ni, qi, ki] = content + pos
+    np.testing.assert_allclose(np.asarray(out)[:, :1], expect[:, :1],
+                               rtol=2e-4, atol=2e-4)
+
   def test_position_bias_changes_logits(self):
     """XL bias must make outputs differ from the plain local attention with
     identical projection weights."""

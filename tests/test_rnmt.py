@@ -1,0 +1,54 @@
+"""RNMT+ MT model tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu import model_registry
+import lingvo_tpu.models.all_params  # noqa: F401
+
+
+class TestRnmt:
+
+  def _setup(self):
+    mp = model_registry.GetParams("mt.wmt14_en_de.WmtEnDeRNMTPlusTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    return task, state, batch
+
+  def test_trains(self):
+    task, state, batch = self._setup()
+    step = jax.jit(task.TrainStep, donate_argnums=(0,))
+    losses = []
+    for _ in range(8):
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+  def test_greedy_decode_and_bleu(self):
+    task, state, batch = self._setup()
+    out = jax.jit(task.Decode)(state.theta, batch)
+    assert out.topk_ids.shape[1] == 1       # single greedy hyp
+    metrics = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(out, metrics)
+    res = task.DecodeFinalize(metrics)
+    assert "corpus_bleu" in res
+
+  def test_decode_stops_at_eos(self):
+    task, state, batch = self._setup()
+    out = task.Decode(state.theta, batch)
+    ids = np.asarray(out.topk_ids)[:, 0, :]
+    lens = np.asarray(out.topk_lens)[:, 0]
+    eos = task.dec.p.eos_id
+    for i in range(ids.shape[0]):
+      # after the first eos, everything is eos (done rows freeze)
+      where = np.where(ids[i] == eos)[0]
+      if len(where):
+        assert np.all(ids[i, where[0]:] == eos)
+        assert lens[i] <= where[0] + 1
