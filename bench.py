@@ -204,7 +204,8 @@ def _BenchMoE(jax, jnp, model_registry, on_tpu):
   task = mp.task.Instantiate()
   task.FinalizePaths()
   state = task.CreateTrainState(jax.random.PRNGKey(0))
-  gen = mp.input.Instantiate()
+  from lingvo_tpu.core import input_policy
+  gen = input_policy.Instantiate(mp.input)
   batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
   step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
 
@@ -272,7 +273,8 @@ def main():
   task = mp.task.Instantiate()
   task.FinalizePaths()
   state = task.CreateTrainState(jax.random.PRNGKey(0))
-  gen = mp.input.Instantiate()
+  from lingvo_tpu.core import input_policy
+  gen = input_policy.Instantiate(mp.input)
   batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
 
   from lingvo_tpu.core import py_utils

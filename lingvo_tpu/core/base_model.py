@@ -265,7 +265,7 @@ class BaseTask(base_layer.BaseLayer):
     if self._input_params is None:
       raise ValueError(f"Task {self.p.name} has no input params")
     from lingvo_tpu.core import input_policy
-    return input_policy.Apply(self._input_params).Instantiate()
+    return input_policy.Instantiate(self._input_params)
 
 
 class BaseModel(base_layer.BaseLayer):

@@ -35,3 +35,13 @@ def Apply(input_params):
         f"but the cluster has {num_shards} infeed hosts; add them (see "
         f"BaseInputGenerator) or run single-host input.")
   return input_params.Copy().Set(num_hosts=num_shards, host_index=shard)
+
+
+def Instantiate(input_params):
+  """The one chokepoint for turning input params into a generator.
+
+  Every runner/task/tool must instantiate input generators through here
+  (never `params.Instantiate()` directly) so multi-host shard stamping is
+  never skipped.
+  """
+  return Apply(input_params).Instantiate()

@@ -1,0 +1,444 @@
+"""SentencePiece model inference without the `sentencepiece` library.
+
+The reference wraps the SentencePiece C++ library for its spm tokenizers
+(`lingvo/core/tokenizers.py` SentencePieceTokenizer, `gshard_utils.LoadSpm`
+at `gshard_utils.py:448`). That library is not available in this image, so
+this module implements the inference half from scratch:
+
+  * a minimal protobuf wire-format parser for `sentencepiece_model.proto`
+    (ModelProto → pieces [piece, score, type], TrainerSpec model_type and
+    unk/bos/eos/pad ids, NormalizerSpec whitespace options);
+  * unigram-LM segmentation via Viterbi over a piece dictionary;
+  * BPE segmentation via the standard best-scoring-adjacent-merge loop;
+  * byte-fallback (`<0xXX>` pieces) for out-of-vocab characters;
+  * decoding back to text (▁ → space, byte pieces → utf-8);
+  * a writer + tiny unigram trainer so tests and `tools/build_vocab.py`
+    can produce real `.model` files.
+
+Only inference-quality parity is targeted (same segmentation rules), not
+training parity (no EM pruning, no precompiled normalizer charsmap — text
+is assumed already unicode-normalized).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+_WS = "▁"  # ▁ (LOWER ONE EIGHTH BLOCK), sentencepiece whitespace marker
+
+# SentencePiece.Type enum values (sentencepiece_model.proto).
+NORMAL = 1
+UNKNOWN = 2
+CONTROL = 3
+USER_DEFINED = 4
+UNUSED = 5
+BYTE = 6
+
+# TrainerSpec.ModelType enum values.
+UNIGRAM = 1
+BPE = 2
+WORD = 3
+CHAR = 4
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire format (just enough for sentencepiece_model.proto)
+# ---------------------------------------------------------------------------
+
+
+def _ReadVarint(buf: bytes, pos: int) -> Tuple[int, int]:
+  result = 0
+  shift = 0
+  while True:
+    b = buf[pos]
+    pos += 1
+    result |= (b & 0x7F) << shift
+    if not b & 0x80:
+      return result, pos
+    shift += 7
+    if shift > 63:
+      raise ValueError("varint too long (corrupt model file)")
+
+
+def _IterFields(buf: bytes):
+  """Yields (field_number, wire_type, value) over a serialized message.
+
+  wire types: 0 varint (value int), 1 fixed64 (bytes), 2 length-delimited
+  (bytes), 5 fixed32 (bytes). Groups (3/4) are rejected.
+  """
+  pos = 0
+  n = len(buf)
+  while pos < n:
+    key, pos = _ReadVarint(buf, pos)
+    field, wire = key >> 3, key & 7
+    if wire == 0:
+      val, pos = _ReadVarint(buf, pos)
+    elif wire == 1:
+      val, pos = buf[pos:pos + 8], pos + 8
+    elif wire == 2:
+      ln, pos = _ReadVarint(buf, pos)
+      val, pos = buf[pos:pos + ln], pos + ln
+    elif wire == 5:
+      val, pos = buf[pos:pos + 4], pos + 4
+    else:
+      raise ValueError(f"unsupported wire type {wire} (corrupt model file)")
+    yield field, wire, val
+
+
+def _Varint(v: int) -> bytes:
+  out = bytearray()
+  while True:
+    b = v & 0x7F
+    v >>= 7
+    if v:
+      out.append(b | 0x80)
+    else:
+      out.append(b)
+      return bytes(out)
+
+
+def _Key(field: int, wire: int) -> bytes:
+  return _Varint((field << 3) | wire)
+
+
+def _LenDelim(field: int, payload: bytes) -> bytes:
+  return _Key(field, 2) + _Varint(len(payload)) + payload
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+
+class SentencePieceModel:
+  """Parsed .model file + encode/decode.
+
+  Attributes:
+    pieces: list of (piece_str, score, type).
+    model_type: UNIGRAM or BPE (WORD/CHAR degenerate to whole-word/char).
+    unk_id / bos_id / eos_id / pad_id: special ids from TrainerSpec.
+  """
+
+  def __init__(self, pieces: List[Tuple[str, float, int]],
+               model_type: int = UNIGRAM, unk_id: int = 0, bos_id: int = 1,
+               eos_id: int = 2, pad_id: int = -1, add_dummy_prefix: bool = True,
+               remove_extra_whitespaces: bool = True,
+               escape_whitespaces: bool = True):
+    self.pieces = pieces
+    self.model_type = model_type
+    self.unk_id = unk_id
+    self.bos_id = bos_id
+    self.eos_id = eos_id
+    self.pad_id = pad_id
+    self.add_dummy_prefix = add_dummy_prefix
+    self.remove_extra_whitespaces = remove_extra_whitespaces
+    self.escape_whitespaces = escape_whitespaces
+
+    self._piece_to_id: Dict[str, int] = {}
+    self._byte_ids: Dict[int, int] = {}
+    self._max_piece_len = 1
+    scores = []
+    for i, (piece, score, typ) in enumerate(pieces):
+      if typ == BYTE:
+        try:
+          self._byte_ids[int(piece[1:-1], 16)] = i  # "<0xAB>"
+        except ValueError:
+          pass
+      if typ in (NORMAL, USER_DEFINED, BYTE):
+        self._piece_to_id.setdefault(piece, i)
+        self._max_piece_len = max(self._max_piece_len, len(piece))
+        scores.append(score)
+    # OOV single characters score worse than any real piece (the library's
+    # unk penalty: min_score - 10).
+    self._unk_score = (min(scores) if scores else 0.0) - 10.0
+
+  @property
+  def vocab_size(self) -> int:
+    return len(self.pieces)
+
+  # -- parse / serialize ----------------------------------------------------
+
+  @classmethod
+  def FromFile(cls, path: str) -> "SentencePieceModel":
+    with open(path, "rb") as f:
+      return cls.FromBytes(f.read())
+
+  @classmethod
+  def FromBytes(cls, buf: bytes) -> "SentencePieceModel":
+    pieces: List[Tuple[str, float, int]] = []
+    kwargs = {}
+    for field, wire, val in _IterFields(buf):
+      if field == 1 and wire == 2:  # repeated SentencePiece pieces
+        piece, score, typ = "", 0.0, NORMAL
+        for f2, w2, v2 in _IterFields(val):
+          if f2 == 1 and w2 == 2:
+            piece = v2.decode("utf-8")
+          elif f2 == 2 and w2 == 5:
+            score = struct.unpack("<f", v2)[0]
+          elif f2 == 3 and w2 == 0:
+            typ = v2
+        pieces.append((piece, score, typ))
+      elif field == 2 and wire == 2:  # TrainerSpec
+        for f2, w2, v2 in _IterFields(val):
+          if w2 != 0:
+            continue
+          if f2 == 3:
+            kwargs["model_type"] = v2
+          elif f2 == 40:
+            kwargs["unk_id"] = _ToSigned(v2)
+          elif f2 == 41:
+            kwargs["bos_id"] = _ToSigned(v2)
+          elif f2 == 42:
+            kwargs["eos_id"] = _ToSigned(v2)
+          elif f2 == 43:
+            kwargs["pad_id"] = _ToSigned(v2)
+      elif field == 3 and wire == 2:  # NormalizerSpec
+        for f2, w2, v2 in _IterFields(val):
+          if w2 != 0:
+            continue
+          if f2 == 3:
+            kwargs["add_dummy_prefix"] = bool(v2)
+          elif f2 == 4:
+            kwargs["remove_extra_whitespaces"] = bool(v2)
+          elif f2 == 5:
+            kwargs["escape_whitespaces"] = bool(v2)
+    return cls(pieces, **kwargs)
+
+  def ToBytes(self) -> bytes:
+    out = bytearray()
+    for piece, score, typ in self.pieces:
+      body = _LenDelim(1, piece.encode("utf-8"))
+      body += _Key(2, 5) + struct.pack("<f", score)
+      body += _Key(3, 0) + _Varint(typ)
+      out += _LenDelim(1, bytes(body))
+    trainer = (_Key(3, 0) + _Varint(self.model_type)
+               + _Key(40, 0) + _FromSigned(self.unk_id)
+               + _Key(41, 0) + _FromSigned(self.bos_id)
+               + _Key(42, 0) + _FromSigned(self.eos_id)
+               + _Key(43, 0) + _FromSigned(self.pad_id))
+    out += _LenDelim(2, trainer)
+    norm = (_Key(3, 0) + _Varint(int(self.add_dummy_prefix))
+            + _Key(4, 0) + _Varint(int(self.remove_extra_whitespaces))
+            + _Key(5, 0) + _Varint(int(self.escape_whitespaces)))
+    out += _LenDelim(3, norm)
+    return bytes(out)
+
+  def Save(self, path: str) -> None:
+    with open(path, "wb") as f:
+      f.write(self.ToBytes())
+
+  # -- encode ---------------------------------------------------------------
+
+  def _Normalize(self, text: str) -> str:
+    if self.remove_extra_whitespaces:
+      text = " ".join(text.split())
+    if self.add_dummy_prefix:
+      text = " " + text
+    if self.escape_whitespaces:
+      text = text.replace(" ", _WS)
+    return text
+
+  def EncodeAsIds(self, text: str) -> List[int]:
+    return [pid for _, pid in self._Segment(self._Normalize(text))]
+
+  def EncodeAsPieces(self, text: str) -> List[str]:
+    return [s for s, _ in self._Segment(self._Normalize(text))]
+
+  def _Segment(self, text: str) -> List[Tuple[str, int]]:
+    if not text:
+      return []
+    if self.model_type == BPE:
+      return self._SegmentBpe(text)
+    if self.model_type == CHAR:
+      return [self._LookupOrUnk(c) for c in text]
+    if self.model_type == WORD:
+      return [self._LookupOrUnk(w) for w in text.split(_WS) if w]
+    return self._SegmentUnigram(text)
+
+  def _LookupOrUnk(self, piece: str) -> Tuple[str, int]:
+    pid = self._piece_to_id.get(piece)
+    if pid is not None:
+      return piece, pid
+    return piece, self.unk_id
+
+  def _ByteFallback(self, ch: str) -> List[Tuple[str, int]]:
+    if not self._byte_ids:
+      return [(ch, self.unk_id)]
+    out = []
+    for b in ch.encode("utf-8"):
+      bid = self._byte_ids.get(b)
+      out.append((self.pieces[bid][0] if bid is not None else ch,
+                  bid if bid is not None else self.unk_id))
+    return out
+
+  def _SegmentUnigram(self, text: str) -> List[Tuple[str, int]]:
+    """Viterbi best segmentation by summed piece scores (log probs)."""
+    n = len(text)
+    best = [-math.inf] * (n + 1)
+    back: List[Tuple[int, int]] = [(-1, -1)] * (n + 1)  # (start, piece_id)
+    best[0] = 0.0
+    lookup = self._piece_to_id
+    maxlen = self._max_piece_len
+    for end in range(1, n + 1):
+      for start in range(max(0, end - maxlen), end):
+        if best[start] == -math.inf:
+          continue
+        pid = lookup.get(text[start:end])
+        if pid is not None:
+          s = best[start] + self.pieces[pid][1]
+          if s > best[end]:
+            best[end], back[end] = s, (start, pid)
+      if best[end] == -math.inf and end >= 1:
+        # single-char unk hop keeps the lattice connected
+        s = best[end - 1] + self._unk_score
+        if s > best[end]:
+          best[end], back[end] = s, (end - 1, -1)
+    out: List[Tuple[str, int]] = []
+    end = n
+    while end > 0:
+      start, pid = back[end]
+      if pid >= 0:
+        out.append((text[start:end], pid))
+      else:
+        out[len(out):] = reversed(self._ByteFallback(text[start:end]))
+      end = start
+    out.reverse()
+    return out
+
+  def _SegmentBpe(self, text: str) -> List[Tuple[str, int]]:
+    """Iteratively merge the adjacent pair whose merged piece scores best
+    (sentencepiece BPE: scores encode -merge_rank, so max score = earliest
+    learned merge)."""
+    symbols = list(text)
+    while len(symbols) > 1:
+      best_score, best_i = -math.inf, -1
+      for i in range(len(symbols) - 1):
+        pid = self._piece_to_id.get(symbols[i] + symbols[i + 1])
+        if pid is not None and self.pieces[pid][1] > best_score:
+          best_score, best_i = self.pieces[pid][1], i
+      if best_i < 0:
+        break
+      symbols[best_i:best_i + 2] = [symbols[best_i] + symbols[best_i + 1]]
+    out: List[Tuple[str, int]] = []
+    for s in symbols:
+      pid = self._piece_to_id.get(s)
+      if pid is not None:
+        out.append((s, pid))
+      else:
+        out.extend(self._ByteFallback(s))
+    return out
+
+  # -- decode ---------------------------------------------------------------
+
+  def DecodeIds(self, ids: Sequence[int]) -> str:
+    parts: List[str] = []
+    byte_run: List[int] = []
+
+    def _FlushBytes():
+      if byte_run:
+        parts.append(bytes(byte_run).decode("utf-8", errors="replace"))
+        byte_run.clear()
+
+    for i in ids:
+      if i < 0 or i >= len(self.pieces):
+        continue
+      piece, _, typ = self.pieces[i]
+      if typ == BYTE:
+        byte_run.append(int(piece[1:-1], 16))
+        continue
+      _FlushBytes()
+      if typ in (CONTROL, UNUSED):
+        continue
+      if typ == UNKNOWN:
+        parts.append(" ⁇ ")  # the library renders unk as ⁇
+        continue
+      parts.append(piece)
+    _FlushBytes()
+    text = "".join(parts)
+    if self.escape_whitespaces:
+      text = text.replace(_WS, " ")
+    return text.lstrip(" ") if self.add_dummy_prefix else text
+
+
+def _ToSigned(v: int) -> int:
+  return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _FromSigned(v: int) -> bytes:
+  return _Varint(v + (1 << 64) if v < 0 else v)
+
+
+# ---------------------------------------------------------------------------
+# Tiny trainer (frequency-scored unigram; for tests and build_vocab tool)
+# ---------------------------------------------------------------------------
+
+
+def TrainUnigramModel(texts, vocab_size: int,
+                      byte_fallback: bool = False,
+                      specials: Sequence[str] = ("<unk>", "<s>", "</s>"),
+                      ) -> SentencePieceModel:
+  """Builds a usable unigram .model from a corpus.
+
+  Not the library's EM-pruned trainer — pieces are the corpus' characters
+  plus its most frequent words/word-prefixes (▁-marked), scored by log
+  relative frequency. Good enough to exercise real spm files end-to-end.
+
+  `vocab_size` is a hard cap: specials and byte pieces are budgeted first,
+  then characters by frequency, then substrings. `specials` are emitted
+  first in order; `<unk>` is typed UNKNOWN, `<pad>`/`<s>`/`</s>` and other
+  bracketed tokens CONTROL, and unk/bos/eos/pad ids are taken from their
+  positions (matching the words-format convention of specials-first).
+  """
+  char_counts: collections.Counter = collections.Counter()
+  sub_counts: collections.Counter = collections.Counter()
+  for text in texts:
+    for word in text.split():
+      marked = _WS + word
+      char_counts.update(marked)
+      for ln in range(2, min(len(marked), 16) + 1):
+        sub_counts[marked[:ln]] += 1
+      for ln in range(2, min(len(word), 8) + 1):  # word-internal suffixes
+        sub_counts[word[-ln:]] += 1
+
+  if "<unk>" not in specials:
+    raise ValueError("specials must include '<unk>' (OOV pieces need an id)")
+  pieces: List[Tuple[str, float, int]] = [
+      (s, 0.0, UNKNOWN if s == "<unk>" else CONTROL) for s in specials]
+  ids = {s: i for i, s in enumerate(specials)}
+  if byte_fallback:
+    pieces += [(f"<0x{b:02X}>", 0.0, BYTE) for b in range(256)]
+  if len(pieces) >= vocab_size:
+    raise ValueError(
+        f"vocab_size={vocab_size} cannot even hold the {len(pieces)} "
+        "special/byte pieces")
+  total = sum(char_counts.values()) + sum(sub_counts.values()) or 1
+
+  def _Score(count: int) -> float:
+    return math.log(count / total)
+
+  seen = set()
+  budget = vocab_size - len(pieces)
+  for ch, c in char_counts.most_common():
+    if budget <= 0:
+      break  # rarest chars fall to unk/byte-fallback, vocab_size is a cap
+    pieces.append((ch, _Score(c), NORMAL))
+    seen.add(ch)
+    budget -= 1
+  # Longer frequent substrings score higher than their chars combined, so
+  # Viterbi prefers them; break count ties toward longer pieces.
+  ranked = sorted(sub_counts.items(), key=lambda kv: (-kv[1], -len(kv[0])))
+  for sub, c in ranked:
+    if budget <= 0:
+      break
+    if sub in seen:
+      continue
+    pieces.append((sub, _Score(c) + 0.1 * len(sub), NORMAL))
+    seen.add(sub)
+    budget -= 1
+  return SentencePieceModel(
+      pieces, model_type=UNIGRAM, unk_id=ids.get("<unk>", -1),
+      bos_id=ids.get("<s>", -1), eos_id=ids.get("</s>", -1),
+      pad_id=ids.get("<pad>", -1))

@@ -185,3 +185,70 @@ class BpeTokenizer(_FileBackedTokenizer):
     from lingvo_tpu.ops import native
     return native.BpeTokenizer(self.p.codes_filepath, self.p.vocab_filepath,
                                self.p.unk_token)
+
+
+class _SpmAdapter:
+  """Adapts core.sentencepiece.SentencePieceModel to the native-tokenizer
+  (StringsToIds/IdsToStrings over fixed-width arrays) interface."""
+
+  def __init__(self, model):
+    self.model = model
+    self.vocab_size = model.vocab_size
+
+  def StringsToIds(self, texts, max_len):
+    b = len(texts)
+    ids = np.zeros((b, max_len), np.int32)
+    paddings = np.ones((b, max_len), np.float32)
+    for i, text in enumerate(texts):
+      row = self.model.EncodeAsIds(text)[:max_len]
+      ids[i, :len(row)] = row
+      paddings[i, :len(row)] = 0.0
+    return ids, paddings
+
+  def IdsToStrings(self, ids, lens):
+    return [self.model.DecodeIds([int(t) for t in ids[i, :int(lens[i])]])
+            for i in range(len(ids))]
+
+
+class SentencePieceTokenizer(_FileBackedTokenizer):
+  """SentencePiece .model tokenizer (ref `tokenizers.py`
+  SentencePieceTokenizer / `gshard_utils.py:448` LoadSpm), backed by the
+  from-scratch model reader in `core/sentencepiece.py` (unigram Viterbi /
+  BPE merges, byte fallback) — no external spm library needed.
+
+  `vocab_filepath` points at the serialized `.model` file. sos/eos/unk ids
+  default to -1 = "take the model's TrainerSpec value" (resolved lazily on
+  first use, like the sibling tokenizers' file loads); set them explicitly
+  to override the model file. A model without a usable id (e.g. T5-style
+  bos_id=-1) fails loudly rather than framing with a wrong id.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.target_sos_id = -1
+    p.target_eos_id = -1
+    p.target_unk_id = -1
+    return p
+
+  def _Load(self):
+    from lingvo_tpu.core import sentencepiece as spm
+    impl = _SpmAdapter(spm.SentencePieceModel.FromFile(self.p.vocab_filepath))
+    m, p = impl.model, self.p
+    for attr, mid in (("target_sos_id", m.bos_id), ("target_eos_id", m.eos_id),
+                      ("target_unk_id", m.unk_id)):
+      if getattr(p, attr) < 0:  # -1 = defer to the model file
+        if mid < 0:
+          raise ValueError(
+              f"{p.vocab_filepath}: model defines no id for {attr} "
+              f"(TrainerSpec value {mid}); set p.{attr} explicitly")
+        setattr(p, attr, mid)
+    return impl
+
+  def StringsToIds(self, texts, max_length: int):
+    self.impl  # resolve special ids from the model before framing
+    return super().StringsToIds(texts, max_length)
+
+  def IdsToStrings(self, ids, lens=None):
+    self.impl  # resolve special ids before sos/eos stripping
+    return super().IdsToStrings(ids, lens)

@@ -99,38 +99,55 @@ def AveragePrecision(matches: list[tuple[float, bool]],
 
 
 class ApMetric:
-  """Accumulates rotated-IoU-matched detections across batches."""
+  """Accumulates rotated-IoU-matched detections across batches.
+
+  Class-aware when Update is given per-box class labels (ref
+  `ap_metric.py` computes AP per metadata class then averages): detections
+  only match ground truth of the same class, and `value` is the mean AP
+  over classes that have ground truth. Without labels everything lands in
+  one class bucket (class-agnostic AP)."""
 
   def __init__(self, iou_threshold: float = 0.5):
     self._iou = iou_threshold
-    self._matches: list[tuple[float, bool]] = []
-    self._num_gt = 0
+    self._matches: dict[int, list[tuple[float, bool]]] = {}
+    self._num_gt: dict[int, int] = {}
 
   def Update(self, pred_boxes: np.ndarray, pred_scores: np.ndarray,
-             gt_boxes: np.ndarray):
+             gt_boxes: np.ndarray, pred_classes: np.ndarray = None,
+             gt_classes: np.ndarray = None):
     """pred_boxes [P, 5+], pred_scores [P], gt_boxes [G, 5+] (one scene);
-    greedy score-ordered matching, one detection per gt."""
-    self._num_gt += len(gt_boxes)
+    greedy score-ordered matching per class, one detection per gt."""
+    if pred_classes is None:
+      pred_classes = np.zeros((len(pred_boxes),), np.int32)
+    if gt_classes is None:
+      gt_classes = np.zeros((len(gt_boxes),), np.int32)
+    for c in np.unique(gt_classes):
+      self._num_gt[int(c)] = self._num_gt.get(int(c), 0) + int(
+          np.sum(gt_classes == c))
     order = np.argsort(-np.asarray(pred_scores))
     taken = set()
     for i in order:
+      cls = int(pred_classes[i])
       best_iou, best_j = 0.0, -1
       for j in range(len(gt_boxes)):
-        if j in taken:
+        if j in taken or int(gt_classes[j]) != cls:
           continue
         iou = RotatedIou(pred_boxes[i], gt_boxes[j])
         if iou > best_iou:
           best_iou, best_j = iou, j
-      if best_iou >= self._iou and best_j >= 0:
+      matched = best_iou >= self._iou and best_j >= 0
+      if matched:
         taken.add(best_j)
-        self._matches.append((float(pred_scores[i]), True))
-      else:
-        self._matches.append((float(pred_scores[i]), False))
+      self._matches.setdefault(cls, []).append(
+          (float(pred_scores[i]), matched))
 
   @property
   def value(self) -> float:
-    return AveragePrecision(self._matches, self._num_gt)
+    """Mean AP over classes with ground truth."""
+    aps = [AveragePrecision(self._matches.get(c, []), n)
+           for c, n in self._num_gt.items() if n > 0]
+    return float(np.mean(aps)) if aps else 0.0
 
   @property
   def num_ground_truth(self) -> int:
-    return self._num_gt
+    return sum(self._num_gt.values())

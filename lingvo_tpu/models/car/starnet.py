@@ -90,7 +90,8 @@ class NeighborhoodFeaturizer(base_layer.BaseLayer):
     d2 = jnp.sum(
         (xyz[:, None, :, :] - centers[:, :, None, :]) ** 2, axis=-1)
     d2 = jnp.where(paddings[:, None, :] > 0, 1e9, d2)      # [b, c, m]
-    _, nn_idx = jax.lax.top_k(-d2, p.num_neighbors)        # [b, c, k]
+    k = min(p.num_neighbors, d2.shape[-1])  # scenes may have < K points
+    _, nn_idx = jax.lax.top_k(-d2, k)                      # [b, c, k]
     nn_pts = jnp.take_along_axis(
         points[:, None], nn_idx[..., None], axis=2)        # [b, c, k, d]
     nn_pad = jnp.take_along_axis(paddings[:, None], nn_idx, axis=2)
@@ -101,7 +102,11 @@ class NeighborhoodFeaturizer(base_layer.BaseLayer):
       fc = getattr(self, f"fc_{i}")
       h = fc.FProp(self.ChildTheta(theta, f"fc_{i}"), h)
     h = jnp.where(nn_pad[..., None] > 0, -1e9, h)
-    return jnp.max(h, axis=2), centers                     # [b, c, F]
+    pooled = jnp.max(h, axis=2)                            # [b, c, F]
+    # a center whose K neighbors are ALL padding (scene with < K valid
+    # points) must emit 0, not -1e9, or it poisons the trunk with inf/NaN
+    all_pad = jnp.min(nn_pad, axis=2) > 0                  # [b, c]
+    return jnp.where(all_pad[..., None], 0.0, pooled), centers
 
 
 class StarNetModel(base_model.BaseTask):
@@ -280,13 +285,16 @@ class StarNetModel(base_model.BaseTask):
     import numpy as np
     boxes = np.asarray(decode_out.boxes)
     scores = np.asarray(decode_out.scores)
+    classes = np.asarray(decode_out.classes)
     gt_boxes = np.asarray(decode_out.gt_boxes)
     gt_classes = np.asarray(decode_out.gt_classes)
     for i in range(boxes.shape[0]):
       gt_mask = gt_classes[i] > 0
       valid = scores[i] > 0.0  # NMS pads exhausted scenes with score 0
       decoder_metrics["ap"].Update(boxes[i][valid], scores[i][valid],
-                                   gt_boxes[i][gt_mask])
+                                   gt_boxes[i][gt_mask],
+                                   pred_classes=classes[i][valid],
+                                   gt_classes=gt_classes[i][gt_mask])
 
   def DecodeFinalize(self, decoder_metrics):
     return {"ap": decoder_metrics["ap"].value}

@@ -67,8 +67,12 @@ class TransformerModel(base_model.BaseTask):
         "examples": metrics_lib.AverageMetric(),
     }
 
+  def _DecodeEosId(self):
+    """Eos id used to trim hyps/refs; decoder-family-specific."""
+    return self.dec.p.beam_search.target_eos_id
+
   def PostProcessDecodeOut(self, decode_out, decoder_metrics):
-    eos = self.dec.p.beam_search.target_eos_id
+    eos = self._DecodeEosId()
     best = np.asarray(decode_out.topk_ids[:, 0, :])
     lens = np.asarray(decode_out.topk_lens[:, 0])
     labels = np.asarray(decode_out.target_labels)

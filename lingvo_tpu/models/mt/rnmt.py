@@ -222,16 +222,5 @@ class RNMTModel(mt_model.TransformerModel):
         target_labels=input_batch.tgt.labels,
         target_paddings=input_batch.tgt.paddings)
 
-  def PostProcessDecodeOut(self, decode_out, decoder_metrics):
-    import numpy as np
-    eos = self.dec.p.eos_id
-    best = np.asarray(decode_out.topk_ids[:, 0, :])
-    lens = np.asarray(decode_out.topk_lens[:, 0])
-    labels = np.asarray(decode_out.target_labels)
-    pads = np.asarray(decode_out.target_paddings)
-    for i in range(best.shape[0]):
-      hyp = [str(t) for t in best[i, :lens[i]] if t != eos]
-      ref_len = int((1.0 - pads[i]).sum())
-      ref = [str(t) for t in labels[i, :ref_len] if t != eos]
-      decoder_metrics["corpus_bleu"].Update(ref, hyp)
-      decoder_metrics["examples"].Update(1.0)
+  def _DecodeEosId(self):
+    return self.dec.p.eos_id

@@ -91,7 +91,7 @@ class BaseProgram:
       if ip is None:
         raise ValueError(f"Program {self.p.name}: no input params")
       from lingvo_tpu.core import input_policy
-      self._input = input_policy.Apply(ip).Instantiate()
+      self._input = input_policy.Instantiate(ip)
     return self._input
 
   def _PutBatch(self, batch: NestedMap) -> NestedMap:

@@ -48,7 +48,7 @@ def _BuildSchedule(model_params, args):
         task=task_p, logdir=args.logdir, dataset_name=ds,
         name=f"eval_{ds.lower()}")
     from lingvo_tpu.core import input_policy
-    input_generators[ds] = input_policy.Apply(ds_params).Instantiate()
+    input_generators[ds] = input_policy.Instantiate(ds_params)
     eval_programs.append(ep)
     if has_decode and ds == "Test":
       eval_programs.append(program_lib.DecodeProgram.Params().Set(

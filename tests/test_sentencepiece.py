@@ -1,0 +1,158 @@
+"""Tests for the from-scratch SentencePiece model reader/tokenizer."""
+
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import sentencepiece as spm
+from lingvo_tpu.core import tokenizers
+
+
+def _TinyUnigramModel():
+  # Hand-built vocab: specials, chars, and two multi-char pieces that
+  # Viterbi should prefer over per-char segmentation.
+  pieces = [
+      ("<unk>", 0.0, spm.UNKNOWN),
+      ("<s>", 0.0, spm.CONTROL),
+      ("</s>", 0.0, spm.CONTROL),
+      ("▁", -3.0, spm.NORMAL),
+      ("h", -4.0, spm.NORMAL),
+      ("e", -4.0, spm.NORMAL),
+      ("l", -4.0, spm.NORMAL),
+      ("o", -4.0, spm.NORMAL),
+      ("w", -4.0, spm.NORMAL),
+      ("r", -4.0, spm.NORMAL),
+      ("d", -4.0, spm.NORMAL),
+      ("▁hello", -5.0, spm.NORMAL),
+      ("▁world", -5.5, spm.NORMAL),
+  ]
+  return spm.SentencePieceModel(pieces, model_type=spm.UNIGRAM, unk_id=0,
+                                bos_id=1, eos_id=2)
+
+
+class TestProtoRoundTrip:
+
+  def test_bytes_round_trip(self):
+    m = _TinyUnigramModel()
+    m2 = spm.SentencePieceModel.FromBytes(m.ToBytes())
+    assert m2.pieces == [(p, pytest.approx(s), t) for p, s, t in m.pieces]
+    assert (m2.model_type, m2.unk_id, m2.bos_id, m2.eos_id, m2.pad_id) == (
+        spm.UNIGRAM, 0, 1, 2, -1)
+
+  def test_file_round_trip(self, tmp_path):
+    path = str(tmp_path / "tiny.model")
+    _TinyUnigramModel().Save(path)
+    m = spm.SentencePieceModel.FromFile(path)
+    assert m.vocab_size == 13
+    assert m.EncodeAsPieces("hello") == ["▁hello"]
+
+  def test_negative_pad_id_survives(self):
+    m = _TinyUnigramModel()
+    m.pad_id = -1
+    assert spm.SentencePieceModel.FromBytes(m.ToBytes()).pad_id == -1
+
+
+class TestUnigramSegmentation:
+
+  def test_viterbi_prefers_whole_word(self):
+    m = _TinyUnigramModel()
+    # score(▁hello)=-5 beats ▁+h+e+l+l+o = -3-4*5 = -23
+    assert m.EncodeAsPieces("hello world") == ["▁hello", "▁world"]
+
+  def test_falls_back_to_chars(self):
+    m = _TinyUnigramModel()
+    assert m.EncodeAsPieces("hole") == ["▁", "h", "o", "l", "e"]
+
+  def test_unknown_char_gets_unk_id(self):
+    m = _TinyUnigramModel()
+    ids = m.EncodeAsIds("hz")
+    # ▁, h, then z → unk
+    assert ids[-1] == m.unk_id
+
+  def test_whitespace_normalization(self):
+    m = _TinyUnigramModel()
+    assert m.EncodeAsPieces("  hello   world  ") == ["▁hello", "▁world"]
+
+  def test_decode_round_trip(self):
+    m = _TinyUnigramModel()
+    assert m.DecodeIds(m.EncodeAsIds("hello world")) == "hello world"
+
+  def test_decode_skips_control(self):
+    m = _TinyUnigramModel()
+    ids = [1] + m.EncodeAsIds("hello") + [2]
+    assert m.DecodeIds(ids) == "hello"
+
+
+class TestByteFallback:
+
+  def test_oov_char_becomes_bytes_and_back(self):
+    pieces = ([("<unk>", 0.0, spm.UNKNOWN), ("<s>", 0.0, spm.CONTROL),
+               ("</s>", 0.0, spm.CONTROL)]
+              + [(f"<0x{b:02X}>", -8.0, spm.BYTE) for b in range(256)]
+              + [("▁", -2.0, spm.NORMAL), ("a", -2.0, spm.NORMAL)])
+    m = spm.SentencePieceModel(pieces)
+    ids = m.EncodeAsIds("aé")  # é not in vocab → 2 utf-8 byte pieces
+    byte_ids = [i for i in ids if m.pieces[i][2] == spm.BYTE]
+    assert len(byte_ids) == 2
+    assert m.DecodeIds(ids) == "aé"
+
+
+class TestBpeMode:
+
+  def test_merge_order_follows_scores(self):
+    pieces = [
+        ("<unk>", 0.0, spm.UNKNOWN), ("<s>", 0.0, spm.CONTROL),
+        ("</s>", 0.0, spm.CONTROL),
+        ("▁", -1.0, spm.NORMAL), ("a", -1.0, spm.NORMAL),
+        ("b", -1.0, spm.NORMAL), ("ab", -0.5, spm.NORMAL),
+        ("▁ab", -0.25, spm.NORMAL),
+    ]
+    m = spm.SentencePieceModel(pieces, model_type=spm.BPE)
+    assert m.EncodeAsPieces("ab") == ["▁ab"]
+    assert m.EncodeAsPieces("abb") == ["▁ab", "b"]
+
+
+class TestTinyTrainer:
+
+  def test_vocab_size_is_hard_cap(self):
+    corpus = ["abcdefghij klmnop qrstuv wxyz"]
+    m = spm.TrainUnigramModel(corpus, vocab_size=10)
+    assert m.vocab_size <= 10
+    # byte pieces that don't fit raise instead of overflowing
+    with pytest.raises(ValueError, match="cannot even hold"):
+      spm.TrainUnigramModel(corpus, vocab_size=100, byte_fallback=True)
+
+  def test_specials_order_sets_ids(self):
+    m = spm.TrainUnigramModel(["a b"], vocab_size=32,
+                              specials=("<pad>", "<s>", "</s>", "<unk>"))
+    assert (m.pad_id, m.bos_id, m.eos_id, m.unk_id) == (0, 1, 2, 3)
+    with pytest.raises(ValueError, match="<unk>"):
+      spm.TrainUnigramModel(["a"], vocab_size=32, specials=("<s>",))
+
+  def test_trained_model_round_trips(self, tmp_path):
+    corpus = ["the cat sat on the mat", "the dog sat on the log"] * 5
+    m = spm.TrainUnigramModel(corpus, vocab_size=64)
+    assert m.vocab_size <= 64
+    path = str(tmp_path / "trained.model")
+    m.Save(path)
+    m2 = spm.SentencePieceModel.FromFile(path)
+    text = "the cat sat"
+    assert m2.DecodeIds(m2.EncodeAsIds(text)) == text
+    # frequent word "the" should be a single piece
+    assert "▁the" in m2.EncodeAsPieces("the cat")
+
+
+class TestTokenizerLayer:
+
+  def test_strings_to_ids_framing(self, tmp_path):
+    path = str(tmp_path / "tiny.model")
+    _TinyUnigramModel().Save(path)
+    tok = tokenizers.SentencePieceTokenizer.Params().Set(
+        vocab_filepath=path).Instantiate()
+    ids, labels, paddings = tok.StringsToIds(["hello world"], 8)
+    # special ids resolved lazily from the model file's TrainerSpec
+    assert tok.p.target_sos_id == 1 and tok.p.target_eos_id == 2
+    assert ids[0, 0] == 1  # sos
+    n = int((1.0 - paddings[0]).sum()) - 1
+    assert labels[0, n] == 2  # eos
+    np.testing.assert_array_equal(ids[0, 1:n + 1], labels[0, :n])
+    assert tok.IdsToStrings(labels, np.array([n + 1]))[0] == "hello world"

@@ -1,8 +1,13 @@
 #!/usr/bin/env python
-"""Builds a vocab file from text shards (ref `lingvo/tools/wpm_encode_file.py`
-/ vocab generation tools): counts whitespace tokens, writes the top-k with
-special tokens first. Works for VocabFileTokenizer; for WPM/BPE train the
-pieces with your favorite trainer and feed the files to
+"""Builds a vocab from text shards (ref `lingvo/tools/wpm_encode_file.py`
+/ vocab generation tools).
+
+--format=words (default): counts whitespace tokens, writes the top-k with
+special tokens first; works for VocabFileTokenizer.
+--format=spm: trains a frequency-scored unigram SentencePiece `.model`
+(core.sentencepiece.TrainUnigramModel) usable with
+core.tokenizers.SentencePieceTokenizer.
+For WPM/BPE piece files use your favorite trainer and feed the files to
 core.tokenizers.{Wpm,Bpe}Tokenizer."""
 
 from __future__ import annotations
@@ -19,6 +24,9 @@ def main(argv=None):
   ap.add_argument("--output", required=True)
   ap.add_argument("--vocab_size", type=int, default=32000)
   ap.add_argument("--specials", default="<pad>,<s>,</s>,<unk>")
+  ap.add_argument("--format", choices=("words", "spm"), default="words")
+  ap.add_argument("--byte_fallback", action="store_true",
+                  help="spm only: add <0xXX> byte pieces for OOV coverage")
   args = ap.parse_args(argv)
 
   counts: collections.Counter = collections.Counter()
@@ -26,6 +34,27 @@ def main(argv=None):
   if not files:
     print(f"no files match {args.input_glob}", file=sys.stderr)
     return 1
+  if args.format == "spm":
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from lingvo_tpu.core import sentencepiece as spm
+
+    def _Lines():  # stream: never materialize the corpus in memory
+      for path in files:
+        with open(path, errors="replace") as f:
+          for line in f:
+            line = line.strip()
+            if line:
+              yield line
+
+    model = spm.TrainUnigramModel(_Lines(), args.vocab_size,
+                                  byte_fallback=args.byte_fallback,
+                                  specials=tuple(args.specials.split(",")))
+    model.Save(args.output)
+    print(f"wrote spm model ({model.vocab_size} pieces) from {len(files)} "
+          f"files -> {args.output}")
+    return 0
   for path in files:
     with open(path, errors="replace") as f:
       for line in f:
