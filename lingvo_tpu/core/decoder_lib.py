@@ -1,0 +1,49 @@
+"""Decode-output persistence (ref `lingvo/core/decoder_lib.py`).
+
+Decode jobs emit per-example (key, value) pairs; these helpers persist and
+reload them. The reference pickles the kv list and packs NestedMaps into a
+`record_pb2.Record` of serialized numpy tensors; here the record format is
+a self-contained .npz-style dict (numpy's own portable serialization) so
+outputs round-trip without a proto toolchain.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+def WriteKeyValuePairs(filename, key_value_pairs) -> None:
+  """Writes a list of (key, value) pairs (ref `decoder_lib.py:24`)."""
+  with open(filename, "wb") as f:
+    pickle.dump(key_value_pairs, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def ReadKeyValuePairs(filename):
+  with open(filename, "rb") as f:
+    return pickle.load(f)
+
+
+def SerializeOutputs(nmap: NestedMap) -> bytes:
+  """NestedMap of arrays/scalars/strings -> portable bytes
+  (ref `decoder_lib.py:30` SerializeOutputs -> record_pb2.Record)."""
+  buf = io.BytesIO()
+  flat = dict(nmap.FlattenItems())
+  np.savez(buf, **{k: np.asarray(v) for k, v in flat.items()})
+  return buf.getvalue()
+
+
+def DeserializeOutputs(data: bytes) -> NestedMap:
+  """Inverse of SerializeOutputs; restores the nested structure."""
+  loaded = np.load(io.BytesIO(data), allow_pickle=False)
+  out = NestedMap()
+  for key in loaded.files:
+    arr = loaded[key]
+    if arr.dtype.kind in ("U", "S") and arr.ndim == 0:
+      arr = arr.item()
+    out.Set(key, arr)
+  return out

@@ -1,16 +1,20 @@
-"""Host-side op equivalents: cached calls, random permutation sequences.
+"""Host-side op equivalents: cached calls, permutations, static maps.
 
 Re-designs the small CPU kernels the reference registers as TF ops:
 `ops/functional_ops_kernels.cc` (CachedCall: run a function once, replay the
-cached tensors) and `ops/random_ops_kernels.cc` (RandomPermutationSequence:
+cached tensors), `ops/random_ops_kernels.cc` (RandomPermutationSequence:
 epoch-wise shuffled id batches for sampling-without-replacement input
-pipelines). In the JAX stack these run on the host by construction, so they
-are plain Python with numpy RNG — no kernel registry needed.
+pipelines), `ops/static_map_op.cc` (compile-time string<->int maps), and
+`ops/ml_perf_subword_op.cc` (MLPerf transformer subword detokenizer). In
+the JAX stack these run on the host by construction, so they are plain
+Python with numpy RNG — no kernel registry needed.
 """
 
 from __future__ import annotations
 
+import glob as glob_lib
 import threading
+from typing import Sequence
 
 import numpy as np
 
@@ -80,3 +84,88 @@ class RandomPermutationSequence:
 
   def __next__(self) -> np.ndarray:
     return self.GetNext()
+
+
+class StaticMap:
+  """Frozen string<->int map (ref `static_map_op.cc` StaticMapStringInt /
+  StaticMapIntString, `x_ops.cc:926-985`).
+
+  Built once from keys (ids default to positions) and vectorized both ways
+  with an unknown fallback, like the reference ops' `unk` attr. Lookup of
+  arrays preserves shape.
+  """
+
+  def __init__(self, keys: Sequence[str], ids: Sequence[int] | None = None,
+               unk_id: int = -1, unk_token: str = ""):
+    if ids is None:
+      ids = range(len(keys))
+    ids = [int(i) for i in ids]
+    if len(set(keys)) != len(keys):
+      raise ValueError("duplicate keys in StaticMap")
+    if len(set(ids)) != len(ids):
+      raise ValueError("duplicate ids in StaticMap")
+    if len(keys) != len(ids):
+      raise ValueError(f"{len(keys)} keys vs {len(ids)} ids")
+    self._to_id = dict(zip(keys, ids))
+    self._to_str = dict(zip(ids, keys))
+    self._unk_id = unk_id
+    self._unk_token = unk_token
+
+  def StrToId(self, strs) -> np.ndarray:
+    arr = np.asarray(strs)
+    flat = [self._to_id.get(s, self._unk_id) for s in arr.reshape(-1)]
+    return np.asarray(flat, np.int32).reshape(arr.shape)
+
+  def IdToStr(self, ids) -> np.ndarray:
+    arr = np.asarray(ids)
+    flat = [self._to_str.get(int(i), self._unk_token)
+            for i in arr.reshape(-1)]
+    return np.asarray(flat, object).reshape(arr.shape)
+
+  def __len__(self) -> int:
+    return len(self._to_id)
+
+
+class MlPerfSubword:
+  """MLPerf transformer subword detokenizer (ref `ml_perf_subword_op.cc`).
+
+  Vocab lines are quoted subtokens whose trailing `_` marks a word end
+  (e.g. `'Wie_'`, `'geht'`, `'s_'`). Decode joins the subtokens, splits on
+  `_`, and re-inserts spaces only between alphanumeric-starting fragments —
+  punctuation glues to the previous word, matching the reference kernel.
+  """
+
+  def __init__(self, vocab_lines: Sequence[str] | None = None,
+               vocab_glob: str | None = None):
+    if (vocab_lines is None) == (vocab_glob is None):
+      raise ValueError("pass exactly one of vocab_lines / vocab_glob")
+    if vocab_glob is not None:
+      files = sorted(glob_lib.glob(vocab_glob))
+      if not files:
+        raise FileNotFoundError(f"no vocab files match {vocab_glob!r}")
+      vocab_lines = []
+      for path in files:
+        with open(path, encoding="utf-8") as f:
+          vocab_lines.extend(f.read().splitlines())
+    self._id_to_token = [self._StripQuotes(line) for line in vocab_lines]
+
+  @staticmethod
+  def _StripQuotes(line: str) -> str:
+    line = line.strip()
+    if len(line) >= 2 and line[0] == line[-1] and line[0] in "'\"":
+      return line[1:-1]
+    return line
+
+  def Decode(self, ids: Sequence[int]) -> str:
+    tokens = []
+    for i in ids:
+      if not 0 <= int(i) < len(self._id_to_token):
+        raise IndexError(f"id {i} out of range [0, {len(self._id_to_token)})")
+      tokens.append(self._id_to_token[int(i)])
+    fragments = "".join(tokens).split("_")
+    out = []
+    for i, frag in enumerate(fragments):
+      if (i > 0 and fragments[i - 1][:1].isalnum() and frag[:1].isalnum()):
+        out.append(" ")
+      out.append(frag)
+    return "".join(out)

@@ -1,0 +1,60 @@
+"""Signature→Params bridging (ref `lingvo/core/inspect_utils.py`).
+
+Lets a Params tree drive arbitrary callables (e.g. wrapping an external
+layer/optimizer class as a configurable component) without hand-writing
+`Define` statements: `DefineParams` reflects a callable's signature into a
+Params object, `CallWithParams`/`ConstructWithParams` call it back with
+those values. Keyword overrides win over params values; parameters the
+callable doesn't declare are never passed.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+_SKIPPED_KINDS = (inspect.Parameter.VAR_POSITIONAL,
+                  inspect.Parameter.VAR_KEYWORD)
+
+
+def _ExtractParameters(func, ignore, bound):
+  ignore = set(ignore or ())
+  params = list(inspect.signature(func).parameters.values())
+  if bound and params:
+    params = params[1:]  # drop self/cls
+  return [p for p in params
+          if p.kind not in _SKIPPED_KINDS and p.name not in ignore]
+
+
+def DefineParams(func, params, ignore=None, bound=False):
+  """Defines one params entry per explicit parameter of `func`.
+
+  Defaults are copied; parameters without defaults get None. `*args` /
+  `**kwargs` catch-alls cannot be reflected and are skipped. Pass
+  `bound=True` when `func` is an unbound method whose first arg is
+  self/cls.
+  """
+  for p in _ExtractParameters(func, ignore, bound):
+    default = p.default
+    if default is inspect.Parameter.empty:
+      default = None
+    params.Define(p.name, default, "Function parameter.")
+  return params
+
+
+def _MakeArgs(func, params, bound=False, **kwargs):
+  args = {}
+  for p in _ExtractParameters(func, None, bound):
+    if p.name in params:
+      args[p.name] = params.Get(p.name)
+  args.update(kwargs)
+  return args
+
+
+def CallWithParams(func, params, **kwargs):
+  """Calls `func` with matching values from `params` (kwargs override)."""
+  return func(**_MakeArgs(func, params, **kwargs))
+
+
+def ConstructWithParams(cls, params, **kwargs):
+  """Constructs `cls` with matching values from `params`."""
+  return cls(**_MakeArgs(cls.__init__, params, bound=True, **kwargs))

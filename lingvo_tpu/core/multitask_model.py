@@ -1,0 +1,107 @@
+"""Cross-task variable sharing by regex (ref `lingvo/core/multitask_model.py`
+RegExSharedVariableModel).
+
+The reference shares TF variable *objects* between tasks whose variable
+names match renaming rules, so any task's update is every task's update. In
+the functional stack there are no variable objects — each task's train
+state owns a theta pytree — so sharing is a state relation instead:
+
+  * `SharedVariableRules(rules)` maps a task's variable path to a canonical
+    key via `re.sub` (first matching rule wins; non-matching paths stay
+    task-private). Two (task, path) leaves that map to the same canonical
+    key are shared.
+  * `UnifyStates` makes shared leaves identical at init (first task in
+    sorted order donates its initialization).
+  * `Propagate(states, from_task)` pushes the trainer's post-update values
+    of shared leaves to all other tasks.
+
+Only theta is shared; optimizer slots remain per-task (each task's
+optimizer sees the shared weights as its own — same observable behavior as
+the reference under one-task-at-a-time program scheduling, where the
+training task's slots are the only ones advancing).
+
+`runners/program.py` MultiTaskProgramSchedule applies these hooks when its
+`variable_renaming_rules` param is set.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class SharedVariableRules:
+  """Compiled (pattern, replacement) rules over variable paths."""
+
+  def __init__(self, rules: Sequence[Tuple[str, str]]):
+    self._rules = [(re.compile(pat), repl) for pat, repl in rules]
+    self._shared_paths = None  # computed once; the mapping is static
+
+  def CanonicalKey(self, path: str) -> str | None:
+    r"""Canonical share key for a theta path, or None if task-private.
+
+    Replacement supports backrefs (`\1`): e.g. rule
+    `(r"enc\.(.*)", r"shared_enc.\1")` (theta paths are dotted) shares every encoder variable across
+    all tasks under one key per variable.
+    """
+    for pat, repl in self._rules:
+      if pat.fullmatch(path):
+        return pat.sub(repl, path)
+    return None
+
+  def SharedPaths(self, states: NestedMap) -> dict[str, list[tuple[str, str]]]:
+    """canonical key -> [(task_name, theta_path), ...] with >= 1 entry.
+
+    Cached after the first call: the path structure is fixed at state
+    creation, and Propagate runs every train cycle.
+    """
+    if self._shared_paths is None:
+      out: dict[str, list[tuple[str, str]]] = {}
+      for task_name in sorted(states.keys()):
+        theta = states.GetItem(task_name).theta
+        for path, _ in theta.FlattenItems():
+          key = self.CanonicalKey(path)
+          if key is not None:
+            out.setdefault(key, []).append((task_name, path))
+      self._shared_paths = out
+    return self._shared_paths
+
+  def UnifyStates(self, states: NestedMap) -> NestedMap:
+    """Makes shared leaves identical: first task in sorted order donates.
+
+    Raises if two leaves sharing a key have different shapes — a wrong rule
+    silently pairing unrelated variables is the dangerous failure mode.
+    """
+    for key, entries in self.SharedPaths(states).items():
+      donor_task, donor_path = entries[0]
+      donor = states.GetItem(donor_task).theta.GetItem(donor_path)
+      for task_name, path in entries[1:]:
+        leaf = states.GetItem(task_name).theta.GetItem(path)
+        if getattr(leaf, "shape", None) != getattr(donor, "shape", None):
+          raise ValueError(
+              f"rule key {key!r} pairs {donor_task}/{donor_path} "
+              f"{getattr(donor, 'shape', None)} with {task_name}/{path} "
+              f"{getattr(leaf, 'shape', None)}")
+        states.GetItem(task_name).theta.Set(path, donor)
+    return states
+
+  def Propagate(self, states: NestedMap, from_task: str) -> NestedMap:
+    """Pushes `from_task`'s shared values to every tied leaf.
+
+    Every entry under a key is overwritten — including `from_task`'s own
+    other paths, which can diverge during its train cycle when one task
+    maps several of its own variables to the same key (the reference's
+    single-TF-variable sharing can't diverge, so neither may this).
+    """
+    for _, entries in self.SharedPaths(states).items():
+      sources = [(t, p) for t, p in entries if t == from_task]
+      if not sources:
+        continue
+      src_task, src_path = sources[0]
+      value = states.GetItem(from_task).theta.GetItem(src_path)
+      for task_name, path in entries:
+        if (task_name, path) != (src_task, src_path):
+          states.GetItem(task_name).theta.Set(path, value)
+    return states

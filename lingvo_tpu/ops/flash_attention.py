@@ -53,27 +53,42 @@ def _ApplyCausalMask(s, q_start, k_start, block_q: int, block_k: int):
   return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
+def _DotF32(a, b, contract):
+  """Matmul keeping the inputs' native dtype with f32 accumulation.
+
+  Pre-casting bf16 operands to f32 (the obvious way to get f32 math) forces
+  the MXU into f32xf32 mode at a fraction of bf16 throughput; the fast path
+  is native-dtype inputs + preferred_element_type=f32, like XLA's own
+  attention fusions. `contract` = (a_axis, b_axis).
+  """
+  return jax.lax.dot_general(
+      a, b, (((contract[0],), (contract[1],)), ((), ())),
+      preferred_element_type=jnp.float32)
+
+
 def _RecomputePandDs(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      q_start, k_start, *, block_q: int, block_k: int,
                      causal: bool, sm_scale: float):
-  """Shared backward-block recompute: returns (q, do, p, ds) in f32.
+  """Shared backward-block recompute: returns (q, k, do, p, ds).
 
-  p = exp(s - lse) reproduces the forward probabilities from the saved
-  logsumexp; ds = p * (dp - delta) * sm_scale is d(loss)/d(q k^T). Both
-  backward kernels must use this same definition or dQ vs dK/dV gradients
-  silently diverge.
+  q/k/do keep their input dtype (MXU fast path); p and ds are f32
+  (consumers cast them back for their matmuls). p = exp(s - lse)
+  reproduces the forward probabilities from the saved logsumexp;
+  ds = p * (dp - delta) * sm_scale is d(loss)/d(q k^T). Both backward
+  kernels must use this same definition or dQ vs dK/dV gradients silently
+  diverge.
   """
-  q = q_ref[0].astype(jnp.float32)                      # [block_q, h]
-  k = k_ref[0].astype(jnp.float32)                      # [block_k, h]
-  v = v_ref[0].astype(jnp.float32)                      # [block_k, h]
-  do = do_ref[0].astype(jnp.float32)                    # [block_q, h]
+  q = q_ref[0]                                          # [block_q, h]
+  k = k_ref[0]                                          # [block_k, h]
+  v = v_ref[0]                                          # [block_k, h]
+  do = do_ref[0]                                        # [block_q, h]
   lse = lse_ref[0][:, :1]                               # [block_q, 1]
   delta = delta_ref[0][:, :1]                           # [block_q, 1]
-  s = (q @ k.T) * sm_scale
+  s = _DotF32(q, k, (1, 1)) * sm_scale                  # [block_q, block_k]
   if causal:
     s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
-  p = jnp.exp(s - lse)                                  # [block_q, block_k]
-  dp = do @ v.T                                         # [block_q, block_k]
+  p = jnp.exp(s - lse)                                  # f32 [bq, bk]
+  dp = _DotF32(do, v, (1, 1))                           # [block_q, block_k]
   ds = p * (dp - delta) * sm_scale
   return q, k, do, p, ds
 
@@ -96,10 +111,10 @@ def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
   # A block contributes unless it is entirely in the causal future:
   # smallest q position is q_start, largest k position is k_start+block_k-1.
   def _Accumulate():
-    q = q_ref[0].astype(jnp.float32)                    # [block_q, h]
-    k = k_ref[0].astype(jnp.float32)                    # [block_k, h]
-    v = v_ref[0].astype(jnp.float32)                    # [block_k, h]
-    s = (q @ k.T) * sm_scale                            # [block_q, block_k]
+    q = q_ref[0]                                        # [block_q, h]
+    k = k_ref[0]                                        # [block_k, h]
+    v = v_ref[0]                                        # [block_k, h]
+    s = _DotF32(q, k, (1, 1)) * sm_scale                # f32 [bq, bk]
     if causal:
       s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
     m_prev = m_scr[:, :1]                               # [block_q, 1]
@@ -111,7 +126,8 @@ def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(
         alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape)
-    acc_scr[:] = acc_scr[:] * alpha + p @ v
+    # p rounds to the input dtype for the MXU (standard flash practice)
+    acc_scr[:] = acc_scr[:] * alpha + _DotF32(p.astype(v.dtype), v, (1, 0))
 
   if causal:
     pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
@@ -170,6 +186,8 @@ def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
           pltpu.VMEM((block_q, LANES), jnp.float32),
           pltpu.VMEM((block_q, h), jnp.float32),
       ],
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=("parallel", "parallel", "arbitrary")),
       interpret=interpret,
   )(q, k, v)
   return out, lse
@@ -193,8 +211,8 @@ def _DkDvKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     q, _, do, p, ds = _RecomputePandDs(
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start, k_start,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale)
-    dv_scr[:] = dv_scr[:] + p.T @ do
-    dk_scr[:] = dk_scr[:] + ds.T @ q
+    dv_scr[:] = dv_scr[:] + _DotF32(p.astype(do.dtype), do, (0, 0))
+    dk_scr[:] = dk_scr[:] + _DotF32(ds.astype(q.dtype), q, (0, 0))
 
   if causal:
     pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
@@ -224,7 +242,7 @@ def _DqKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     _, k, _, _, ds = _RecomputePandDs(
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start, k_start,
         block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale)
-    dq_scr[:] = dq_scr[:] + ds @ k
+    dq_scr[:] = dq_scr[:] + _DotF32(ds.astype(k.dtype), k, (1, 0))
 
   if causal:
     pl.when(k_start <= q_start + block_q - 1)(_Accumulate)
@@ -281,6 +299,8 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
           pltpu.VMEM((block_k, h), jnp.float32),
           pltpu.VMEM((block_k, h), jnp.float32),
       ],
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=("parallel", "parallel", "arbitrary")),
       interpret=interpret,
   )(q, k, v, do, lse, delta)
 
@@ -300,6 +320,8 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
       ],
       out_specs=pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
       scratch_shapes=[pltpu.VMEM((block_q, h), jnp.float32)],
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=("parallel", "parallel", "arbitrary")),
       interpret=interpret,
   )(q, k, v, do, lse, delta)
   return dq, dk, dv
@@ -325,14 +347,22 @@ def _FlashCoreBwd(block_q, block_k, causal, interpret, res, g):
 _FlashCore.defvjp(_FlashCoreFwd, _FlashCoreBwd)
 
 
-def FlashAttention(q, k, v, *, causal: bool = True, block_q: int = 128,
-                   block_k: int = 128, interpret: bool | None = None):
+def FlashAttention(q, k, v, *, causal: bool = True, block_q: int = 1024,
+                   block_k: int = 1024, interpret: bool | None = None):
   """Fused attention. q/k/v: [b, t, n, h] -> [b, t, n, h].
 
   Scaling by 1/sqrt(h) happens INSIDE (don't pre-scale q). Block sizes are
   shrunk automatically to the largest power of two dividing T; h should be a
   multiple of 128 for the MXU on real TPU. interpret=None auto-selects
   (True off-TPU).
+
+  Default blocks are 1024x1024 (measured on v5e at [4,2048,8,128] fwd+bwd
+  causal bf16: 1.87 ms vs 7.92 ms with 128x128 blocks and 8.37 ms for naive
+  XLA attention — small blocks leave the MXU idle behind per-block VPU
+  softmax work). VMEM at these defaults is dominated by the
+  [block_q, block_k] f32 intermediates (s/p — and dp/ds in the backward —
+  at 4 MB each, ~16 MB live in the bwd recompute), not the ~256 KB q/k/v
+  tiles; shrink block_k first on parts with smaller VMEM than v5e's.
   """
   b, t, n, h = q.shape
   if interpret is None:

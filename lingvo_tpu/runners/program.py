@@ -566,6 +566,11 @@ class MultiTaskProgramSchedule:
     p.Define("train_executions_per_eval", 1,
              "Train cycles between eval rounds (ref "
              "SimpleProgramSchedule.train_executions_per_eval).")
+    p.Define("variable_renaming_rules", [],
+             "[(regex, replacement)] over dotted theta paths; tasks whose renamed "
+             "paths collide share those variables (ref multitask_model.py "
+             "RegExSharedVariableModel). Shared values are unified at init "
+             "and propagated from the sampled task after each train cycle.")
     return p
 
   def __init__(self, params, tasks: dict | None = None,
@@ -587,6 +592,11 @@ class MultiTaskProgramSchedule:
     self._tasks = dict(tasks)
     self._scheduler = self.p.task_schedule.Instantiate()
     self._runs_since_eval = 0
+    self._shared_rules = None
+    if self.p.variable_renaming_rules:
+      from lingvo_tpu.core import multitask_model
+      self._shared_rules = multitask_model.SharedVariableRules(
+          self.p.variable_renaming_rules)
 
     def _GenFor(name, dataset):
       if (name, dataset) in input_generators:
@@ -619,6 +629,8 @@ class MultiTaskProgramSchedule:
     keys = jax.random.split(key, len(self._tasks))
     for k, name in zip(keys, sorted(self._tasks)):
       states.Set(name, self._tasks[name].CreateTrainState(k))
+    if self._shared_rules is not None:
+      states = self._shared_rules.UnifyStates(states)
     return NestedMap(tasks=states, step=jnp.zeros((), jnp.int32))
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, Any]]:
@@ -628,6 +640,8 @@ class MultiTaskProgramSchedule:
     task_state = state.tasks.GetItem(name)
     task_state, result = self.train_programs[name].Run(task_state)
     state.tasks.Set(name, task_state)
+    if self._shared_rules is not None:
+      state.tasks = self._shared_rules.Propagate(state.tasks, name)
     state.step = jnp.asarray(
         sum(int(jax.device_get(state.tasks.GetItem(n).step))
             for n in sorted(self._tasks)), jnp.int32)

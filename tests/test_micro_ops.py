@@ -1,0 +1,206 @@
+"""StaticMap, MlPerfSubword, inspect_utils, decoder_lib, and regex
+cross-task variable sharing (SURVEY §2 micro-components)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import decoder_lib
+from lingvo_tpu.core import host_ops
+from lingvo_tpu.core import hyperparams
+from lingvo_tpu.core import inspect_utils
+from lingvo_tpu.core import multitask_model
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class TestStaticMap:
+
+  def test_round_trip_with_default_ids(self):
+    m = host_ops.StaticMap(["car", "ped", "cyc"])
+    np.testing.assert_array_equal(m.StrToId(["ped", "car"]), [1, 0])
+    assert list(m.IdToStr([2, 0])) == ["cyc", "car"]
+
+  def test_explicit_ids_and_unk(self):
+    m = host_ops.StaticMap(["a", "b"], ids=[10, 20], unk_id=-7,
+                           unk_token="<?>")
+    np.testing.assert_array_equal(m.StrToId([["a", "x"], ["b", "b"]]),
+                                  [[10, -7], [20, 20]])
+    assert m.IdToStr([99]).tolist() == ["<?>"]
+
+  def test_duplicate_keys_rejected(self):
+    with pytest.raises(ValueError, match="duplicate"):
+      host_ops.StaticMap(["a", "a"])
+
+
+class TestMlPerfSubword:
+
+  def test_decode_joins_words_and_glues_punctuation(self):
+    vocab = ["'Wie_'", "'geht'", "'s_'", "'?_'", "'dir_'"]
+    sub = host_ops.MlPerfSubword(vocab_lines=vocab)
+    # "Wie_" + "geht" + "s_" -> fragments Wie | gehts | ... spaces only
+    # between alnum fragments; "?" glues to the previous word
+    assert sub.Decode([0, 1, 2, 4, 3]) == "Wie gehts dir?"
+
+  def test_out_of_range_id_raises(self):
+    sub = host_ops.MlPerfSubword(vocab_lines=["'a_'"])
+    with pytest.raises(IndexError):
+      sub.Decode([1])
+
+
+class TestInspectUtils:
+
+  def test_define_params_reflects_signature(self):
+    def fn(alpha, beta=2.5, gamma="g"):
+      return (alpha, beta, gamma)
+
+    p = hyperparams.Params()
+    inspect_utils.DefineParams(fn, p)
+    assert p.alpha is None and p.beta == 2.5 and p.gamma == "g"
+    p.alpha = 7
+    assert inspect_utils.CallWithParams(fn, p) == (7, 2.5, "g")
+    assert inspect_utils.CallWithParams(fn, p, beta=9) == (7, 9, "g")
+
+  def test_construct_with_params_skips_self(self):
+    class Thing:
+      def __init__(self, x, y=3):
+        self.xy = (x, y)
+
+    p = hyperparams.Params()
+    inspect_utils.DefineParams(Thing.__init__, p, bound=True)
+    p.x = 1
+    assert inspect_utils.ConstructWithParams(Thing, p).xy == (1, 3)
+
+  def test_ignores_var_args(self):
+    def fn(a, *args, **kwargs):
+      return a
+
+    p = hyperparams.Params()
+    inspect_utils.DefineParams(fn, p)
+    assert p.GetKeys() == ["a"]
+
+
+class TestDecoderLib:
+
+  def test_kv_pairs_round_trip(self, tmp_path):
+    path = str(tmp_path / "decode_out.pkl")
+    pairs = [("ex1", {"hyp": "a b", "score": 0.5}), ("ex2", {"hyp": "c"})]
+    decoder_lib.WriteKeyValuePairs(path, pairs)
+    assert decoder_lib.ReadKeyValuePairs(path) == pairs
+
+  def test_serialize_outputs_round_trip(self):
+    nmap = NestedMap(
+        ids=np.arange(6, dtype=np.int32).reshape(2, 3),
+        scores=np.array([0.5, -1.0], np.float32),
+        nested=NestedMap(x=np.ones((2,), np.float64)))
+    data = decoder_lib.SerializeOutputs(nmap)
+    out = decoder_lib.DeserializeOutputs(data)
+    np.testing.assert_array_equal(out.ids, nmap.ids)
+    np.testing.assert_array_equal(out.nested.x, nmap.nested.x)
+    np.testing.assert_allclose(out.scores, nmap.scores)
+
+
+def _TwoTaskStates():
+  k = jax.random.PRNGKey(0)
+  ka, kb = jax.random.split(k)
+  mk = lambda key: NestedMap(
+      theta=NestedMap(
+          enc=NestedMap(w=jax.random.normal(key, (3, 3))),
+          head=NestedMap(w=jax.random.normal(jax.random.fold_in(key, 1),
+                                             (3, 2)))),
+      step=jnp.zeros((), jnp.int32))
+  return NestedMap(a=mk(ka), b=mk(kb))
+
+
+class TestSharedVariableRules:
+
+  def test_unify_makes_shared_leaves_identical(self):
+    rules = multitask_model.SharedVariableRules(
+        [(r"enc\.(.*)", r"shared_enc.\1")])
+    states = _TwoTaskStates()
+    before_b_head = np.asarray(states.b.theta.head.w)
+    states = rules.UnifyStates(states)
+    np.testing.assert_array_equal(np.asarray(states.a.theta.enc.w),
+                                  np.asarray(states.b.theta.enc.w))
+    # non-matching paths stay private
+    np.testing.assert_array_equal(np.asarray(states.b.theta.head.w),
+                                  before_b_head)
+    assert not np.array_equal(np.asarray(states.a.theta.head.w),
+                              np.asarray(states.b.theta.head.w))
+
+  def test_propagate_pushes_trainer_values(self):
+    rules = multitask_model.SharedVariableRules(
+        [(r"enc\.(.*)", r"shared_enc.\1")])
+    states = rules.UnifyStates(_TwoTaskStates())
+    states.a.theta.enc.w = states.a.theta.enc.w + 1.0
+    states = rules.Propagate(states, "a")
+    np.testing.assert_array_equal(np.asarray(states.a.theta.enc.w),
+                                  np.asarray(states.b.theta.enc.w))
+
+  def test_propagate_reties_diverged_leaves_within_trainer(self):
+    # one task maps TWO of its own paths to one key; after they diverge in
+    # training, Propagate must re-tie them everywhere (incl. the trainer)
+    rules = multitask_model.SharedVariableRules(
+        [(r"(enc|head)\.w", r"shared.w")])
+    states = NestedMap(
+        a=NestedMap(theta=NestedMap(enc=NestedMap(w=jnp.zeros((2,))),
+                                    head=NestedMap(w=jnp.zeros((2,))))),
+        b=NestedMap(theta=NestedMap(enc=NestedMap(w=jnp.ones((2,))),
+                                    head=NestedMap(w=jnp.ones((2,))))))
+    states = rules.UnifyStates(states)
+    states.a.theta.enc.w = jnp.full((2,), 5.0)
+    states.a.theta.head.w = jnp.full((2,), 9.0)  # diverged within task a
+    states = rules.Propagate(states, "a")
+    for leaf in (states.a.theta.enc.w, states.a.theta.head.w,
+                 states.b.theta.enc.w, states.b.theta.head.w):
+      np.testing.assert_array_equal(np.asarray(leaf), [5.0, 5.0])
+
+  def test_shape_mismatch_fails_loudly(self):
+    rules = multitask_model.SharedVariableRules([(r".*", "everything")])
+    states = _TwoTaskStates()
+    with pytest.raises(ValueError, match="pairs"):
+      rules.UnifyStates(states)
+
+
+class TestMultiTaskSharingEndToEnd:
+
+  def test_shared_encoder_stays_in_sync_through_schedule(self, tmp_path):
+    from lingvo_tpu.core import task_scheduler
+    from lingvo_tpu.runners import program as program_lib
+    from tests.test_executor_hardening import (_RegressionInput, _TaskParams)
+    import lingvo_tpu.core.hyperparams as hp
+
+    logdir = str(tmp_path)
+    task_ps = {"a": _TaskParams("a"), "b": _TaskParams("b")}
+    tasks, gens = {}, {}
+    train_programs = hp.Params()
+    for name, tp_ in task_ps.items():
+      tasks[name] = tp_.Instantiate()
+      tasks[name].FinalizePaths()
+      train_programs.Define(
+          name,
+          program_lib.TrainProgram.Params().Set(
+              task=tp_, logdir=logdir, name=f"train_{name}",
+              steps_per_loop=3), "")
+      gens[(name, "Train")] = _RegressionInput(seed=hash(name) % 100)
+    sched_p = program_lib.MultiTaskProgramSchedule.Params().Set(
+        task_schedule=task_scheduler.ConstantScheduler.Params().Set(
+            task_probs=[("a", 0.5), ("b", 0.5)], seed=3),
+        train_programs=train_programs,
+        variable_renaming_rules=[(r"proj\.(.*)", r"shared_proj.\1")])
+    sched = program_lib.MultiTaskProgramSchedule(sched_p, tasks=tasks,
+                                                 input_generators=gens)
+    state = sched.CreateTrainState(jax.random.PRNGKey(0))
+    wa = np.asarray(state.tasks.GetItem("a").theta.proj.w)
+    wb = np.asarray(state.tasks.GetItem("b").theta.proj.w)
+    np.testing.assert_array_equal(wa, wb)  # unified at init
+    for _ in range(4):
+      state, _ = sched.Run(state)
+      wa = np.asarray(jax.device_get(state.tasks.GetItem("a").theta.proj.w))
+      wb = np.asarray(jax.device_get(state.tasks.GetItem("b").theta.proj.w))
+      np.testing.assert_array_equal(wa, wb)  # in sync after every cycle
+    # and training actually changed the shared weights
+    w0 = np.asarray(
+        sched.CreateTrainState(jax.random.PRNGKey(0)).tasks.GetItem(
+            "a").theta.proj.w)
+    assert not np.array_equal(wa, w0)

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Diffs the Params trees of two registered models (ref
+`lingvo/tools/compare_params.py`): prints keys present in only one and keys
+whose values differ. Accepts registry names (`lm.one_billion_wds.X`) or
+paths to `params.txt` files written into a logdir.
+
+Usage: compare_params.py <model_or_file_a> <model_or_file_b> [--dataset=Train]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _LoadParamsText(spec: str, dataset: str) -> str:
+  if os.path.exists(spec):
+    with open(spec) as f:
+      return f.read()
+  from lingvo_tpu import model_registry
+  import lingvo_tpu.models.all_params  # noqa: F401
+  return model_registry.GetParams(spec, dataset).ToText()
+
+
+def _ToDict(text: str) -> dict:
+  out = {}
+  for line in text.splitlines():
+    line = line.strip()
+    if not line or ":" not in line:
+      continue
+    key, val = line.split(":", 1)
+    out[key.strip()] = val.strip()
+  return out
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("a")
+  ap.add_argument("b")
+  ap.add_argument("--dataset", default="Train")
+  args = ap.parse_args(argv)
+
+  da = _ToDict(_LoadParamsText(args.a, args.dataset))
+  db = _ToDict(_LoadParamsText(args.b, args.dataset))
+  only_a = sorted(set(da) - set(db))
+  only_b = sorted(set(db) - set(da))
+  diff = sorted(k for k in set(da) & set(db) if da[k] != db[k])
+  for k in only_a:
+    print(f"< {k}: {da[k]}")
+  for k in only_b:
+    print(f"> {k}: {db[k]}")
+  for k in diff:
+    print(f"! {k}: {da[k]}  ->  {db[k]}")
+  print(f"# {len(only_a)} only in A, {len(only_b)} only in B, "
+        f"{len(diff)} differ, {len(set(da) & set(db)) - len(diff)} equal")
+  return 0 if not (only_a or only_b or diff) else 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())

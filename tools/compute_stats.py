@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Corpus statistics over record shards (ref `lingvo/tools/compute_stats.py`):
+record counts, byte-length and (for text) whitespace-token-length
+distributions — the numbers needed to pick input-generator bucket
+boundaries.
+
+Usage: compute_stats.py --input_glob='data/*.tfrecord' [--format=tfrecord]
+       compute_stats.py --input_glob='data/*.txt' --format=text
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _IterRecords(files, fmt):
+  if fmt == "text":
+    for path in files:
+      with open(path, "rb") as f:
+        for line in f:
+          yield line.rstrip(b"\n")
+  else:
+    import struct
+    for path in files:
+      # tfrecord framing: u64 len, u32 len-crc, payload, u32 payload-crc
+      with open(path, "rb") as f:
+        while True:
+          hdr = f.read(12)
+          if len(hdr) < 12:
+            break
+          (ln,) = struct.unpack("<Q", hdr[:8])
+          payload = f.read(ln)
+          if len(payload) < ln:
+            break
+          f.read(4)
+          yield payload
+
+
+def _Describe(name, values):
+  arr = np.asarray(values)
+  if not len(arr):
+    print(f"{name}: no data")
+    return
+  pcts = np.percentile(arr, [50, 90, 95, 99])
+  print(f"{name}: n={len(arr)} mean={arr.mean():.1f} max={arr.max()} "
+        f"p50={pcts[0]:.0f} p90={pcts[1]:.0f} p95={pcts[2]:.0f} "
+        f"p99={pcts[3]:.0f}")
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--input_glob", required=True)
+  ap.add_argument("--format", choices=("tfrecord", "text"), default="text")
+  ap.add_argument("--suggest_buckets", type=int, default=0,
+                  help="If >0, print this many token-length bucket bounds.")
+  args = ap.parse_args(argv)
+
+  files = sorted(glob.glob(args.input_glob))
+  if not files:
+    print(f"no files match {args.input_glob}", file=sys.stderr)
+    return 1
+  byte_lens, tok_lens = [], []
+  for rec in _IterRecords(files, args.format):
+    byte_lens.append(len(rec))
+    tok_lens.append(len(rec.split()))
+  print(f"{len(files)} files")
+  _Describe("bytes/record", byte_lens)
+  _Describe("tokens/record", tok_lens)
+  if args.suggest_buckets and tok_lens:
+    qs = np.linspace(0, 100, args.suggest_buckets + 1)[1:]
+    bounds = sorted({int(np.ceil(b))
+                     for b in np.percentile(tok_lens, qs)})
+    print(f"suggested bucket_upper_bound: {bounds}")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
