@@ -253,7 +253,9 @@ def main():
   if on_tpu:
     # ~670M params, MXU-friendly geometry (d=2048 beats d=1024 by ~12 MFU
     # points on v5e); 'dots' remat saves matmul outputs instead of
-    # recomputing whole layers. Measured 0.46 MFU on v5e.
+    # recomputing whole layers; the Pallas flash kernel handles the packed
+    # input's segment mask in-kernel. Measured 0.457 MFU naive-attention,
+    # 0.568 with flash (v5e).
     mp.task.model_dim = 2048
     mp.task.num_layers = 12
     mp.task.num_heads = 16
@@ -263,6 +265,9 @@ def main():
     mp.task.input.seq_len = 1024
     mp.task.input.batch_size = 8
     mp.task.remat_policy = "dots"
+    from lingvo_tpu.core import attention as attention_lib
+    mp.task.atten_tpl = attention_lib.MultiHeadedAttention.Params().Set(
+        use_flash_attention=True)
     steps = 20
   else:
     mp.task.input.seq_len = 64

@@ -203,12 +203,22 @@ class MultiHeadedAttention(base_layer.BaseLayer):
           keep_prob=1.0 - p.atten_dropout_prob)
     return jnp.einsum("BNTS,BSNH->BTNH", probs, v), probs
 
-  def _FlashEligible(self, key_vec, paddings, atten_mask, segment_ids, t):
+  def _FlashEligible(self, key_vec, atten_mask, needs_seg, t):
+    """Self-attention with only causal/padding/segment masking can run the
+    fused kernel (paddings/segment_ids fold into the kernel's segment mask;
+    arbitrary additive atten_mask cannot). On real TPU the segment path
+    further requires t % 128 == 0 (Mosaic lane alignment) — shorter inputs
+    fall back to the einsum path."""
     p = self.p
-    return (p.use_flash_attention and key_vec is None and paddings is None
-            and atten_mask is None and segment_ids is None and
+    if not (p.use_flash_attention and key_vec is None
+            and atten_mask is None and
             p.rel_pos_emb_dim == 0 and p.atten_logit_cap == 0 and
-            p.atten_dropout_prob == 0 and t % 16 == 0)
+            p.atten_dropout_prob == 0 and t % 16 == 0):
+      return False
+    if jax.default_backend() == "tpu":
+      from lingvo_tpu.ops import flash_attention
+      return flash_attention.SupportedOnTpu(t, with_segments=needs_seg)
+    return True
 
   def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
             paddings=None, atten_mask=None, segment_ids=None, causal=False):
@@ -219,8 +229,9 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     (self-attention) — adds a SegmentMask. `causal=True` is an alternative
     to passing CausalMask that lets the fused flash kernel run.
     """
-    use_flash = self._FlashEligible(key_vec, paddings, atten_mask,
-                                    segment_ids, query_vec.shape[1])
+    use_flash = self._FlashEligible(
+        key_vec, atten_mask, paddings is not None or segment_ids is not None,
+        query_vec.shape[1])
     key_vec = query_vec if key_vec is None else key_vec
     value_vec = key_vec if value_vec is None else value_vec
     q = self._HeadsProj(theta, "query", query_vec)
@@ -233,11 +244,19 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     q = self._ScaleQuery(theta, q)
     if use_flash:
       from lingvo_tpu.ops import flash_attention
+      # paddings/segment_ids both become the kernel's segment mask: padding
+      # gets segment 0 (packed inputs already carry 0 there; enforce it so
+      # pad keys never leak into real queries)
+      seg = segment_ids
+      if paddings is not None:
+        base = segment_ids if segment_ids is not None else jnp.ones_like(
+            paddings, jnp.int32)
+        seg = jnp.where(paddings > 0.5, 0, base).astype(jnp.int32)
       # the kernel scales by 1/sqrt(h) internally; q already carries the
       # (learned) query scale, so cancel the kernel's factor.
       h = self._dim_per_head
       ctx = flash_attention.FlashAttention(
-          q * math.sqrt(h), k, v, causal=causal)
+          q * math.sqrt(h), k, v, causal=causal, segment_ids=seg)
       return self._PostProj(theta, ctx), None
     mask = atten_mask
     if causal:

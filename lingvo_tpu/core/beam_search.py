@@ -176,6 +176,48 @@ class BeamSearchHelper:
         topk_ids=topk_ids, topk_lens=topk_lens, topk_scores=topk_scores)
 
 
+def MergeBeamSearchOutputs(max_hyps_per_beam: int, beam_search_outputs):
+  """Merges beam-search outputs from several decoders (model ensembling,
+  ref `beam_search_helper.py:681` MergeBeamSearchOutputs).
+
+  Each element is a NestedMap(topk_ids [B,K_i,T], topk_lens [B,K_i],
+  topk_scores [B,K_i]) with a common B and T. Hypotheses are pooled,
+  duplicates (identical token prefixes up to their length) keep only the
+  best-scoring copy, and the top `max_hyps_per_beam` by score come back in
+  the same layout. Pure jnp with static shapes, so it jits.
+  """
+  ids = jnp.concatenate([o.topk_ids for o in beam_search_outputs], axis=1)
+  lens = jnp.concatenate([o.topk_lens for o in beam_search_outputs], axis=1)
+  scores = jnp.concatenate([o.topk_scores for o in beam_search_outputs],
+                           axis=1)
+  b, k, t = ids.shape
+  # duplicate = same length and same ids within that length
+  pos = jnp.arange(t)
+  valid = pos[None, None, :] < lens[:, :, None]              # [B,K,T]
+  masked = jnp.where(valid, ids, -1)
+  same = jnp.all(masked[:, :, None, :] == masked[:, None, :, :], axis=-1)
+  same &= lens[:, :, None] == lens[:, None, :]               # [B,K,K]
+  # a hyp is a duplicate if an equal hyp exists with (better score) or
+  # (equal score and lower index) — keeps exactly one representative
+  better = (scores[:, None, :] > scores[:, :, None]) | (
+      (scores[:, None, :] == scores[:, :, None]) &
+      (jnp.arange(k)[None, None, :] < jnp.arange(k)[None, :, None]))
+  dup = jnp.any(same & better, axis=-1)                      # [B,K]
+  pooled = jnp.where(dup, -jnp.inf, scores)
+  order = jnp.argsort(-pooled, axis=-1)[:, :max_hyps_per_beam]
+  out_scores = jnp.take_along_axis(pooled, order, axis=1)
+  # slots beyond the unique-hyp count would otherwise carry -inf scores
+  # with live duplicate ids; blank them so consumers see empty hyps
+  live = jnp.isfinite(out_scores)
+  return NestedMap(
+      topk_ids=jnp.where(
+          live[:, :, None],
+          jnp.take_along_axis(ids, order[:, :, None], axis=1), 0),
+      topk_lens=jnp.where(
+          live, jnp.take_along_axis(lens, order, axis=1), 0),
+      topk_scores=out_scores)
+
+
 class GreedySearchHelper:
   """Argmax decoding (ref GreedySearchHelper:752)."""
 

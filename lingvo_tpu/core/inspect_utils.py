@@ -41,7 +41,7 @@ def DefineParams(func, params, ignore=None, bound=False):
   return params
 
 
-def _MakeArgs(func, params, bound=False, **kwargs):
+def _MakeArgs(func, params, bound, kwargs):
   args = {}
   for p in _ExtractParameters(func, None, bound):
     if p.name in params:
@@ -51,10 +51,14 @@ def _MakeArgs(func, params, bound=False, **kwargs):
 
 
 def CallWithParams(func, params, **kwargs):
-  """Calls `func` with matching values from `params` (kwargs override)."""
-  return func(**_MakeArgs(func, params, **kwargs))
+  """Calls `func` with matching values from `params` (kwargs override).
+
+  kwargs are forwarded verbatim — a parameter named `bound` or `params`
+  cannot collide with this wrapper's own arguments.
+  """
+  return func(**_MakeArgs(func, params, False, kwargs))
 
 
 def ConstructWithParams(cls, params, **kwargs):
   """Constructs `cls` with matching values from `params`."""
-  return cls(**_MakeArgs(cls.__init__, params, bound=True, **kwargs))
+  return cls(**_MakeArgs(cls.__init__, params, True, kwargs))

@@ -43,14 +43,30 @@ NEG_INF = -1.0e30
 # broadcast across a 128-lane minor dim: TPU VMEM/HBM are (8, 128)-tiled and
 # the Mosaic lowering rejects 2D blocks whose minor dims aren't tile-aligned
 # (the round-1 on-hardware failure; same layout as jax's own TPU flash
-# kernel's l/m residuals).
+# kernel's l/m residuals). Segment ids use the same trick: q-side ids
+# broadcast over LANES, kv-side ids over SUBLANES with t on the minor axis.
 LANES = 128
+SUBLANES = 8
 
 
 def _ApplyCausalMask(s, q_start, k_start, block_q: int, block_k: int):
   q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
   k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
   return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _ApplySegmentMask(s, sq_ref, sk_ref, block_q: int, block_k: int):
+  """Masks cross-segment pairs: seg_q == seg_k keeps a pair.
+
+  Padding carries segment 0, so pad queries still attend pad keys — every
+  row keeps at least its diagonal, the online-softmax denominator stays
+  well-conditioned, and pad outputs are finite garbage that the loss mask
+  zeroes (their dout is exactly 0, so no gradient leaks through them).
+  """
+  del block_q, block_k
+  sq = sq_ref[0][:, :1]    # [block_q, LANES] -> [block_q, 1]
+  sk = sk_ref[0][:1, :]    # [SUBLANES, block_k] -> [1, block_k]
+  return jnp.where(sq == sk, s, NEG_INF)
 
 
 def _DotF32(a, b, contract):
@@ -67,8 +83,8 @@ def _DotF32(a, b, contract):
 
 
 def _RecomputePandDs(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     q_start, k_start, *, block_q: int, block_k: int,
-                     causal: bool, sm_scale: float):
+                     sq_ref, sk_ref, q_start, k_start, *, block_q: int,
+                     block_k: int, causal: bool, sm_scale: float):
   """Shared backward-block recompute: returns (q, k, do, p, ds).
 
   q/k/do keep their input dtype (MXU fast path); p and ds are f32
@@ -87,16 +103,23 @@ def _RecomputePandDs(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   s = _DotF32(q, k, (1, 1)) * sm_scale                  # [block_q, block_k]
   if causal:
     s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
+  if sq_ref is not None:
+    s = _ApplySegmentMask(s, sq_ref, sk_ref, block_q, block_k)
   p = jnp.exp(s - lse)                                  # f32 [bq, bk]
   dp = _DotF32(do, v, (1, 1))                           # [block_q, block_k]
   ds = p * (dp - delta) * sm_scale
   return q, k, do, p, ds
 
 
-def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
-               *, block_q: int, block_k: int, nk: int, causal: bool,
-               sm_scale: float):
+def _FwdKernel(*refs, block_q: int, block_k: int, nk: int, causal: bool,
+               sm_scale: float, has_seg: bool):
   """One (batch*head, q_block, k_block) program step."""
+  if has_seg:
+    (q_ref, k_ref, v_ref, sq_ref, sk_ref, out_ref, lse_ref, m_scr, l_scr,
+     acc_scr) = refs
+  else:
+    q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    sq_ref = sk_ref = None
   qi = pl.program_id(1)
   kb = pl.program_id(2)
   q_start = qi * block_q
@@ -117,11 +140,18 @@ def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
     s = _DotF32(q, k, (1, 1)) * sm_scale                # f32 [bq, bk]
     if causal:
       s = _ApplyCausalMask(s, q_start, k_start, block_q, block_k)
+    if sq_ref is not None:
+      s = _ApplySegmentMask(s, sq_ref, sk_ref, block_q, block_k)
     m_prev = m_scr[:, :1]                               # [block_q, 1]
     l_prev = l_scr[:, :1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)
+    # Rows with no unmasked key yet have m_new = NEG_INF; exp(s - m_new)
+    # would be exp(0) = 1 for masked entries (causal-only kernels dodge
+    # this because the diagonal appears in k-block 0, but segment masks
+    # don't). Substitute 0 so masked rows contribute p = exp(NEG_INF) = 0.
+    m_safe = jnp.where(m_new <= NEG_INF * 0.5, 0.0, m_new)
+    p = jnp.exp(s - m_safe)
     alpha = jnp.exp(m_prev - m_new)
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(
@@ -149,22 +179,42 @@ def _FwdKernel(q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
                                   lse_ref.shape[1:]).astype(lse_ref.dtype)
 
 
-def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
+def _FlashForward(q, k, v, seg, block_q: int, block_k: int, causal: bool,
                   interpret: bool):
-  """q/k/v: [bn, t, h] -> (out [bn, t, h], lse [bn, t, LANES])."""
+  """q/k/v: [bn, t, h], seg: [bn, t] int32 or None
+  -> (out [bn, t, h], lse [bn, t, LANES])."""
   bn, t, h = q.shape
   sm_scale = 1.0 / math.sqrt(h)
   nq, nk = t // block_q, t // block_k
   kernel = functools.partial(
       _FwdKernel, block_q=block_q, block_k=block_k, nk=nk, causal=causal,
-      sm_scale=sm_scale)
+      sm_scale=sm_scale, has_seg=seg is not None)
   if causal:
     # clamp the K/V block index so fully-masked grid steps re-request the
     # previous block — Pallas elides the DMA (no wasted HBM bandwidth).
-    kv_idx = lambda b, i, j: (
-        b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
+    kv_blk = lambda i, j: jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
   else:
-    kv_idx = lambda b, i, j: (b, j, 0)
+    kv_blk = lambda i, j: j
+  inputs = [q, k, v]
+  in_specs = [
+      pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+      pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, kv_blk(i, j), 0)),
+      pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, kv_blk(i, j), 0)),
+  ]
+  if seg is not None:
+    # seg is [b_true, t] (per-batch, not per-head); index maps divide the
+    # flattened batch*head grid index back down so heads share one copy
+    n_rep = bn // seg.shape[0]
+    seg_q = jnp.broadcast_to(seg[:, :, None],
+                             (seg.shape[0], t, LANES)).astype(jnp.int32)
+    seg_kv = jnp.broadcast_to(seg[:, None, :],
+                              (seg.shape[0], SUBLANES, t)).astype(jnp.int32)
+    inputs += [seg_q, seg_kv]
+    in_specs += [
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b // n_rep, i, 0)),
+        pl.BlockSpec((1, SUBLANES, block_k),
+                     lambda b, i, j: (b // n_rep, 0, kv_blk(i, j))),
+    ]
   out, lse = pl.pallas_call(
       kernel,
       out_shape=[
@@ -172,11 +222,7 @@ def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
           jax.ShapeDtypeStruct((bn, t, LANES), jnp.float32),
       ],
       grid=(bn, nq, nk),
-      in_specs=[
-          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
-          pl.BlockSpec((1, block_k, h), kv_idx),
-          pl.BlockSpec((1, block_k, h), kv_idx),
-      ],
+      in_specs=in_specs,
       out_specs=[
           pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
           pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
@@ -189,14 +235,20 @@ def _FlashForward(q, k, v, block_q: int, block_k: int, causal: bool,
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=("parallel", "parallel", "arbitrary")),
       interpret=interpret,
-  )(q, k, v)
+  )(*inputs)
   return out, lse
 
 
-def _DkDvKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_scr, dv_scr, *, block_q: int, block_k: int,
-                nq: int, causal: bool, sm_scale: float):
+def _DkDvKernel(*refs, block_q: int, block_k: int, nq: int, causal: bool,
+                sm_scale: float, has_seg: bool):
   """One (batch*head, k_block, q_block) step: accumulate dK, dV."""
+  if has_seg:
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+     dk_ref, dv_ref, dk_scr, dv_scr) = refs
+  else:
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr) = refs
+    sq_ref = sk_ref = None
   kb = pl.program_id(1)
   qi = pl.program_id(2)
   q_start = qi * block_q
@@ -209,8 +261,9 @@ def _DkDvKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
   def _Accumulate():
     q, _, do, p, ds = _RecomputePandDs(
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start, k_start,
-        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+        q_start, k_start, block_q=block_q, block_k=block_k, causal=causal,
+        sm_scale=sm_scale)
     dv_scr[:] = dv_scr[:] + _DotF32(p.astype(do.dtype), do, (0, 0))
     dk_scr[:] = dk_scr[:] + _DotF32(ds.astype(q.dtype), q, (0, 0))
 
@@ -225,10 +278,15 @@ def _DkDvKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _DqKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-              dq_scr, *, block_q: int, block_k: int, nk: int, causal: bool,
-              sm_scale: float):
+def _DqKernel(*refs, block_q: int, block_k: int, nk: int, causal: bool,
+              sm_scale: float, has_seg: bool):
   """One (batch*head, q_block, k_block) step: accumulate dQ."""
+  if has_seg:
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+     dq_ref, dq_scr) = refs
+  else:
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+    sq_ref = sk_ref = None
   qi = pl.program_id(1)
   kb = pl.program_id(2)
   q_start = qi * block_q
@@ -240,8 +298,9 @@ def _DqKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
   def _Accumulate():
     _, k, _, _, ds = _RecomputePandDs(
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start, k_start,
-        block_q=block_q, block_k=block_k, causal=causal, sm_scale=sm_scale)
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+        q_start, k_start, block_q=block_q, block_k=block_k, causal=causal,
+        sm_scale=sm_scale)
     dq_scr[:] = dq_scr[:] + _DotF32(ds.astype(k.dtype), k, (1, 0))
 
   if causal:
@@ -254,43 +313,56 @@ def _DqKernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
+def _FlashBackward(q, k, v, seg, out, lse, do, block_q: int, block_k: int,
                    causal: bool, interpret: bool):
   bn, t, h = q.shape
   sm_scale = 1.0 / math.sqrt(h)
   nq, nk = t // block_q, t // block_k
+  has_seg = seg is not None
   delta = jnp.broadcast_to(
       jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
               keepdims=True), (bn, t, LANES))           # [bn, t, LANES]
   if causal:
-    kv_idx = lambda b, i, j: (
-        b, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
-  else:
-    kv_idx = lambda b, i, j: (b, j, 0)
-
-  if causal:
+    kv_blk = lambda i, j: jnp.minimum(j, ((i + 1) * block_q - 1) // block_k)
     qi_of = lambda j, i: jnp.maximum(i, (j * block_k) // block_q)
   else:
+    kv_blk = lambda i, j: j
     qi_of = lambda j, i: i
   q_idx = lambda b, j, i: (b, qi_of(j, i), 0)
   row_idx = lambda b, j, i: (b, qi_of(j, i), 0)
+
+  dkdv_inputs = [q, k, v, do, lse, delta]
+  dkdv_specs = [
+      pl.BlockSpec((1, block_q, h), q_idx),                      # q
+      pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # k
+      pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # v
+      pl.BlockSpec((1, block_q, h), q_idx),                      # do
+      pl.BlockSpec((1, block_q, LANES), row_idx),                # lse
+      pl.BlockSpec((1, block_q, LANES), row_idx),                # delta
+  ]
+  if has_seg:
+    n_rep = bn // seg.shape[0]
+    seg_q3 = jnp.broadcast_to(seg[:, :, None],
+                              (seg.shape[0], t, LANES)).astype(jnp.int32)
+    seg_kv3 = jnp.broadcast_to(seg[:, None, :],
+                               (seg.shape[0], SUBLANES, t)).astype(jnp.int32)
+    dkdv_inputs += [seg_q3, seg_kv3]
+    dkdv_specs += [
+        pl.BlockSpec((1, block_q, LANES),
+                     lambda b, j, i: (b // n_rep, qi_of(j, i), 0)),
+        pl.BlockSpec((1, SUBLANES, block_k),
+                     lambda b, j, i: (b // n_rep, 0, j)),
+    ]
   dk, dv = pl.pallas_call(
       functools.partial(
           _DkDvKernel, block_q=block_q, block_k=block_k, nq=nq,
-          causal=causal, sm_scale=sm_scale),
+          causal=causal, sm_scale=sm_scale, has_seg=has_seg),
       out_shape=[
           jax.ShapeDtypeStruct((bn, t, h), k.dtype),
           jax.ShapeDtypeStruct((bn, t, h), v.dtype),
       ],
       grid=(bn, nk, nq),
-      in_specs=[
-          pl.BlockSpec((1, block_q, h), q_idx),                      # q
-          pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # k
-          pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),  # v
-          pl.BlockSpec((1, block_q, h), q_idx),                      # do
-          pl.BlockSpec((1, block_q, LANES), row_idx),                # lse
-          pl.BlockSpec((1, block_q, LANES), row_idx),                # delta
-      ],
+      in_specs=dkdv_specs,
       out_specs=[
           pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),
           pl.BlockSpec((1, block_k, h), lambda b, j, i: (b, j, 0)),
@@ -302,54 +374,83 @@ def _FlashBackward(q, k, v, out, lse, do, block_q: int, block_k: int,
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=("parallel", "parallel", "arbitrary")),
       interpret=interpret,
-  )(q, k, v, do, lse, delta)
+  )(*dkdv_inputs)
 
+  dq_inputs = [q, k, v, do, lse, delta]
+  dq_specs = [
+      pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # q
+      pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, kv_blk(i, j), 0)),
+      pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, kv_blk(i, j), 0)),
+      pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # do
+      pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # lse
+      pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # delta
+  ]
+  if has_seg:
+    dq_inputs += [seg_q3, seg_kv3]
+    dq_specs += [
+        pl.BlockSpec((1, block_q, LANES),
+                     lambda b, i, j: (b // n_rep, i, 0)),
+        pl.BlockSpec((1, SUBLANES, block_k),
+                     lambda b, i, j: (b // n_rep, 0, kv_blk(i, j))),
+    ]
   dq = pl.pallas_call(
       functools.partial(
           _DqKernel, block_q=block_q, block_k=block_k, nk=nk, causal=causal,
-          sm_scale=sm_scale),
+          sm_scale=sm_scale, has_seg=has_seg),
       out_shape=jax.ShapeDtypeStruct((bn, t, h), q.dtype),
       grid=(bn, nq, nk),
-      in_specs=[
-          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # q
-          pl.BlockSpec((1, block_k, h), kv_idx),                     # k
-          pl.BlockSpec((1, block_k, h), kv_idx),                     # v
-          pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),  # do
-          pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # lse
-          pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),  # delta
-      ],
+      in_specs=dq_specs,
       out_specs=pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
       scratch_shapes=[pltpu.VMEM((block_q, h), jnp.float32)],
       compiler_params=pltpu.CompilerParams(
           dimension_semantics=("parallel", "parallel", "arbitrary")),
       interpret=interpret,
-  )(q, k, v, do, lse, delta)
+  )(*dq_inputs)
   return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _FlashCore(q, k, v, block_q, block_k, causal, interpret):
-  out, _ = _FlashForward(q, k, v, block_q, block_k, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _FlashCore(q, k, v, seg, block_q, block_k, causal, interpret):
+  out, _ = _FlashForward(q, k, v, seg, block_q, block_k, causal, interpret)
   return out
 
 
-def _FlashCoreFwd(q, k, v, block_q, block_k, causal, interpret):
-  out, lse = _FlashForward(q, k, v, block_q, block_k, causal, interpret)
-  return out, (q, k, v, out, lse)
+def _FlashCoreFwd(q, k, v, seg, block_q, block_k, causal, interpret):
+  out, lse = _FlashForward(q, k, v, seg, block_q, block_k, causal, interpret)
+  return out, (q, k, v, seg, out, lse)
 
 
 def _FlashCoreBwd(block_q, block_k, causal, interpret, res, g):
-  q, k, v, out, lse = res
-  return _FlashBackward(q, k, v, out, lse, g, block_q, block_k, causal,
-                        interpret)
+  q, k, v, seg, out, lse = res
+  dq, dk, dv = _FlashBackward(q, k, v, seg, out, lse, g, block_q, block_k,
+                              causal, interpret)
+  return dq, dk, dv, None
 
 
 _FlashCore.defvjp(_FlashCoreFwd, _FlashCoreBwd)
 
 
-def FlashAttention(q, k, v, *, causal: bool = True, block_q: int = 1024,
-                   block_k: int = 1024, interpret: bool | None = None):
+def SupportedOnTpu(t: int, with_segments: bool = False) -> bool:
+  """Whether a [*, t, *, *] input can lower on real TPU hardware.
+
+  Without segments any t whose fitted blocks divide it works (t % 16 is
+  plenty); the segment path additionally needs the fitted block_k to stay
+  128-lane aligned, i.e. t a multiple of 128 (see _FlashForward specs).
+  """
+  if t % 16 != 0:
+    return False
+  return not with_segments or t % LANES == 0
+
+
+def FlashAttention(q, k, v, *, causal: bool = True, segment_ids=None,
+                   block_q: int = 1024, block_k: int = 1024,
+                   interpret: bool | None = None):
   """Fused attention. q/k/v: [b, t, n, h] -> [b, t, n, h].
+
+  segment_ids: optional [b, t] int — packed-input segment mask (pairs with
+  different ids never attend; padding should carry id 0, whose positions
+  produce finite loss-masked garbage rather than NaN). This is what lets
+  the packed GShard LM recipe run on the fused kernel.
 
   Scaling by 1/sqrt(h) happens INSIDE (don't pre-scale q). Block sizes are
   shrunk automatically to the largest power of two dividing T; h should be a
@@ -378,10 +479,24 @@ def FlashAttention(q, k, v, *, causal: bool = True, block_q: int = 1024,
   block_q = _FitBlock(block_q)
   block_k = _FitBlock(block_k)
   assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
+  if not interpret and segment_ids is not None and (
+      block_k % LANES != 0 or block_q % SUBLANES != 0):
+    # the segment-id kv spec puts block_k on the 128-lane minor axis; a
+    # shrunken block (t not a multiple of 128) cannot lower on TPU —
+    # callers gate on SupportedOnTpu, this is the backstop
+    raise ValueError(
+        f"segment_ids flash path needs block_q % {SUBLANES} == 0 and "
+        f"block_k % {LANES} == 0 on TPU; t={t} gave ({block_q}, {block_k}). "
+        "Pad t to a multiple of 128 or use the unfused path.")
 
   def _Flat(x):
     return x.transpose(0, 2, 1, 3).reshape(b * n, t, h)
 
-  out = _FlashCore(_Flat(q), _Flat(k), _Flat(v), block_q, block_k, causal,
-                   interpret)
+  seg = None
+  if segment_ids is not None:
+    # [b, t]; heads share one copy (the kernels' index maps divide the
+    # flattened batch*head index back down, matching _Flat's b-major order)
+    seg = segment_ids.astype(jnp.int32)
+  out = _FlashCore(_Flat(q), _Flat(k), _Flat(v), seg, block_q, block_k,
+                   causal, interpret)
   return out.reshape(b, n, t, h).transpose(0, 2, 1, 3)

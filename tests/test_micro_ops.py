@@ -204,3 +204,16 @@ class TestMultiTaskSharingEndToEnd:
         sched.CreateTrainState(jax.random.PRNGKey(0)).tasks.GetItem(
             "a").theta.proj.w)
     assert not np.array_equal(wa, w0)
+
+
+class TestInspectUtilsBoundCollision:
+
+  def test_callable_param_named_bound_is_forwarded(self):
+    def fn(bound, x=1):
+      return (bound, x)
+
+    p = hyperparams.Params()
+    inspect_utils.DefineParams(fn, p)
+    p.bound = 42
+    assert inspect_utils.CallWithParams(fn, p) == (42, 1)
+    assert inspect_utils.CallWithParams(fn, p, bound=7) == (7, 1)

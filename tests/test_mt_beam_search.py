@@ -169,3 +169,52 @@ class TestMtTask:
     results = task.DecodeFinalize(dm)
     assert "corpus_bleu" in results
     assert results["examples"] > 0
+
+
+class TestMergeBeamSearchOutputs:
+
+  def test_merge_dedupes_and_sorts(self):
+    from lingvo_tpu.core import beam_search
+    from lingvo_tpu.core.nested_map import NestedMap
+    import jax.numpy as jnp
+    import numpy as np
+    # decoder A: hyps [1,2] (score -1), [3,4,5] (score -3)
+    # decoder B: hyps [1,2] (score -2, duplicate), [7] (score -0.5)
+    a = NestedMap(
+        topk_ids=jnp.array([[[1, 2, 0], [3, 4, 5]]]),
+        topk_lens=jnp.array([[2, 3]]),
+        topk_scores=jnp.array([[-1.0, -3.0]]))
+    b = NestedMap(
+        topk_ids=jnp.array([[[1, 2, 9], [7, 0, 0]]]),  # trailing junk ignored
+        topk_lens=jnp.array([[2, 1]]),
+        topk_scores=jnp.array([[-2.0, -0.5]]))
+    out = beam_search.MergeBeamSearchOutputs(3, [a, b])
+    np.testing.assert_array_equal(np.asarray(out.topk_scores[0]),
+                                  [-0.5, -1.0, -3.0])
+    np.testing.assert_array_equal(np.asarray(out.topk_ids[0, 0, :1]), [7])
+    np.testing.assert_array_equal(np.asarray(out.topk_ids[0, 1, :2]), [1, 2])
+
+  def test_jit_compatible(self):
+    import jax
+    from lingvo_tpu.core import beam_search
+    from lingvo_tpu.core.nested_map import NestedMap
+    import jax.numpy as jnp
+    a = NestedMap(topk_ids=jnp.zeros((2, 4, 8), jnp.int32),
+                  topk_lens=jnp.ones((2, 4), jnp.int32),
+                  topk_scores=jnp.arange(8.0).reshape(2, 4))
+    out = jax.jit(lambda a: beam_search.MergeBeamSearchOutputs(2, [a, a]))(a)
+    assert out.topk_ids.shape == (2, 2, 8)
+
+  def test_merge_blanks_padding_slots(self):
+    from lingvo_tpu.core import beam_search
+    from lingvo_tpu.core.nested_map import NestedMap
+    import jax.numpy as jnp
+    import numpy as np
+    # both decoders agree on the single hyp; asking for 3 leaves 2 blank
+    a = NestedMap(topk_ids=jnp.array([[[5, 6, 0]]]),
+                  topk_lens=jnp.array([[2]]),
+                  topk_scores=jnp.array([[-1.0]]))
+    out = beam_search.MergeBeamSearchOutputs(3, [a, a])
+    assert np.isneginf(np.asarray(out.topk_scores[0, 1:])).all()
+    np.testing.assert_array_equal(np.asarray(out.topk_ids[0, 1:]), 0)
+    np.testing.assert_array_equal(np.asarray(out.topk_lens[0, 1:]), 0)
