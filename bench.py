@@ -181,21 +181,35 @@ def _BenchFlashAttention(jax, jnp, on_tpu):
   }
 
 
-def _BenchMoE(jax, jnp, model_registry, on_tpu):
-  """64-expert MoE LM single-chip train step (VERDICT r1 item 1)."""
+def _BenchMoE(jax, jnp, model_registry, on_tpu, peak):
+  """64-expert MoE LM single-chip train step (VERDICT r1 item 1).
+
+  MFU counts ACTIVE flops: dense params fully, expert FFNs at top-2/E
+  utilization (the GShard accounting); routing/dispatch einsums are
+  overhead, not model flops.
+  """
   mp = model_registry.GetParams("lm.synthetic_packed_input.MoELmTiny",
                                 "Train")
   mp.task.input = mp.input
   if on_tpu:
-    mp.task.model_dim = 512
-    mp.task.hidden_dim = 2048
-    mp.task.num_heads = 8
+    # 64 experts has to fit a single 16G chip with f32 master weights +
+    # f32 grads + bf16 casts: 3 MoE layers x 64 x 2 x (1024*2048) = 805M
+    # expert params (3.2G f32)
+    mp.task.model_dim = 1024
+    mp.task.hidden_dim = 4096
+    mp.task.moe_hidden_dim = 2048
+    mp.task.num_heads = 16
     mp.task.num_layers = 6
     mp.task.num_experts = 64
+    mp.task.moe_num_groups = 8
     mp.task.vocab_size = 32768
     mp.task.input.vocab_size = 32768
     mp.task.input.seq_len = 1024
     mp.task.input.batch_size = 8
+    mp.task.remat_policy = "dots"
+    from lingvo_tpu.core import attention as attention_lib
+    mp.task.atten_tpl = attention_lib.MultiHeadedAttention.Params().Set(
+        use_flash_attention=True)
   else:
     mp.task.num_experts = 8
     mp.task.input.seq_len = 32
@@ -218,10 +232,25 @@ def _BenchMoE(jax, jnp, model_registry, on_tpu):
       _Dispatch, lambda out: float(out.metrics.loss[0]),
       *( (3, 13) if on_tpu else (1, 3) ))
   ntok = int(np.prod(batch.ids.shape))
+  from lingvo_tpu.core import py_utils
+  p = mp.task
+  n_params = py_utils.CountParams(state.theta)
+  # expert FFN weights: E * (wi [D,H] + wo [H,D]) per MoE layer
+  expert_params = (p.num_layers // 2) * p.num_experts * 2 * (
+      p.model_dim * (p.moe_hidden_dim or p.hidden_dim))
+  dense_params = n_params - expert_params
+  active = dense_params + expert_params * 2.0 / p.num_experts  # top-2
+  b, t = batch.ids.shape
+  attn = 12.0 * b * t * t * p.model_dim * p.num_layers
+  flops = 6.0 * active * ntok + attn
+  mfu = flops / (step * peak)
   return {
-      "num_experts": mp.task.num_experts,
+      "num_experts": p.num_experts,
+      "params_m": round(n_params / 1e6, 1),
+      "active_params_m": round(active / 1e6, 1),
       "step_time_ms": round(step * 1e3, 2),
       "tokens_per_sec": round(ntok / step, 1),
+      "mfu": round(mfu, 4),
   }
 
 
@@ -343,7 +372,7 @@ def main():
   except Exception as e:  # noqa: BLE001
     detail["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
   try:
-    detail["moe"] = _BenchMoE(jax, jnp, model_registry, on_tpu)
+    detail["moe"] = _BenchMoE(jax, jnp, model_registry, on_tpu, peak)
   except Exception as e:  # noqa: BLE001
     detail["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 

@@ -190,6 +190,14 @@ def MergeBeamSearchOutputs(max_hyps_per_beam: int, beam_search_outputs):
   lens = jnp.concatenate([o.topk_lens for o in beam_search_outputs], axis=1)
   scores = jnp.concatenate([o.topk_scores for o in beam_search_outputs],
                            axis=1)
+  if ids.shape[1] < max_hyps_per_beam:
+    # keep the documented [B, max_hyps_per_beam, T] layout even when the
+    # pool is smaller than requested: pad with blank -inf slots
+    pad = max_hyps_per_beam - ids.shape[1]
+    ids = jnp.pad(ids, ((0, 0), (0, pad), (0, 0)))
+    lens = jnp.pad(lens, ((0, 0), (0, pad)))
+    scores = jnp.pad(scores, ((0, 0), (0, pad)),
+                     constant_values=-jnp.inf)
   b, k, t = ids.shape
   # duplicate = same length and same ids within that length
   pos = jnp.arange(t)

@@ -126,6 +126,50 @@ def Top2Gating(logits: jax.Array,
       combine_tensor=combine, dispatch_tensor=dispatch, aux_loss=aux_loss)
 
 
+def SinkhornGating(logits: jax.Array,
+                   paddings: jax.Array | None,
+                   capacity_factor: float = 2.0,
+                   num_iters: int = 10,
+                   temperature: float = 1.0,
+                   capacity: int | None = None):
+  """Optimal-transport (Sinkhorn) top-1 gating (ref `gshard_layers.py:2736`
+  optimal-transport gating, via `differentiable_assignment.py`).
+
+  A Sinkhorn-balanced transport plan picks each token's expert — the plan's
+  column marginals are equalized, so routing is load-balanced *by
+  construction* and no aux loss is needed (aux_loss = 0). The combine
+  weight is the ordinary softmax gate probability of the chosen expert.
+
+  Gradient contract: the plan is consumed through argmax, so the router
+  trains ONLY through the gate values of the selected experts (like top-1
+  gating); `num_iters`/`temperature` shape the forward routing decision,
+  not the gradient. Balance comes from the forward plan, not from loss
+  pressure.
+  """
+  from lingvo_tpu.core import extras
+  g, s, e = logits.shape
+  c = _DeriveCapacity(s, e, capacity_factor, capacity)
+  raw_gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G,S,E]
+  nonpad = (1.0 - paddings) if paddings is not None else jnp.ones(
+      (g, s), jnp.float32)
+  # mask pad rows out of the plan so they don't consume expert budget
+  scores = logits.astype(jnp.float32) + jnp.where(
+      nonpad[..., None] > 0, 0.0, -1e9)
+  plan = extras.SinkhornAssignment(scores, num_iters=num_iters,
+                                   temperature=temperature)       # [G,S,E]
+  index_1 = jnp.argmax(plan, axis=-1)                             # [G,S]
+  mask_1 = jax.nn.one_hot(index_1, e, dtype=jnp.float32) * nonpad[..., None]
+  gate_1 = jnp.sum(raw_gates * mask_1, axis=-1)                   # [G,S]
+  mask_1, pos_1_tok = _PositionInExpert(mask_1, c)
+  gate_1 = gate_1 * jnp.sum(mask_1, axis=-1)
+  onehot_c = jax.nn.one_hot(pos_1_tok.astype(jnp.int32), c,
+                            dtype=jnp.float32)                    # [G,S,C]
+  combine = gate_1[..., None, None] * mask_1[..., None] * \
+      onehot_c[:, :, None, :]
+  return NestedMap(combine_tensor=combine, dispatch_tensor=combine > 0.0,
+                   aux_loss=jnp.zeros((), jnp.float32))
+
+
 def HashGating(token_ids: jax.Array,
                num_experts: int,
                paddings: jax.Array | None,
@@ -198,8 +242,13 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     p.Define("capacity_factor", 2.0, "Per-expert capacity factor.")
     p.Define("activation", "RELU", "Expert FFN activation.")
     p.Define("gating_policy", "top2",
-             "'top2' (learned router) or 'hash' (id-hash top-1, ref "
-             "HashGatingOnLogits:2367; requires token_ids at FProp).")
+             "'top2' (learned router), 'hash' (id-hash top-1, ref "
+             "HashGatingOnLogits:2367; requires token_ids at FProp), or "
+             "'sinkhorn' (optimal-transport balanced top-1, ref "
+             "gshard_layers.py:2736; no aux loss).")
+    p.Define("sinkhorn_num_iters", 10, "Sinkhorn iterations ('sinkhorn').")
+    p.Define("sinkhorn_temperature", 1.0,
+             "Sinkhorn temperature ('sinkhorn').")
     p.Define("shuffle_tokens", False,
              "Randomly permute tokens within each group before capacity "
              "truncation (ref gshard_layers.py:2496) so drops are unbiased; "
@@ -286,6 +335,14 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
         idg = _TakeAlongS(idg[..., None], perm)[..., 0]
       gating = HashGating(idg, p.num_experts, pg_gate, p.capacity_factor,
                           capacity=p.expert_capacity or None)
+    elif p.gating_policy == "sinkhorn":
+      logits = jnp.einsum("GSD,DE->GSE", xg_gate,
+                          th.gating.astype(xg.dtype))
+      gating = SinkhornGating(
+          logits, pg_gate, p.capacity_factor,
+          num_iters=p.sinkhorn_num_iters,
+          temperature=p.sinkhorn_temperature,
+          capacity=p.expert_capacity or None)
     else:
       logits = jnp.einsum("GSD,DE->GSE", xg_gate,
                           th.gating.astype(xg.dtype))

@@ -215,6 +215,8 @@ class TestMergeBeamSearchOutputs:
                   topk_lens=jnp.array([[2]]),
                   topk_scores=jnp.array([[-1.0]]))
     out = beam_search.MergeBeamSearchOutputs(3, [a, a])
+    # documented fixed layout even when the pool is smaller than requested
+    assert out.topk_ids.shape == (1, 3, 3)
     assert np.isneginf(np.asarray(out.topk_scores[0, 1:])).all()
     np.testing.assert_array_equal(np.asarray(out.topk_ids[0, 1:]), 0)
     np.testing.assert_array_equal(np.asarray(out.topk_lens[0, 1:]), 0)

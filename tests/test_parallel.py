@@ -495,3 +495,55 @@ class TestMoEAtScale:
     batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
     metrics, _ = task.EvalStep(theta, batch)
     assert np.isfinite(float(metrics.loss[0]))
+
+
+class TestSinkhornGating:
+
+  def test_balanced_routing_under_skewed_logits(self):
+    # all tokens prefer expert 0; Sinkhorn's balanced plan must spread them
+    g, s, e = 1, 16, 4
+    logits = jax.random.normal(KEY, (g, s, e)) * 0.1
+    logits = logits.at[:, :, 0].add(5.0)
+    out = gshard.SinkhornGating(logits, None, capacity_factor=2.0,
+                                num_iters=20)
+    per_expert = np.asarray(out.dispatch_tensor.sum(axis=(1, 3)))[0]  # [E]
+    # top-2 greedy would put min(c, 16) on expert 0 and 0 on some others;
+    # the OT plan must assign every expert a nontrivial share
+    assert per_expert.min() >= 2, per_expert
+    assert float(out.aux_loss) == 0.0
+
+  def test_combine_weights_and_capacity(self):
+    g, s, e = 2, 12, 3
+    logits = jax.random.normal(jax.random.PRNGKey(7), (g, s, e))
+    out = gshard.SinkhornGating(logits, None, capacity_factor=1.0)
+    c = out.combine_tensor.shape[-1]
+    assert c == 4  # ceil(12/3*1)
+    slot_usage = np.asarray(out.dispatch_tensor.sum(1))  # [G,E,C]
+    assert slot_usage.max() <= 1.0 + 1e-6
+    # top-1: each surviving token uses exactly one expert slot, with the
+    # softmax gate prob as its weight (in (0, 1))
+    w = np.asarray(out.combine_tensor.sum(axis=(2, 3)))
+    assert (w >= 0).all() and (w <= 1.0 + 1e-6).all()
+
+  def test_paddings_excluded(self):
+    g, s, e = 1, 8, 2
+    logits = jax.random.normal(KEY, (g, s, e))
+    paddings = jnp.zeros((g, s)).at[:, 6:].set(1.0)
+    out = gshard.SinkhornGating(logits, paddings)
+    np.testing.assert_allclose(
+        np.asarray(out.combine_tensor[:, 6:]).sum(), 0.0, atol=1e-6)
+
+  def test_moe_layer_with_sinkhorn_policy_trains(self):
+    p = gshard.MoEFeedForwardLayer.Params().Set(
+        name="moe", input_dim=16, hidden_dim=32, num_experts=4,
+        num_groups=2, gating_policy="sinkhorn")
+    layer = p.Instantiate()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 16))
+
+    def loss(th, x):
+      return jnp.mean(jnp.square(layer.FProp(th, x)))
+
+    g = jax.jit(jax.grad(loss))(theta, x)
+    # router gets gradients through the gate values
+    assert float(jnp.sum(jnp.abs(g.gating))) > 0
