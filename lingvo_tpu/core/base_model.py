@@ -60,6 +60,13 @@ class BaseTask(base_layer.BaseLayer):
               "re.sub(target_regex, source_template, path), with dtype "
               "casting (ref bfloat16_variables.py). Applied only when no "
               "checkpoint exists in the run's own train dir.")
+    tp.Define("init_from_npz", "",
+              "Warm start from a converted reference checkpoint "
+              "(tools/convert_tf_checkpoint.py .npz); applied on fresh "
+              "init like init_from_checkpoint_rules.")
+    tp.Define("init_from_npz_rules", None,
+              "Optional [(target_regex, source_template)] name mapping for "
+              "init_from_npz (None = npz keys are already theta paths).")
     tp.Define("pruning", None,
               "Optional core.pruning.PruningSchedule params: magnitude "
               "masks updated at the schedule cadence and re-applied after "

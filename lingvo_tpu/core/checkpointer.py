@@ -192,10 +192,13 @@ def ApplyInitFromCheckpointRules(state: NestedMap, rules: dict) -> NestedMap:
           raise ValueError(
               f"init_from_checkpoint_rules: shape mismatch for {path}: "
               f"{np.shape(value)} vs source {np.shape(src_val)}")
-        new_val = jnp.asarray(src_val, dtype=value.dtype)
+        # host-side cast + direct sharded placement (see ImportNpzCheckpoint)
+        host_val = np.asarray(src_val).astype(value.dtype)
         if isinstance(value, jax.Array) and hasattr(value, "sharding"):
           # keep the target's (possibly multi-host) sharding layout
-          new_val = jax.device_put(new_val, value.sharding)
+          new_val = jax.device_put(host_val, value.sharding)
+        else:
+          new_val = jnp.asarray(host_val)
         state.theta.Set(path, new_val)
         # EMA shadows theta at init (base_model copies theta into
         # ema_theta BEFORE warm start runs): mirror the warm value or
@@ -207,4 +210,65 @@ def ApplyInitFromCheckpointRules(state: NestedMap, rules: dict) -> NestedMap:
             f"@ step {src_step}", flush=True)
     finally:
       mgr.close()
+  return state
+
+
+def ImportNpzCheckpoint(state: NestedMap, npz_path: str,
+                        rules=None) -> NestedMap:
+  """Initializes state.theta from a converted reference checkpoint.
+
+  The .npz is produced by `tools/convert_tf_checkpoint.py` (dotted-path
+  keys -> arrays). `rules` is an optional list of (target_regex,
+  source_template) pairs like init_from_checkpoint_rules; None means
+  identity mapping (the npz keys already use this framework's theta
+  paths). Matched leaves are shape-checked and dtype-cast; theta paths with
+  no matching npz entry keep their fresh initialization, but a RULE whose
+  mapped source is missing raises (a silent miss hides naming bugs).
+  """
+  import re
+
+  import jax.numpy as jnp
+
+  src = np.load(npz_path)
+  src_keys = set(src.files)
+  n_loaded = 0
+  for path, value in state.theta.FlattenItems():
+    if rules is None:
+      src_path = path if path in src_keys else None
+      required = False
+    else:
+      src_path = None
+      required = False
+      for target_regex, source_tpl in rules:
+        if re.fullmatch(target_regex, path):
+          src_path = re.sub(target_regex, source_tpl, path)
+          required = True
+          break
+    if src_path is None:
+      continue
+    if src_path not in src_keys:
+      if required:
+        raise KeyError(
+            f"ImportNpzCheckpoint: {path!r} maps to {src_path!r} which is "
+            f"not in {npz_path} ({len(src_keys)} vars)")
+      continue
+    src_val = src[src_path]
+    if tuple(src_val.shape) != tuple(np.shape(value)):
+      raise ValueError(
+          f"ImportNpzCheckpoint: shape mismatch for {path}: "
+          f"{np.shape(value)} vs source {src_val.shape}")
+    # cast on the host, then place directly into the target's sharding —
+    # never materialize the full array on one device (a sharded expert
+    # table can exceed a single chip's HBM)
+    host_val = np.asarray(src_val).astype(value.dtype)
+    if isinstance(value, jax.Array) and hasattr(value, "sharding"):
+      new_val = jax.device_put(host_val, value.sharding)
+    else:
+      new_val = jnp.asarray(host_val)
+    state.theta.Set(path, new_val)
+    if "ema_theta" in state:
+      state.ema_theta.Set(path, new_val)
+    n_loaded += 1
+  print(f"[checkpointer] npz import: {n_loaded} vars from {npz_path}",
+        flush=True)
   return state

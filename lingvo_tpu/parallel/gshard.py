@@ -126,6 +126,29 @@ def Top2Gating(logits: jax.Array,
       combine_tensor=combine, dispatch_tensor=dispatch, aux_loss=aux_loss)
 
 
+def _MaskedSinkhorn(log_p: jax.Array, nonpad: jax.Array, num_iters: int):
+  """Sinkhorn iterations over [G,S,E] with pad ROWS excluded.
+
+  A plain doubly-stochastic normalization lets pad rows keep full mass
+  (row normalization cancels any uniform shift), so pad tokens would eat
+  most of each expert's column budget in short groups and the balance
+  guarantee among real tokens would quietly vanish. Here pad rows are
+  forced to ~zero mass after every row step, so column marginals equalize
+  over REAL tokens only.
+  """
+  neg = -1e9
+  real = nonpad[..., None] > 0                       # [G,S,1]
+
+  def _Iter(lp, _):
+    lp = jnp.where(real, lp - jax.nn.logsumexp(lp, -1, keepdims=True), neg)
+    lp = lp - jax.nn.logsumexp(lp, -2, keepdims=True)
+    return lp, ()
+
+  lp, _ = jax.lax.scan(_Iter, jnp.where(real, log_p, neg), None,
+                       length=num_iters)
+  return jnp.exp(lp) * nonpad[..., None]
+
+
 def SinkhornGating(logits: jax.Array,
                    paddings: jax.Array | None,
                    capacity_factor: float = 2.0,
@@ -146,17 +169,13 @@ def SinkhornGating(logits: jax.Array,
   not the gradient. Balance comes from the forward plan, not from loss
   pressure.
   """
-  from lingvo_tpu.core import extras
   g, s, e = logits.shape
   c = _DeriveCapacity(s, e, capacity_factor, capacity)
   raw_gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G,S,E]
   nonpad = (1.0 - paddings) if paddings is not None else jnp.ones(
       (g, s), jnp.float32)
-  # mask pad rows out of the plan so they don't consume expert budget
-  scores = logits.astype(jnp.float32) + jnp.where(
-      nonpad[..., None] > 0, 0.0, -1e9)
-  plan = extras.SinkhornAssignment(scores, num_iters=num_iters,
-                                   temperature=temperature)       # [G,S,E]
+  plan = _MaskedSinkhorn(logits.astype(jnp.float32) / temperature,
+                         nonpad, num_iters)                       # [G,S,E]
   index_1 = jnp.argmax(plan, axis=-1)                             # [G,S]
   mask_1 = jax.nn.one_hot(index_1, e, dtype=jnp.float32) * nonpad[..., None]
   gate_1 = jnp.sum(raw_gates * mask_1, axis=-1)                   # [G,S]

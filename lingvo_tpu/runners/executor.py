@@ -178,6 +178,11 @@ class ExecutorTpu:
         # fresh run: warm-start matching vars from other checkpoints
         # (ref checkpointer.py:214); resumed runs skip this.
         state = checkpointer_lib.ApplyInitFromCheckpointRules(state, rules)
+      npz = getattr(self._task.p.train, "init_from_npz", "")
+      if npz:
+        state = checkpointer_lib.ImportNpzCheckpoint(
+            state, npz,
+            getattr(self._task.p.train, "init_from_npz_rules", None))
     if self._precompile and self._schedule is not None:
       for prog in self._schedule.programs:
         prog.Compile(state)

@@ -1,0 +1,148 @@
+"""TF-checkpoint conversion story: name normalization (tools side) and
+ImportNpzCheckpoint (framework side)."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import checkpointer
+from lingvo_tpu.core.nested_map import NestedMap
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import convert_tf_checkpoint as conv  # noqa: E402
+
+
+class TestNameMapping:
+
+  def test_normalize_strips_prefix_var_suffix_and_slashes(self):
+    assert conv.NormalizeName("librispeech/enc/conv_0/w/var",
+                              "librispeech/") == "enc.conv_0.w"
+    assert conv.NormalizeName(
+        "model/emb/.ATTRIBUTES/VARIABLE_VALUE") == "model.emb"
+
+  def test_rules_first_match_wins(self):
+    rules = conv.ParseRules(r"enc\.conv_(\d+)\.w=enc.convs.\1.kernel,"
+                            r"enc\..*=DROPPED")
+    assert conv.ApplyRules("enc.conv_2.w", rules) == "enc.convs.2.kernel"
+    assert conv.ApplyRules("enc.proj.w", rules) == "DROPPED"
+    assert conv.ApplyRules("dec.w", rules) == "dec.w"  # pass-through
+
+  def test_convert_writes_npz(self, tmp_path):
+    out = str(tmp_path / "conv.npz")
+    items = [("m/enc/w/var", np.ones((2, 3), np.float64)),
+             ("m/dec/w/var", np.zeros((4,), np.float32))]
+    n = conv.Convert(items, out, "m/", conv.ParseRules(""), "float32")
+    assert n == 2
+    loaded = np.load(out)
+    assert set(loaded.files) == {"enc.w", "dec.w"}
+    assert loaded["enc.w"].dtype == np.float32
+
+  def test_convert_rejects_colliding_names(self, tmp_path):
+    items = [("a/w", np.ones(1)), ("a/w/var", np.ones(1))]
+    with pytest.raises(ValueError, match="map to"):
+      conv.Convert(items, str(tmp_path / "x.npz"), "",
+                   conv.ParseRules(""), None)
+
+
+def _State():
+  return NestedMap(
+      theta=NestedMap(enc=NestedMap(w=jnp.zeros((2, 3), jnp.bfloat16)),
+                      head=NestedMap(w=jnp.zeros((3,)))),
+      ema_theta=NestedMap(enc=NestedMap(w=jnp.zeros((2, 3), jnp.bfloat16)),
+                          head=NestedMap(w=jnp.zeros((3,)))),
+      step=jnp.zeros((), jnp.int32))
+
+
+class TestImportNpz:
+
+  def test_identity_mapping_partial_load(self, tmp_path):
+    path = str(tmp_path / "c.npz")
+    np.savez(path, **{"enc.w": np.full((2, 3), 7.0)})
+    state = checkpointer.ImportNpzCheckpoint(_State(), path)
+    np.testing.assert_array_equal(np.asarray(state.theta.enc.w,
+                                             dtype=np.float32), 7.0)
+    assert state.theta.enc.w.dtype == jnp.bfloat16  # cast to target dtype
+    np.testing.assert_array_equal(np.asarray(state.theta.head.w), 0.0)
+    # ema mirrors the warm value
+    np.testing.assert_array_equal(
+        np.asarray(state.ema_theta.enc.w, dtype=np.float32), 7.0)
+
+  def test_rules_mapping(self, tmp_path):
+    path = str(tmp_path / "c.npz")
+    np.savez(path, **{"source_encoder.w": np.full((2, 3), 3.0)})
+    state = checkpointer.ImportNpzCheckpoint(
+        _State(), path, rules=[(r"enc\.(.*)", r"source_encoder.\1")])
+    np.testing.assert_array_equal(
+        np.asarray(state.theta.enc.w, dtype=np.float32), 3.0)
+
+  def test_rule_with_missing_source_raises(self, tmp_path):
+    path = str(tmp_path / "c.npz")
+    np.savez(path, **{"other.w": np.ones((2, 3))})
+    with pytest.raises(KeyError, match="not in"):
+      checkpointer.ImportNpzCheckpoint(
+          _State(), path, rules=[(r"enc\.(.*)", r"missing.\1")])
+
+  def test_shape_mismatch_raises(self, tmp_path):
+    path = str(tmp_path / "c.npz")
+    np.savez(path, **{"enc.w": np.ones((9, 9))})
+    with pytest.raises(ValueError, match="shape mismatch"):
+      checkpointer.ImportNpzCheckpoint(_State(), path)
+
+
+class TestExecutorNpzWarmStart:
+
+  def test_fresh_run_imports_npz(self, tmp_path):
+    import tests.test_executor_hardening as helpers
+    from lingvo_tpu.runners import executor as executor_lib
+    from lingvo_tpu.runners import program as program_lib
+
+    # fabricate a "converted reference checkpoint" for the proj layer
+    probe = helpers._TaskParams().Instantiate()
+    probe.FinalizePaths()
+    theta = probe.InstantiateVariables(jax.random.PRNGKey(0))
+    npz = str(tmp_path / "ref.npz")
+    w = np.full(np.shape(theta.proj.w), 0.5, np.float32)
+    b = np.zeros(np.shape(theta.proj.b), np.float32)
+    np.savez(npz, **{"proj.w": w, "proj.b": b})
+
+    logdir = str(tmp_path / "run")
+    task_p = helpers._TaskParams(max_steps=5, steps_per_loop=5)
+    task_p.train.init_from_npz = npz
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=logdir, steps_per_loop=5)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+        task=task, input_generators={"Train": helpers._RegressionInput()})
+    ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task)
+    captured = {}
+    orig = ex._MainLoop
+
+    def _Spy(state, start_step):
+      captured["w"] = np.asarray(state.theta.proj.w)
+      return orig(state, start_step)
+
+    ex._MainLoop = _Spy
+    ex.Start()
+    np.testing.assert_array_equal(captured["w"], 0.5)
+
+
+class TestModelVariableFilter:
+
+  def test_tf1_lingvo_naming(self):
+    assert conv.IsModelVariable("lm/stack/w/var")
+    assert not conv.IsModelVariable("lm/stack/w/var/Adam")
+    assert not conv.IsModelVariable("lm/stack/w/var/Adam_1")
+    assert not conv.IsModelVariable("lm/stack/w/var/Adafactor_1")
+    assert not conv.IsModelVariable("global_step")
+
+  def test_tf2_object_naming(self):
+    assert conv.IsModelVariable(
+        "model/emb/.ATTRIBUTES/VARIABLE_VALUE")
+    assert not conv.IsModelVariable(
+        "model/emb/.OPTIMIZER_SLOT/optimizer/m/.ATTRIBUTES/VARIABLE_VALUE")

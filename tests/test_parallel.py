@@ -547,3 +547,19 @@ class TestSinkhornGating:
     g = jax.jit(jax.grad(loss))(theta, x)
     # router gets gradients through the gate values
     assert float(jnp.sum(jnp.abs(g.gating))) > 0
+
+  def test_sinkhorn_balance_survives_heavy_padding(self):
+    # 75% padding + skewed logits: real tokens must still spread, and pad
+    # rows must carry ~zero plan mass (the masked-Sinkhorn property)
+    g, s, e = 1, 16, 4
+    logits = jax.random.normal(KEY, (g, s, e)) * 0.1
+    logits = logits.at[:, :, 0].add(5.0)
+    paddings = jnp.zeros((g, s)).at[:, 4:].set(1.0)  # 4 real tokens
+    out = gshard.SinkhornGating(logits, paddings, capacity_factor=2.0,
+                                num_iters=25)
+    per_expert = np.asarray(out.dispatch_tensor[:, :4].sum(axis=(1, 3)))[0]
+    # 4 real tokens over 4 experts, balanced plan -> roughly one each
+    assert per_expert.max() <= 2 and per_expert.min() >= 0
+    assert per_expert.sum() == 4
+    # no single expert hogs all real tokens despite +5 logit skew
+    assert per_expert.max() < 4, per_expert

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Converts a reference (tensorflow/lingvo) TF checkpoint to a portable .npz
+that `core.checkpointer.ImportNpzCheckpoint` can load (the SURVEY §7
+checkpoint-compatibility story: self-format + converter, like the
+reference's `keras2ckpt.py` direction).
+
+Run this WHERE TENSORFLOW IS INSTALLED (the training image here is TF-free
+by design); the output .npz needs only numpy to read.
+
+  python tools/convert_tf_checkpoint.py \
+    --tf_checkpoint=/ckpts/librispeech/ckpt-123456 \
+    --output=/tmp/librispeech.npz \
+    --strip_prefix=librispeech/ \
+    --rules='enc/conv_(\\d+)/w/var=enc.conv_\\1.w'
+
+Name mapping: TF variable names are first normalized (optional
+--strip_prefix removed, trailing '/var' removed, '/' -> '.'), then each
+--rules regex=template pair (comma-separated, applied to the NORMALIZED
+name, first match wins) rewrites to this framework's dotted theta path.
+Unmatched names pass through normalized — run with --list first to see
+both columns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+import numpy as np
+
+
+def NormalizeName(name: str, strip_prefix: str = "") -> str:
+  if strip_prefix and name.startswith(strip_prefix):
+    name = name[len(strip_prefix):]
+  for suffix in ("/var", "/.ATTRIBUTES/VARIABLE_VALUE"):
+    if name.endswith(suffix):
+      name = name[: -len(suffix)]
+  return name.replace("/", ".")
+
+
+def ApplyRules(name: str, rules) -> str:
+  for pattern, template in rules:
+    if re.fullmatch(pattern, name):
+      return re.sub(pattern, template, name)
+  return name
+
+
+def IsModelVariable(name: str) -> bool:
+  """True for model weights; False for optimizer slots / bookkeeping.
+
+  lingvo TF1 names every model variable `<layer path>/<param>/var`, with
+  optimizer slots as suffixes AFTER that (`.../var/Adam`, `.../var/Adam_1`,
+  `.../var/Adafactor_1`) — so 'ends with /var' is the reliable model filter,
+  not slot-name blacklists. TF2 object checkpoints use
+  `.ATTRIBUTES/VARIABLE_VALUE` leaves, excluding `.OPTIMIZER_SLOT` paths.
+  """
+  if name.endswith("/var"):
+    return True
+  if name.endswith("/.ATTRIBUTES/VARIABLE_VALUE"):
+    return ".OPTIMIZER_SLOT" not in name and "optimizer" not in name
+  return False
+
+
+def ParseRules(spec: str):
+  rules = []
+  for pair in filter(None, spec.split(",")):
+    if "=" not in pair:
+      raise ValueError(f"rule {pair!r} is not regex=template")
+    pattern, template = pair.split("=", 1)
+    rules.append((pattern, template))
+  return rules
+
+
+def Convert(reader_items, output: str, strip_prefix: str, rules,
+            dtype: str | None) -> int:
+  """reader_items: iterable of (tf_name, numpy_array)."""
+  out = {}
+  for name, arr in reader_items:
+    key = ApplyRules(NormalizeName(name, strip_prefix), rules)
+    if key in out:
+      raise ValueError(f"two TF variables map to {key!r}")
+    if dtype:
+      arr = arr.astype(dtype)
+    out[key] = arr
+  np.savez(output, **out)
+  return len(out)
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--tf_checkpoint", required=True,
+                  help="TF checkpoint prefix (the path before .index).")
+  ap.add_argument("--output", help=".npz output path.")
+  ap.add_argument("--strip_prefix", default="")
+  ap.add_argument("--rules", default="",
+                  help="comma-separated regex=template name rewrites.")
+  ap.add_argument("--dtype", default="",
+                  help="cast all arrays (e.g. float32); default keeps.")
+  ap.add_argument("--list", action="store_true",
+                  help="print tf-name -> mapped-name -> shape and exit.")
+  ap.add_argument("--keep_all", action="store_true",
+                  help="also convert optimizer slots / bookkeeping vars "
+                  "(default keeps only model weights: '.../var' in TF1 "
+                  "naming, non-slot ATTRIBUTES leaves in TF2).")
+  args = ap.parse_args(argv)
+
+  try:
+    import tensorflow as tf  # pytype: disable=import-error
+  except ImportError:
+    print("tensorflow is required to READ the checkpoint; run this tool in "
+          "an environment with TF installed. (The output .npz is read with "
+          "numpy only.)", file=sys.stderr)
+    return 2
+
+  reader = tf.train.load_checkpoint(args.tf_checkpoint)
+  shape_map = reader.get_variable_to_shape_map()
+  rules = ParseRules(args.rules)
+  names = sorted(n for n in shape_map
+                 if IsModelVariable(n) or args.keep_all)
+  if args.list:
+    for name in names:
+      mapped = ApplyRules(NormalizeName(name, args.strip_prefix), rules)
+      print(f"{name}\t{mapped}\t{shape_map[name]}")
+    return 0
+  if not args.output:
+    print("--output is required unless --list", file=sys.stderr)
+    return 2
+  n = Convert(((name, reader.get_tensor(name)) for name in names),
+              args.output, args.strip_prefix, rules, args.dtype or None)
+  print(f"wrote {n} vars -> {args.output}")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
