@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lingvo_tpu.core import py_utils
+
 from lingvo_tpu.core import base_model
 from lingvo_tpu.core import layers as layers_lib
 from lingvo_tpu.core.nested_map import NestedMap
@@ -90,6 +92,24 @@ class CtcAsrModel(_AsrTaskBase):
         frame_ids=frame_ids,
         target_ids=input_batch.tgt.ids,
         target_paddings=input_batch.tgt.paddings)
+
+  def Inference(self):
+    """'transcribe' subgraph: log-mel features -> greedy CTC frame ids
+    (blank=0; host collapses repeats, ref PostProcessDecodeOut)."""
+    bins = self.p.encoder.input_dim
+    t = 96
+    example = NestedMap(
+        features=jnp.zeros((1, t, bins), jnp.float32),
+        feature_paddings=jnp.zeros((1, t), jnp.float32))
+
+    def transcribe_fn(theta, inputs):
+      with py_utils.EvalContext():
+        preds = self.ComputePredictions(theta, inputs)
+      frame_ids = jnp.argmax(preds.logits, axis=-1)
+      frame_ids = jnp.where(preds.paddings > 0.5, 0, frame_ids)
+      return NestedMap(frame_ids=frame_ids, frame_paddings=preds.paddings)
+
+    return {"transcribe": (transcribe_fn, example)}
 
   def PostProcessDecodeOut(self, decode_out, decoder_metrics):
     frames = np.asarray(decode_out.frame_ids)

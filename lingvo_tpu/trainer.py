@@ -73,8 +73,17 @@ def main(argv=None):
                       help="Output directory.")
   parser.add_argument("--mode", default="train",
                       choices=["train", "eval", "decode", "inspect_model",
-                               "inspect_params"],
-                      help="What to run.")
+                               "inspect_params", "export", "shell"],
+                      help="What to run. 'export' writes the serving bundle "
+                      "(ref --mode=write_inference_graph); 'shell' drops "
+                      "into an interactive prompt with the model loaded "
+                      "(ref --mode=shell ipython_kernel).")
+  parser.add_argument("--export_dir", default="",
+                      help="'export' output dir (default <logdir>/export).")
+  parser.add_argument("--allow_fresh_init", action="store_true",
+                      help="let 'export' serialize randomly initialized "
+                      "weights when the logdir has no checkpoint "
+                      "(default: hard error).")
   parser.add_argument("--job", default="executor_tpu",
                       help="executor_tpu (train), or evaler/decoder "
                            "(checkpoint-polling follower jobs).")
@@ -135,6 +144,46 @@ def main(argv=None):
       total += n
       print(f"{path:<60} {str(tuple(wp.shape)):<20} {n}")
     print(f"{'TOTAL':<60} {'':<20} {total}")
+    return 0
+
+  if args.mode in ("export", "shell"):
+    import jax
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+    task = model_params.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(1234))
+    ckpt = checkpointer_lib.Checkpointer(os.path.join(args.logdir, "train"))
+    step = None
+    if ckpt.LatestStep() is not None:
+      state, step = ckpt.Restore(state)
+    ckpt.Close()
+    if args.mode == "export":
+      if step is None and not args.allow_fresh_init:
+        print(f"no checkpoint in {args.logdir}/train — refusing to export "
+              "random weights (pass --allow_fresh_init to override)",
+              file=sys.stderr)
+        return 1
+      from lingvo_tpu.serving import export as export_lib
+      out_dir = args.export_dir or os.path.join(args.logdir, "export")
+      # serve what eval/decode blessed: EMA weights when the task keeps them
+      theta = state.ema_theta if "ema_theta" in state else state.theta
+      export_lib.InferenceGraphExporter.Export(task, theta, out_dir)
+      which = "ema_theta" if "ema_theta" in state else "theta"
+      print(f"exported inference bundle ({which}, ckpt step {step}) -> "
+            f"{out_dir}")
+      return 0
+    banner = (f"lingvo_tpu shell: `task` ({type(task).__name__}), `state` "
+              f"(step {step}), `model_params`, jax/jnp/np loaded")
+    ns = dict(task=task, state=state, model_params=model_params, jax=jax)
+    import jax.numpy as jnp
+    import numpy as np
+    ns.update(jnp=jnp, np=np)
+    try:
+      import IPython
+      IPython.start_ipython(argv=[], user_ns=ns, display_banner=False)
+    except ImportError:
+      import code
+      code.interact(banner=banner, local=ns)
     return 0
 
   schedule, task = _BuildSchedule(model_params, args)

@@ -11,14 +11,14 @@ by design); the output .npz needs only numpy to read.
     --tf_checkpoint=/ckpts/librispeech/ckpt-123456 \
     --output=/tmp/librispeech.npz \
     --strip_prefix=librispeech/ \
-    --rules='enc/conv_(\\d+)/w/var=enc.conv_\\1.w'
+    --rules='enc\\.conv_(\\d+)\\.w=enc.convs.\\1.kernel'
 
 Name mapping: TF variable names are first normalized (optional
 --strip_prefix removed, trailing '/var' removed, '/' -> '.'), then each
---rules regex=template pair (comma-separated, applied to the NORMALIZED
-name, first match wins) rewrites to this framework's dotted theta path.
-Unmatched names pass through normalized — run with --list first to see
-both columns.
+--rules regex=template pair (';'-separated so regexes may contain commas,
+matched against the NORMALIZED dotted name, first match wins) rewrites to
+this framework's dotted theta path. Unmatched names pass through
+normalized — run with --list first to see both columns.
 """
 
 from __future__ import annotations
@@ -64,7 +64,8 @@ def IsModelVariable(name: str) -> bool:
 
 def ParseRules(spec: str):
   rules = []
-  for pair in filter(None, spec.split(",")):
+  # ';' separates pairs so regex bodies may contain ',' ({m,n}, [a,b])
+  for pair in filter(None, spec.split(";")):
     if "=" not in pair:
       raise ValueError(f"rule {pair!r} is not regex=template")
     pattern, template = pair.split("=", 1)
@@ -94,7 +95,8 @@ def main(argv=None) -> int:
   ap.add_argument("--output", help=".npz output path.")
   ap.add_argument("--strip_prefix", default="")
   ap.add_argument("--rules", default="",
-                  help="comma-separated regex=template name rewrites.")
+                  help="';'-separated regex=template rewrites over the "
+                  "normalized (dotted) names.")
   ap.add_argument("--dtype", default="",
                   help="cast all arrays (e.g. float32); default keeps.")
   ap.add_argument("--list", action="store_true",
