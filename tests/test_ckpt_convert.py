@@ -25,11 +25,16 @@ class TestNameMapping:
         "model/emb/.ATTRIBUTES/VARIABLE_VALUE") == "model.emb"
 
   def test_rules_first_match_wins(self):
-    rules = conv.ParseRules(r"enc\.conv_(\d+)\.w=enc.convs.\1.kernel,"
+    rules = conv.ParseRules(r"enc\.conv_(\d+)\.w=enc.convs.\1.kernel;"
                             r"enc\..*=DROPPED")
     assert conv.ApplyRules("enc.conv_2.w", rules) == "enc.convs.2.kernel"
     assert conv.ApplyRules("enc.proj.w", rules) == "DROPPED"
     assert conv.ApplyRules("dec.w", rules) == "dec.w"  # pass-through
+
+  def test_rule_regex_may_contain_commas(self):
+    # ';' is the pair separator precisely so {m,n} quantifiers survive
+    rules = conv.ParseRules(r"enc\.l_(\d{1,2})\.w=enc.layers.\1.w")
+    assert conv.ApplyRules("enc.l_12.w", rules) == "enc.layers.12.w"
 
   def test_convert_writes_npz(self, tmp_path):
     out = str(tmp_path / "conv.npz")
