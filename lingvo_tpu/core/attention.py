@@ -257,6 +257,12 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       h = self._dim_per_head
       ctx = flash_attention.FlashAttention(
           q * math.sqrt(h), k, v, causal=causal, segment_ids=seg)
+      if paddings is not None:
+        # strict path parity: flash pad queries attend only pad keys while
+        # the einsum path lets them attend real keys — both garbage, but a
+        # downstream consumer mixing across time without re-masking would
+        # see different numerics depending on the engaged path. Zero them.
+        ctx = py_utils.ApplyPadding(paddings, ctx)
       return self._PostProj(theta, ctx), None
     mask = atten_mask
     if causal:

@@ -5,11 +5,21 @@
 The reference drives the TPU embedding mid-level API (host-side enqueue,
 load/retrieve around the train loop) because TF cannot express giant sparse
 tables in-graph. Under GSPMD none of that machinery is needed: the table is
-a regular variable row-sharded over the mesh, the lookup is a one-hot
-matmul (MXU-friendly and partitionable — XLA turns it into a collective
-gather over the table shards), and optimizer slots shard the same way
-automatically. What remains of the reference surface is the table/feature
-config and a combiner for multi-valent features.
+a regular variable row-sharded over the mesh and optimizer slots shard the
+same way automatically. Two lookup formulations:
+
+  * one-hot matmul — MXU-friendly, exact, but O(V*d) flops per token; only
+    sane for small vocabs (softmax-sized).
+  * sharded gather — each device takes the rows it owns (masked local
+    `jnp.take`) and a psum over the shard axis combines them; O(tokens*d)
+    flops + one all-reduce, which is what makes million-row tables usable
+    (the reference's TPU-embedding lookup path,
+    `tpu_embedding_layers_v1.py`). Single-device meshes degrade to a plain
+    gather.
+
+'auto' picks by vocab size. Per-table optimizers (the mid-level API's
+table-specific Adagrad etc.) map onto the existing CompositeOptimizer:
+`TpuEmbeddingCollection.OptimizerRules()` emits its regex->optimizer map.
 """
 
 from __future__ import annotations
@@ -21,6 +31,7 @@ from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import py_utils
 from lingvo_tpu.core.nested_map import NestedMap
 from lingvo_tpu.core.py_utils import WeightParams
+from lingvo_tpu.parallel import mesh as mesh_lib
 
 
 class ShardedEmbeddingTable(base_layer.BaseLayer):
@@ -36,6 +47,15 @@ class ShardedEmbeddingTable(base_layer.BaseLayer):
              "chips like the reference's table sharding).")
     p.Define("combiner", "sum", "'sum' | 'mean' for multi-valent lookups.")
     p.Define("scale_sqrt_depth", False, "Scale outputs by sqrt(dim).")
+    p.Define("lookup_method", "auto",
+             "'one_hot' (O(V*d) matmul), 'gather' (sharded take + psum, "
+             "O(tokens*d)), or 'auto' (one_hot only for small vocabs).")
+    p.Define("one_hot_vocab_threshold", 8192,
+             "'auto' uses the one-hot matmul at or below this vocab size.")
+    p.Define("optimizer", None,
+             "Optional per-table optimizer Params (ref per-table Adagrad "
+             "etc. in the TPU-embedding mid-level API); consumed by "
+             "TpuEmbeddingCollection.OptimizerRules -> CompositeOptimizer.")
     return p
 
   def __init__(self, params):
@@ -48,15 +68,47 @@ class ShardedEmbeddingTable(base_layer.BaseLayer):
                      tensor_split_dims_mapping=(p.shard_axis, None)))
 
   def EmbLookup(self, theta, ids):
-    """ids [..., ] int32 -> [..., dim]; one-hot matmul keeps the table
-    sharded (gather would force an all-gather of the table)."""
+    """ids [..., ] int32 -> [..., dim]."""
     p = self.p
     th = self.CastTheta(theta)
-    one_hot = jax.nn.one_hot(ids, p.vocab_size, dtype=th.table.dtype)
-    out = jnp.einsum("...v,vd->...d", one_hot, th.table)
+    method = p.lookup_method
+    if method == "auto":
+      method = ("one_hot" if p.vocab_size <= p.one_hot_vocab_threshold
+                else "gather")
+    if method == "one_hot":
+      one_hot = jax.nn.one_hot(ids, p.vocab_size, dtype=th.table.dtype)
+      out = jnp.einsum("...v,vd->...d", one_hot, th.table)
+    else:
+      n_shard = mesh_lib.CurrentMeshAxisSize(p.shard_axis) or 0
+      if n_shard > 1 and p.vocab_size % n_shard == 0:
+        out = self._ShardedGather(th.table, ids, n_shard)
+      else:
+        out = jnp.take(th.table, ids, axis=0)
     if p.scale_sqrt_depth:
       out = out * (p.embedding_dim ** 0.5)
     return out
+
+  def _ShardedGather(self, table, ids, n_shard: int):
+    """Each device takes from its own row shard; a psum over the shard axis
+    assembles the result (every id lives on exactly one shard). Payload of
+    the all-reduce is tokens x dim, independent of vocab size; ids arrive
+    replicated (shard them over a batch axis upstream if needed)."""
+    axis = self.p.shard_axis
+    rows = self.p.vocab_size // n_shard
+    from jax.sharding import PartitionSpec as P
+    mesh = jax.sharding.get_abstract_mesh()
+
+    def _Local(tbl_l, ids_r):
+      lo = jax.lax.axis_index(axis) * rows
+      local = ids_r.astype(jnp.int32) - lo
+      valid = (local >= 0) & (local < rows)
+      emb = jnp.take(tbl_l, jnp.clip(local, 0, rows - 1), axis=0)
+      emb = emb * valid[..., None].astype(emb.dtype)
+      return jax.lax.psum(emb, axis)
+
+    return jax.shard_map(
+        _Local, mesh=mesh, in_specs=(P(axis, None), P()),
+        out_specs=P())(table, ids)
 
   def MultivalentLookup(self, theta, ids, weights=None):
     """ids [b, n] with optional weights [b, n] -> combined [b, dim]
@@ -104,3 +156,16 @@ class TpuEmbeddingCollection(base_layer.BaseLayer):
       out.Set(feat, table.EmbLookup(
           self.ChildTheta(theta, f"table_{tbl}"), ids))
     return out
+
+  def OptimizerRules(self, default_optimizer):
+    """(regex, optimizer Params, lr mult) list for CompositeOptimizer —
+    routes each table with a per-table `optimizer` to it, everything else
+    to `default_optimizer` (ref: per-table optimizer configs of the TPU
+    embedding mid-level API, `tpu_embedding_layers_v1.py` load/retrieve
+    slot plumbing)."""
+    rules = []
+    for name, tp in self.p.tables:
+      if tp.optimizer is not None:
+        rules.append((rf".*\btable_{name}\.", tp.optimizer.Copy(), 1.0))
+    rules.append((r".*", default_optimizer, 1.0))
+    return rules

@@ -141,9 +141,10 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
   def InitStates(self, theta, batch_size, max_len):
     return self.atten.InitStates(theta.atten, batch_size, max_len)
 
-  def ExtendStep(self, theta, query_vec, cached_states):
+  def ExtendStep(self, theta, query_vec, cached_states, cache_paddings=None):
     x = self.ln.FProp(theta.ln, query_vec)
-    out, new_states = self.atten.ExtendStep(theta.atten, x, cached_states)
+    out, new_states = self.atten.ExtendStep(theta.atten, x, cached_states,
+                                            paddings=cache_paddings)
     return query_vec + out, new_states
 
 
@@ -201,9 +202,10 @@ class TransformerLayer(base_layer.BaseLayer):
                                               max_len))
 
   def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
-                 aux_paddings=None):
+                 aux_paddings=None, cache_paddings=None):
     x, new_sa = self.self_atten.ExtendStep(theta.self_atten, inputs,
-                                           cached_states.self_atten)
+                                           cached_states.self_atten,
+                                           cache_paddings=cache_paddings)
     if self.p.has_aux_atten:
       x, _ = self.aux_atten.FProp(
           theta.aux_atten, x, source_vecs=aux_vecs, paddings=aux_paddings)
@@ -255,13 +257,13 @@ class StackedTransformerLayers(base_layer.BaseLayer):
     ])
 
   def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
-                 aux_paddings=None):
+                 aux_paddings=None, cache_paddings=None):
     x = inputs
     new_states = NestedMap(x_layers=[])
     for i, layer in enumerate(self.x_layers):
       x, ns = layer.ExtendStep(theta.x_layers[i], x,
                                cached_states.x_layers[i], aux_vecs,
-                               aux_paddings)
+                               aux_paddings, cache_paddings=cache_paddings)
       new_states.x_layers.append(ns)
     if self.p.final_ln:
       x = self.final_ln.FProp(theta.final_ln, x)
@@ -349,11 +351,12 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
     return NestedMap(body=jax.vmap(_One)(theta.body))
 
   def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
-                 aux_paddings=None):
+                 aux_paddings=None, cache_paddings=None):
     def _Body(carry, per_layer):
       theta_i, states_i = per_layer
       x, new_states = self.body.ExtendStep(theta_i, carry, states_i, aux_vecs,
-                                           aux_paddings)
+                                           aux_paddings,
+                                           cache_paddings=cache_paddings)
       return x, new_states
 
     out, new_states = jax.lax.scan(_Body, inputs,

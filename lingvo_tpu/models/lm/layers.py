@@ -62,7 +62,11 @@ class TransformerLm(base_model.BaseTask):
     p.Define("moe_aux_loss_weight", 0.01, "Load-balance loss weight.")
     p.Define("moe_second_expert_policy", "all", "'all' or 'random'.")
     p.Define("moe_gating_policy", "top2",
-             "'top2' (learned) or 'hash' (route by token-id hash).")
+             "'top2' (learned), 'sinkhorn' (balanced top-1), or 'hash' "
+             "(route by token-id hash).")
+    p.Define("moe_dispatch_method", "auto",
+             "MoE dispatch formulation: 'auto' | 'indexed' | 'einsum' "
+             "(see gshard.MoEFeedForwardLayer).")
     return p
 
   def __init__(self, params):
@@ -107,6 +111,7 @@ class TransformerLm(base_model.BaseTask):
           aux_loss_weight=p.moe_aux_loss_weight,
           second_expert_policy=p.moe_second_expert_policy,
           gating_policy=p.moe_gating_policy,
+          dispatch_method=p.moe_dispatch_method,
           residual_dropout_prob=p.residual_dropout_prob)
       block = gshard.DenseMoEBlock.Params().Set(
           input_dim=p.model_dim, num_heads=p.num_heads,
@@ -220,10 +225,16 @@ class TransformerLm(base_model.BaseTask):
   def InitDecodeState(self, theta, batch_size, max_len):
     return self.stack.InitStates(theta.stack, batch_size, max_len)
 
-  def ExtendStep(self, theta, ids_t, states):
-    """ids_t: [b, 1] -> (logits [b, vocab], new states)."""
+  def ExtendStep(self, theta, ids_t, states, cache_paddings=None):
+    """ids_t: [b, 1] -> (logits [b, vocab], new states).
+
+    cache_paddings: optional [b, max_len] — 1.0 marks KV-cache slots that
+    must never be attended (left-padding of right-aligned variable-length
+    prompts in gshard_decode).
+    """
     x = self.emb.EmbLookup(theta.emb, ids_t)
-    x, new_states = self.stack.ExtendStep(theta.stack, x, states)
+    x, new_states = self.stack.ExtendStep(theta.stack, x, states,
+                                          cache_paddings=cache_paddings)
     x = self.final_ln.FProp(theta.final_ln, x)
     if self.p.softmax_num_sampled > 0:
       # decode must score with the head that was TRAINED (the untied

@@ -58,11 +58,16 @@ def Top2Gating(logits: jax.Array,
                capacity_factor: float = 2.0,
                second_expert_policy: str = "all",
                prng_key: jax.Array | None = None,
-               capacity: int | None = None):
+               capacity: int | None = None,
+               build_tensors: bool = True):
   """Top-2 gating over [G, S, E] logits (G=groups, S=tokens/group, E=experts).
 
   Returns NestedMap(combine_tensor [G,S,E,C], dispatch_tensor bool [G,S,E,C],
-  aux_loss scalar).
+  aux_loss scalar) plus the indexed form consumed by the gather/scatter
+  dispatch path: indices/positions [K,G,S] int32 and gates [K,G,S] f32
+  (K=2 here; gates are 0 for dropped/over-capacity tokens). With
+  `build_tensors=False` the O(G*S*E*C) one-hot tensors are skipped — the
+  indexed form carries the same information in O(G*S).
   """
   g, s, e = logits.shape
   c = _DeriveCapacity(s, e, capacity_factor, capacity)
@@ -113,17 +118,23 @@ def Top2Gating(logits: jax.Array,
   total = jnp.maximum(gate_1 + gate_2, 1e-9)
   gate_1, gate_2 = gate_1 / total, gate_2 / total
 
-  def _Combine(gate, mask, pos_tok):
-    # [G,S] gate, [G,S,E] mask, [G,S] position -> [G,S,E,C]
-    onehot_c = jax.nn.one_hot(pos_tok.astype(jnp.int32), c,
-                              dtype=jnp.float32)                 # [G,S,C]
-    return gate[..., None, None] * mask[..., None] * onehot_c[:, :, None, :]
+  out = NestedMap(
+      aux_loss=aux_loss,
+      capacity=c,
+      indices=jnp.stack([index_1, index_2]).astype(jnp.int32),
+      positions=jnp.stack([pos_1_tok, pos_2_tok]).astype(jnp.int32),
+      gates=jnp.stack([gate_1, gate_2]))
+  if build_tensors:
+    def _Combine(gate, mask, pos_tok):
+      # [G,S] gate, [G,S,E] mask, [G,S] position -> [G,S,E,C]
+      onehot_c = jax.nn.one_hot(pos_tok.astype(jnp.int32), c,
+                                dtype=jnp.float32)               # [G,S,C]
+      return gate[..., None, None] * mask[..., None] * onehot_c[:, :, None, :]
 
-  combine = _Combine(gate_1, mask_1, pos_1_tok) + _Combine(
-      gate_2, mask_2, pos_2_tok)
-  dispatch = combine > 0.0
-  return NestedMap(
-      combine_tensor=combine, dispatch_tensor=dispatch, aux_loss=aux_loss)
+    out.combine_tensor = _Combine(gate_1, mask_1, pos_1_tok) + _Combine(
+        gate_2, mask_2, pos_2_tok)
+    out.dispatch_tensor = out.combine_tensor > 0.0
+  return out
 
 
 def _MaskedSinkhorn(log_p: jax.Array, nonpad: jax.Array, num_iters: int):
@@ -154,7 +165,8 @@ def SinkhornGating(logits: jax.Array,
                    capacity_factor: float = 2.0,
                    num_iters: int = 10,
                    temperature: float = 1.0,
-                   capacity: int | None = None):
+                   capacity: int | None = None,
+                   build_tensors: bool = True):
   """Optimal-transport (Sinkhorn) top-1 gating (ref `gshard_layers.py:2736`
   optimal-transport gating, via `differentiable_assignment.py`).
 
@@ -181,19 +193,25 @@ def SinkhornGating(logits: jax.Array,
   gate_1 = jnp.sum(raw_gates * mask_1, axis=-1)                   # [G,S]
   mask_1, pos_1_tok = _PositionInExpert(mask_1, c)
   gate_1 = gate_1 * jnp.sum(mask_1, axis=-1)
-  onehot_c = jax.nn.one_hot(pos_1_tok.astype(jnp.int32), c,
-                            dtype=jnp.float32)                    # [G,S,C]
-  combine = gate_1[..., None, None] * mask_1[..., None] * \
-      onehot_c[:, :, None, :]
-  return NestedMap(combine_tensor=combine, dispatch_tensor=combine > 0.0,
-                   aux_loss=jnp.zeros((), jnp.float32))
+  out = NestedMap(aux_loss=jnp.zeros((), jnp.float32), capacity=c,
+                  indices=index_1[None].astype(jnp.int32),
+                  positions=pos_1_tok[None].astype(jnp.int32),
+                  gates=gate_1[None])
+  if build_tensors:
+    onehot_c = jax.nn.one_hot(pos_1_tok.astype(jnp.int32), c,
+                              dtype=jnp.float32)                  # [G,S,C]
+    out.combine_tensor = gate_1[..., None, None] * mask_1[..., None] * \
+        onehot_c[:, :, None, :]
+    out.dispatch_tensor = out.combine_tensor > 0.0
+  return out
 
 
 def HashGating(token_ids: jax.Array,
                num_experts: int,
                paddings: jax.Array | None,
                capacity_factor: float = 2.0,
-               capacity: int | None = None):
+               capacity: int | None = None,
+               build_tensors: bool = True):
   """Hash-based top-1 routing (ref `gshard_layers.py` HashGatingOnLogits:2367).
 
   Routes each token to `hash(token_id) % E` with gate weight 1 — no learned
@@ -208,11 +226,15 @@ def HashGating(token_ids: jax.Array,
   if paddings is not None:
     mask = mask * (1.0 - paddings)[..., None]
   mask, pos_tok = _PositionInExpert(mask, c)
-  onehot_c = jax.nn.one_hot(pos_tok.astype(jnp.int32), c, dtype=jnp.float32)
-  combine = mask[..., None] * onehot_c[:, :, None, :]
-  dispatch = combine > 0.0
-  return NestedMap(combine_tensor=combine, dispatch_tensor=dispatch,
-                   aux_loss=jnp.zeros((), jnp.float32))
+  out = NestedMap(aux_loss=jnp.zeros((), jnp.float32), capacity=c,
+                  indices=hashed.astype(jnp.int32)[None],
+                  positions=pos_tok[None].astype(jnp.int32),
+                  gates=jnp.sum(mask, axis=-1)[None])
+  if build_tensors:
+    onehot_c = jax.nn.one_hot(pos_tok.astype(jnp.int32), c, dtype=jnp.float32)
+    out.combine_tensor = mask[..., None] * onehot_c[:, :, None, :]
+    out.dispatch_tensor = out.combine_tensor > 0.0
+  return out
 
 
 def TokenShufflePerm(shape, prng_key):
@@ -235,6 +257,53 @@ def _TakeAlongS(x, perm):
   idx = perm.reshape(perm.shape + (1,) * (x.ndim - 2))
   return jnp.take_along_axis(x, jnp.broadcast_to(
       idx, perm.shape + x.shape[2:]), axis=1)
+
+
+def SlotSources(gating: NestedMap, e: int, s: int) -> jax.Array:
+  """Token index feeding each expert slot: [G, E*C] int32 in [0, s] (s=empty).
+
+  The one-hot dispatch tensor is a permutation-ish matrix: every (expert,
+  capacity) slot receives at most one (token, k) assignment, because
+  position-in-expert is a per-expert cumsum (expert-2 positions are offset
+  past expert-1 counts). So dispatch reduces to a scatter of token indices
+  into slots — O(tokens) instead of the O(tokens*E*C*D) dispatch einsum
+  (ref FeedForwardNetworksApplyGating:2992 computes the same routing as a
+  dense einsum; this is the TPU-friendly sparse formulation of it).
+  """
+  c = gating.capacity
+  k, g, _ = gating.indices.shape
+  src = jnp.full((g, e * c), s, jnp.int32)
+  iota_s = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (g, s))
+  for i in range(k):
+    flat = gating.indices[i] * c + gating.positions[i]
+    # dropped tokens (gate 0) scatter out of bounds -> mode="drop"
+    flat = jnp.where(gating.gates[i] > 0, flat, e * c)
+    src = jax.vmap(lambda sr, fi, io: sr.at[fi].set(io, mode="drop"))(
+        src, flat, iota_s)
+  return src
+
+
+def IndexedDispatch(xg: jax.Array, gating: NestedMap, e: int) -> jax.Array:
+  """[G,S,D] tokens -> [E,G,C,D] expert inputs via gather (no einsum)."""
+  g, s, d = xg.shape
+  c = gating.capacity
+  src = SlotSources(gating, e, s)                                # [G,E*C]
+  xg_pad = jnp.concatenate([xg, jnp.zeros((g, 1, d), xg.dtype)], axis=1)
+  expert_in = jnp.take_along_axis(xg_pad, src[..., None], axis=1)
+  return expert_in.reshape(g, e, c, d).transpose(1, 0, 2, 3)
+
+
+def IndexedCombine(expert_out: jax.Array, gating: NestedMap) -> jax.Array:
+  """[E,G,C,D] expert outputs -> [G,S,D] tokens: gather + gate-weighted sum."""
+  e, g, c, d = expert_out.shape
+  k, _, s = gating.indices.shape
+  eo = expert_out.transpose(1, 0, 2, 3).reshape(g, e * c, d)
+  out = jnp.zeros((g, s, d), expert_out.dtype)
+  for i in range(k):
+    flat = jnp.clip(gating.indices[i] * c + gating.positions[i], 0, e * c - 1)
+    vals = jnp.take_along_axis(eo, flat[..., None], axis=1)      # [G,S,D]
+    out = out + gating.gates[i][..., None].astype(eo.dtype) * vals
+  return out
 
 
 class MoEFeedForwardLayer(base_layer.BaseLayer):
@@ -272,10 +341,19 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
              "Randomly permute tokens within each group before capacity "
              "truncation (ref gshard_layers.py:2496) so drops are unbiased; "
              "train-time only.")
-    p.Define("dispatch_via_shard_map", False,
+    p.Define("dispatch_via_shard_map", None,
              "Dispatch/combine through shard_map with an explicit "
              "jax.lax.all_to_all over the 'expert' axis instead of relying "
-             "on GSPMD inferring one from the einsum resharding.")
+             "on GSPMD inferring one from the einsum resharding. None = "
+             "auto: use shard_map whenever an 'expert' mesh axis exists and "
+             "the group/expert counts divide it (the explicit collective "
+             "never regresses to all-gather).")
+    p.Define("dispatch_method", "auto",
+             "'einsum': one-hot dispatch/combine einsums over [G,S,E,C] "
+             "(what GSPMD auto-partitioning needs to infer the all-to-all); "
+             "'indexed': scatter/gather slot assignment, O(tokens*D) memory "
+             "ops instead of O(tokens*E*C*D) matmul flops; 'auto': indexed "
+             "except on the GSPMD einsum multi-device path.")
     p.Define("second_expert_policy", "all", "'all' or 'random'.")
     p.Define("aux_loss_weight", 0.01, "Aux load-balancing loss weight.")
     p.Define("residual_dropout_prob", 0.0, "Residual dropout.")
@@ -347,13 +425,36 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     else:
       xg_gate, pg_gate = xg, pg
 
+    # Pick the dispatch formulation. The explicit shard_map all-to-all (with
+    # indexed local dispatch) is the default whenever an 'expert' mesh axis
+    # exists and the divisibility constraints hold; without an expert axis
+    # the indexed (gather/scatter) path avoids the one-hot einsums entirely;
+    # 'einsum' remains for the GSPMD-inferred collective path.
+    n_exp_axis = mesh_lib.CurrentMeshAxisSize("expert") or 0
+    use_shard_map = p.dispatch_via_shard_map
+    if use_shard_map is None:
+      # an explicit dispatch_method='einsum' opts into the GSPMD-inferred
+      # collective path, so auto must not steer it into shard_map
+      use_shard_map = (p.dispatch_method != "einsum" and bool(n_exp_axis)
+                       and g % max(n_exp_axis, 1) == 0
+                       and p.num_experts % max(n_exp_axis, 1) == 0)
+    else:
+      use_shard_map = bool(use_shard_map) and bool(n_exp_axis)
+    method = p.dispatch_method
+    if method == "auto":
+      method = "einsum" if (n_exp_axis and not use_shard_map) else "indexed"
+    # shard_map dispatches via the indexed form; only the plain einsum path
+    # consumes the O(G*S*E*C) one-hot tensors
+    build_tensors = method == "einsum" and not use_shard_map
+
     if p.gating_policy == "hash":
       assert token_ids is not None, "hash gating needs token_ids"
       idg = token_ids.reshape(g, s)
       if perm is not None:
         idg = _TakeAlongS(idg[..., None], perm)[..., 0]
       gating = HashGating(idg, p.num_experts, pg_gate, p.capacity_factor,
-                          capacity=p.expert_capacity or None)
+                          capacity=p.expert_capacity or None,
+                          build_tensors=build_tensors)
     elif p.gating_policy == "sinkhorn":
       logits = jnp.einsum("GSD,DE->GSE", xg_gate,
                           th.gating.astype(xg.dtype))
@@ -361,7 +462,8 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
           logits, pg_gate, p.capacity_factor,
           num_iters=p.sinkhorn_num_iters,
           temperature=p.sinkhorn_temperature,
-          capacity=p.expert_capacity or None)
+          capacity=p.expert_capacity or None,
+          build_tensors=build_tensors)
     else:
       logits = jnp.einsum("GSD,DE->GSE", xg_gate,
                           th.gating.astype(xg.dtype))
@@ -377,18 +479,27 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
           prng_key = py_utils.StepSeed(f"{self.path}/gating")
       gating = Top2Gating(
           logits, pg_gate, p.capacity_factor, policy, prng_key,
-          capacity=p.expert_capacity or None)
+          capacity=p.expert_capacity or None,
+          build_tensors=build_tensors)
 
-    dispatch = gating.dispatch_tensor.astype(xg.dtype)    # [G,S,E,C]
-    combine = gating.combine_tensor.astype(xg.dtype)
     if inv_perm is not None:
       # gating ran in shuffled token order: restore data order on S
-      dispatch = _TakeAlongS(dispatch, inv_perm)
-      combine = _TakeAlongS(combine, inv_perm)
+      for key in ("indices", "positions", "gates"):
+        gating[key] = jnp.stack(
+            [_TakeAlongS(a, inv_perm) for a in gating[key]])
+      if build_tensors:
+        gating.dispatch_tensor = _TakeAlongS(gating.dispatch_tensor, inv_perm)
+        gating.combine_tensor = _TakeAlongS(gating.combine_tensor, inv_perm)
 
-    if p.dispatch_via_shard_map and mesh_lib.CurrentMeshAxisSize("expert"):
-      out = self._DispatchShardMap(th, xg, dispatch, combine)
+    if use_shard_map:
+      out = self._DispatchShardMap(th, xg, gating)
+    elif method == "indexed":
+      expert_in = IndexedDispatch(xg, gating, p.num_experts)     # [E,G,C,D]
+      expert_out = self._ExpertFfn(th, expert_in)
+      out = IndexedCombine(expert_out, gating)
     else:
+      dispatch = gating.dispatch_tensor.astype(xg.dtype)  # [G,S,E,C]
+      combine = gating.combine_tensor.astype(xg.dtype)
       # GShard layout: token GROUPS shard over the same devices as experts
       # (G over 'expert' axis). The dispatch einsum output is constrained
       # expert-major, so GSPMD must move tokens G-sharded -> E-sharded:
@@ -428,20 +539,21 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     h = activations.GetFn(self.p.activation)(h)
     return jnp.einsum("EGCH,EHD->EGCD", h, th.wo)
 
-  def _DispatchShardMap(self, th, xg, dispatch, combine):
+  def _DispatchShardMap(self, th, xg, gating):
     """Explicit all-to-all dispatch via shard_map over the 'expert' axis.
 
     The einsum formulation relies on GSPMD noticing that `expert_in` flips
     from group-major to expert-major sharding and inserting an all-to-all;
     when it mis-infers (an all-gather instead), this path states the
     collective outright (ref FeedForwardNetworksApplyGating:2992 — same
-    math, the collective made explicit):
+    math, the collective made explicit). Local dispatch/combine use the
+    indexed (scatter/gather) formulation, not one-hot einsums:
 
-      per device: local groups -> [E, g_loc, C, D]
+      per device: gather local groups' tokens into slots -> [E, g_loc, C, D]
       all_to_all over 'expert': split E, concat g -> [e_loc, G, C, D]
       local expert FFN (each device owns its experts' weights)
       all_to_all back: split g, concat E -> [E, g_loc, C, D]
-      local combine
+      local combine (gather + gate-weighted sum)
     """
     try:
       from jax import shard_map  # jax >= 0.8
@@ -452,6 +564,7 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     n_exp = mesh_lib.CurrentMeshAxisSize("expert")
     g, s, d = xg.shape
     e = self.p.num_experts
+    c = gating.capacity
     assert g % n_exp == 0, (
         f"shard_map dispatch needs groups ({g}) divisible by the expert "
         f"axis ({n_exp})")
@@ -463,9 +576,11 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     # contraction over H is completed with a psum over 'model'.
     has_model_tp = bool(mesh_lib.CurrentMeshAxisSize("model"))
 
-    def _Local(xg_l, disp_l, comb_l, wi_l, wo_l):
-      # xg_l [g_loc, S, D]; disp_l [g_loc, S, E, C]; wi_l [e_loc, D, H_loc]
-      expert_in = jnp.einsum("gSEC,gSD->EgCD", disp_l, xg_l)
+    def _Local(xg_l, idx_l, pos_l, gate_l, wi_l, wo_l):
+      # xg_l [g_loc, S, D]; idx/pos/gate_l [K, g_loc, S]; wi_l [e_loc, D, H?]
+      gating_l = NestedMap(indices=idx_l, positions=pos_l, gates=gate_l,
+                           capacity=c)
+      expert_in = IndexedDispatch(xg_l, gating_l, e)   # [E, g_loc, C, D]
       # split E over devices, gather all group shards: [e_loc, G, C, D]
       expert_in = jax.lax.all_to_all(
           expert_in, "expert", split_axis=0, concat_axis=1, tiled=True)
@@ -475,14 +590,17 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
       # back: split G, concat E -> [E, g_loc, C, D]
       h = jax.lax.all_to_all(
           h, "expert", split_axis=1, concat_axis=0, tiled=True)
-      return jnp.einsum("gSEC,EgCD->gSD", comb_l, h)
+      return IndexedCombine(h, gating_l)
 
     model_ax = "model" if has_model_tp else None
     return shard_map(
         _Local, mesh=mesh,
-        in_specs=(P("expert"), P("expert"), P("expert"),
+        in_specs=(P("expert"), P(None, "expert"), P(None, "expert"),
+                  P(None, "expert"),
                   P("expert", None, model_ax), P("expert", model_ax, None)),
-        out_specs=P("expert"))(xg, dispatch, combine, th.wi, th.wo)
+        out_specs=P("expert"))(
+            xg, gating.indices, gating.positions, gating.gates,
+            th.wi, th.wo)
 
 
 class DenseMoEBlock(base_layer.BaseLayer):

@@ -120,7 +120,8 @@ def ThetaShardings(mesh: Mesh, layer, theta: NestedMap | None = None,
   return jax.tree_util.tree_map(_One, specs, theta)
 
 
-def TrainStateShardings(mesh: Mesh, task, state: NestedMap) -> NestedMap:
+def TrainStateShardings(mesh: Mesh, task, state: NestedMap,
+                        fsdp_axis: str | None = None) -> NestedMap:
   """Shardings for a full train state (theta + opt slots + step).
 
   Optimizer slot tensors inherit the sharding of their weight where shapes
@@ -128,9 +129,35 @@ def TrainStateShardings(mesh: Mesh, task, state: NestedMap) -> NestedMap:
   slots (vr/vc drop the last/second-to-last dim respectively) — the
   TPU-native equivalent of the reference's sharded optimizer slots
   (`optimizer.py:905-1275`).
+
+  fsdp_axis: if set (usually 'data'), ZeRO-style-shard every state tensor
+  additionally over that axis, on the first dim that divides evenly and is
+  not already model-sharded. f32 master weights, momentum, and factored
+  slots then live data-sharded; GSPMD all-gathers the bf16 compute copy per
+  scan step (FSDP) and reduce-scatters gradients — what lets 175B-scale
+  states fit per-device HBM when tensor parallelism alone cannot (the
+  reference's XLAShardingAdafactor slot sharding, taken one step further).
   """
   flat_specs = dict(task.VariableSpecs().FlattenItems())
   replicated = NamedSharding(mesh, PartitionSpec())
+  fsdp_size = mesh.shape[fsdp_axis] if (
+      fsdp_axis and fsdp_axis in mesh.axis_names) else 0
+
+  def _AddFsdp(spec: PartitionSpec, shape) -> PartitionSpec:
+    if not fsdp_size or fsdp_size == 1:
+      return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (entry, dim) in enumerate(zip(entries, shape)):
+      names = entry if isinstance(entry, tuple) else (
+          (entry,) if entry is not None else ())
+      if fsdp_axis in names:
+        return spec  # already sharded over it
+      taken = int(np.prod([mesh.shape[nm] for nm in names])) if names else 1
+      if dim % (taken * fsdp_size) == 0:
+        new = tuple(names) + (fsdp_axis,)
+        entries[i] = new if len(new) > 1 else new[0]
+        return PartitionSpec(*entries)
+    return spec
 
   def _ForPath(path: str, leaf):
     # state paths look like: theta.a.b.w / opt_states[0].slots.a.b.w.vr /
@@ -155,9 +182,11 @@ def TrainStateShardings(mesh: Mesh, task, state: NestedMap) -> NestedMap:
     else:
       return replicated
     wp = flat_specs.get(var_path)
-    if wp is None or wp.tensor_split_dims_mapping is None:
+    if wp is None:
       return replicated
-    sdm = list(wp.tensor_split_dims_mapping)
+    if wp.tensor_split_dims_mapping is None and not fsdp_size:
+      return replicated
+    sdm = list(wp.tensor_split_dims_mapping or [None] * len(wp.shape))
     shape = list(wp.shape)
     if slot == "vr":  # reduced over last dim
       sdm, shape = sdm[:-1], shape[:-1]
@@ -172,6 +201,7 @@ def TrainStateShardings(mesh: Mesh, task, state: NestedMap) -> NestedMap:
       else:
         return replicated
     spec = _FilterSpecToMesh(SpecFromSplitDims(sdm), mesh, shape)
+    spec = _AddFsdp(spec, shape)
     return NamedSharding(mesh, spec)
 
   items = state.FlattenItems()

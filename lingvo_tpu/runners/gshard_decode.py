@@ -53,14 +53,29 @@ class GShardDecode:
     temp = self._temperature
 
     def _Decode(theta, prompts, prompt_lens, key):
-      """prompts [B, P] -> sampled continuations [B, t_max]."""
-      b, p_len = prompts.shape
-      states = task.InitDecodeState(theta, b, p_len + t_max)
+      """prompts [B, P] RIGHT-ALIGNED (left-padded) -> continuations
+      [B, t_max].
 
-      # teacher-force the prompt through the KV cache
+      Variable-length support: each row's prompt occupies cache slots
+      [P - len_i, P), so every row's last prompt token sits at slot P-1 and
+      sampling starts at slot P for all rows. Left-pad slots are excluded
+      from attention forever via cache_paddings (their K/V are garbage).
+      Rotary attention depends only on relative positions, so global slot
+      indices give the same numerics as an unpadded per-length batch.
+      """
+      b, p_len = prompts.shape
+      total = p_len + t_max
+      states = task.InitDecodeState(theta, b, total)
+      # slot s is pad for row i iff s < P - len_i
+      slot = jnp.arange(total)[None, :]
+      cache_paddings = (slot < (p_len - prompt_lens)[:, None]).astype(
+          jnp.float32)                                     # [B, total]
+
+      # teacher-force the (right-aligned) prompt through the KV cache
       def _Prime(carry, ids_t):
         states = carry
-        logits, states = task.ExtendStep(theta, ids_t[:, None], states)
+        logits, states = task.ExtendStep(theta, ids_t[:, None], states,
+                                         cache_paddings=cache_paddings)
         return states, logits
 
       states, logits = jax.lax.scan(_Prime, states,
@@ -74,7 +89,8 @@ class GShardDecode:
         else:
           nxt = jnp.argmax(logits, axis=-1)
         nxt = nxt.astype(jnp.int32)
-        new_logits, states = task.ExtendStep(theta, nxt[:, None], states)
+        new_logits, states = task.ExtendStep(theta, nxt[:, None], states,
+                                             cache_paddings=cache_paddings)
         return (states, new_logits), nxt
 
       keys = jax.random.split(key, t_max)
@@ -84,16 +100,23 @@ class GShardDecode:
     self._decode_fn = jax.jit(_Decode)
     return self._decode_fn
 
+  @staticmethod
+  def _RightAlign(prompts: np.ndarray, prompt_lens: np.ndarray) -> np.ndarray:
+    """Shifts each row's first len_i tokens to the row's END (left-pad)."""
+    prompts = np.asarray(prompts)
+    out = np.zeros_like(prompts)
+    p = prompts.shape[1]
+    for i, ln in enumerate(np.asarray(prompt_lens)):
+      ln = int(ln)
+      out[i, p - ln:] = prompts[i, :ln]
+    return out
+
   def DecodeOnce(self, step: int, prompts: np.ndarray,
                  prompt_lens: np.ndarray) -> list:
-    if not np.all(np.asarray(prompt_lens) == prompts.shape[1]):
-      raise NotImplementedError(
-          "variable-length prompts would teacher-force pad tokens into the "
-          "KV cache (silently wrong continuations); batch prompts of equal "
-          "length together, or truncate to the shortest")
     state, restored = self._checkpointer.Restore(self._template, step=step)
     fn = self._GetDecodeFn()
-    out = fn(state.theta, jnp.asarray(prompts), jnp.asarray(prompt_lens),
+    aligned = self._RightAlign(prompts, prompt_lens)
+    out = fn(state.theta, jnp.asarray(aligned), jnp.asarray(prompt_lens),
              jax.random.PRNGKey(restored))
     self._last_step = restored
     results = []

@@ -165,3 +165,40 @@ class TestGShardDecodeDriver:
       preds = task.ComputePredictions(theta, batch)
       ids.append(int(jnp.argmax(preds.logits[0, -1])))
     assert got == ids[4:], (got, ids[4:])
+
+  def test_variable_length_prompts_match_per_length_batches(self, tmp_path):
+    """VERDICT r2 Next #10: a batch of mixed-length prompts must produce
+    the same continuations as separate per-length batches (right-aligned
+    cache + left-pad masking)."""
+    from lingvo_tpu.runners import gshard_decode
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    train_dir = str(tmp_path / "train")
+    ckpt = checkpointer_lib.Checkpointer(train_dir)
+    state = task.CreateTrainState(jax.random.PRNGKey(3))
+    ckpt.Save(1, state, force=True)
+    ckpt.Close()
+
+    driver = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "mixed.jsonl"), max_decode_steps=4)
+    # mixed batch: lengths 4 and 2 (left-aligned input convention)
+    prompts = np.array([[5, 6, 7, 8], [9, 10, 0, 0]], np.int32)
+    recs = driver.DecodeOnce(1, prompts, np.array([4, 2], np.int32))
+
+    d_full = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "full.jsonl"), max_decode_steps=4)
+    rec_full = d_full.DecodeOnce(1, np.array([[5, 6, 7, 8]], np.int32),
+                                 np.array([4], np.int32))
+    d_short = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "short.jsonl"), max_decode_steps=4)
+    rec_short = d_short.DecodeOnce(1, np.array([[9, 10]], np.int32),
+                                   np.array([2], np.int32))
+
+    assert recs[0]["output_ids"] == rec_full[0]["output_ids"]
+    assert recs[1]["output_ids"] == rec_short[0]["output_ids"]
+    assert recs[1]["prompt_ids"] == [9, 10]

@@ -289,6 +289,83 @@ class TestShardedEmbedding:
     np.testing.assert_allclose(np.asarray(out[0, 0]),
                                np.asarray(theta.table[1]), atol=1e-5)
 
+  def test_gather_matches_one_hot_single_device(self):
+    p0 = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        name="tbl", vocab_size=50, embedding_dim=8)
+    t_oh = p0.Copy().Set(lookup_method="one_hot").Instantiate()
+    t_g = p0.Copy().Set(lookup_method="gather").Instantiate()
+    theta = t_oh.InstantiateVariables(KEY)
+    ids = jnp.asarray([[1, 49, 0], [7, 7, 12]], jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(t_oh.EmbLookup(theta, ids)),
+        np.asarray(t_g.EmbLookup(theta, ids)), atol=1e-5)
+
+  def test_sharded_gather_matches_one_hot_on_mesh(self):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs 8 devices")
+    from lingvo_tpu.parallel import mesh as mesh_lib
+    p0 = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        name="tbl", vocab_size=64, embedding_dim=8, shard_axis="data")
+    t_oh = p0.Copy().Set(lookup_method="one_hot").Instantiate()
+    t_g = p0.Copy().Set(lookup_method="gather").Instantiate()
+    theta = t_oh.InstantiateVariables(KEY)
+    mesh = mesh_lib.MakeMesh({"data": 8})
+    placed = jax.device_put(theta, mesh_lib.ThetaShardings(mesh, t_oh, theta))
+    ids = jnp.asarray([[0, 8, 63], [17, 17, 31]], jnp.int32)
+    with mesh_lib.MeshContext(mesh):
+      out_g = jax.jit(t_g.EmbLookup)(placed, ids)
+      out_oh = jax.jit(t_oh.EmbLookup)(placed, ids)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_oh),
+                               atol=1e-5)
+
+  def test_sharded_gather_gradients_match_one_hot(self):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs 8 devices")
+    from lingvo_tpu.parallel import mesh as mesh_lib
+    p0 = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        name="tbl", vocab_size=64, embedding_dim=8, shard_axis="data")
+    t_oh = p0.Copy().Set(lookup_method="one_hot").Instantiate()
+    t_g = p0.Copy().Set(lookup_method="gather").Instantiate()
+    theta = t_oh.InstantiateVariables(KEY)
+    mesh = mesh_lib.MakeMesh({"data": 8})
+    placed = jax.device_put(theta, mesh_lib.ThetaShardings(mesh, t_oh, theta))
+    ids = jnp.asarray([[0, 8, 63], [17, 17, 31]], jnp.int32)
+
+    def loss(layer):
+      return lambda th: jnp.sum(layer.EmbLookup(th, ids) ** 2)
+
+    with mesh_lib.MeshContext(mesh):
+      g_g = jax.jit(jax.grad(loss(t_g)))(placed)
+      g_oh = jax.jit(jax.grad(loss(t_oh)))(placed)
+    np.testing.assert_allclose(np.asarray(g_g.table),
+                               np.asarray(g_oh.table), atol=1e-4)
+
+  def test_per_table_optimizer_rules(self):
+    from lingvo_tpu.core import optimizer as opt_lib
+    tp = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
+        vocab_size=10, embedding_dim=4)
+    p = tpu_embedding_layers.TpuEmbeddingCollection.Params().Set(
+        name="coll",
+        tables=[("words", tp.Copy().Set(
+            optimizer=opt_lib.Adagrad.Params())), ("cats", tp.Copy())],
+        feature_to_table={"query": "words", "category": "cats"})
+    coll = p.Instantiate()
+    coll.FinalizePaths()
+    rules = coll.OptimizerRules(opt_lib.SGD.Params())
+    comp = opt_lib.CompositeOptimizer.Params().Set(
+        name="comp", optimizer_map=rules).Instantiate()
+    theta = coll.InstantiateVariables(KEY)
+    state = comp.InitState(theta)
+    # words table routes to Adagrad (index 0), cats to the SGD default
+    assert comp._RouteIndex("table_words.table") == 0
+    assert comp._RouteIndex("table_cats.table") == 1
+    # one update step must change the words table via the Adagrad rule
+    grads = theta.Transform(jnp.ones_like)
+    new_theta, _ = comp.Update(state, grads, theta, 0.1, jnp.zeros((),
+                                                                  jnp.int32))
+    assert not np.allclose(np.asarray(new_theta.table_words.table),
+                           np.asarray(theta.table_words.table))
+
   def test_collection_routes_features(self):
     tp = tpu_embedding_layers.ShardedEmbeddingTable.Params().Set(
         vocab_size=10, embedding_dim=4)
