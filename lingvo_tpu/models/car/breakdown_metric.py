@@ -1,0 +1,114 @@
+"""Breakdown AP metrics: AP sliced by ground-truth difficulty dimensions
+(ref `lingvo/tasks/car/breakdown_metric.py` ByDistance:252 / ByRotation:371 /
+ByNumPoints:471).
+
+Each breakdown partitions boxes into bins (distance from the sensor, box
+rotation, points inside the box) and reports a per-bin AP: ground truths
+are binned by their own attribute and predictions by theirs (the
+reference's convention — both sides of the match are sliced the same way,
+so a perfect detector scores 1.0 in every populated bin). Host-side numpy
+like ap_metric.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from lingvo_tpu.models.car import ap_metric
+
+
+class BreakdownApMetric:
+  """AP per bin of a ground-truth attribute."""
+
+  def __init__(self, bin_edges, bin_of_gt, iou_threshold: float = 0.5):
+    """bin_edges: labels only (len = num bins); bin_of_gt(gt_box [7]) ->
+    bin index in [0, num_bins) or -1 to exclude."""
+    self._labels = list(bin_edges)
+    self._bin_of_gt = bin_of_gt
+    self._metrics = [ap_metric.ApMetric(iou_threshold)
+                     for _ in self._labels]
+
+  def Update(self, pred_boxes, pred_scores, gt_boxes,
+             pred_classes=None, gt_classes=None):
+    gt_bins = np.array([self._bin_of_gt(g) for g in gt_boxes], np.int64) \
+        if len(gt_boxes) else np.zeros((0,), np.int64)
+    pred_bins = np.array([self._bin_of_gt(g) for g in pred_boxes],
+                         np.int64) if len(pred_boxes) else np.zeros(
+                             (0,), np.int64)
+    for b, metric in enumerate(self._metrics):
+      sel = gt_bins == b
+      psel = pred_bins == b
+      metric.Update(
+          pred_boxes[psel], pred_scores[psel], gt_boxes[sel],
+          pred_classes=(pred_classes[psel] if pred_classes is not None
+                        else None),
+          gt_classes=(gt_classes[sel] if gt_classes is not None else None))
+
+  @property
+  def value(self) -> dict:
+    return {label: m.value for label, m in zip(self._labels, self._metrics)}
+
+
+def ByDistance(max_distance: float = 80.0, num_bins: int = 4,
+               iou_threshold: float = 0.5) -> BreakdownApMetric:
+  """AP binned by BEV distance of the gt box center from the origin
+  (ref breakdown_metric.ByDistance:252)."""
+  edges = np.linspace(0.0, max_distance, num_bins + 1)
+  labels = [f"dist_{edges[i]:.0f}_{edges[i + 1]:.0f}"
+            for i in range(num_bins)]
+
+  def _Bin(gt):
+    d = math.hypot(float(gt[0]), float(gt[1]))
+    if d >= max_distance:
+      return num_bins - 1
+    return int(d / max_distance * num_bins)
+
+  return BreakdownApMetric(labels, _Bin, iou_threshold)
+
+
+def ByRotation(num_bins: int = 4,
+               iou_threshold: float = 0.5) -> BreakdownApMetric:
+  """AP binned by gt heading folded into [0, pi) (ref ByRotation:371)."""
+  labels = [f"rot_{i}_of_{num_bins}" for i in range(num_bins)]
+
+  def _Bin(gt):
+    phi = float(gt[6]) % math.pi
+    return min(int(phi / math.pi * num_bins), num_bins - 1)
+
+  return BreakdownApMetric(labels, _Bin, iou_threshold)
+
+
+def ByNumPoints(edges=(1, 50, 200, 100000),
+                iou_threshold: float = 0.5):
+  """AP binned by the number of laser points inside the gt box
+  (ref ByNumPoints:471). The caller must annotate gt boxes with a point
+  count in column 7 (i.e. pass [..., 8] boxes: 7-DOF + count)."""
+  labels = [f"pts_lt_{e}" for e in edges]
+
+  def _Bin(gt):
+    n = float(gt[7]) if len(gt) > 7 else 0.0
+    for i, e in enumerate(edges):
+      if n < e:
+        return i
+    return len(edges) - 1
+
+  return BreakdownApMetric(labels, _Bin, iou_threshold)
+
+
+def CountPointsInBoxes(points: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+  """points [N, >=3], boxes [G, 7] -> [G] count of points inside each
+  (rotated BEV footprint x z-extent)."""
+  if len(points) == 0 or len(boxes) == 0:
+    return np.zeros((len(boxes),), np.int64)
+  counts = np.zeros((len(boxes),), np.int64)
+  for g, b in enumerate(boxes):
+    dx, dy = points[:, 0] - b[0], points[:, 1] - b[1]
+    c, s = math.cos(-b[6]), math.sin(-b[6])
+    lx = dx * c - dy * s
+    ly = dx * s + dy * c
+    inside = ((np.abs(lx) <= b[3] / 2) & (np.abs(ly) <= b[4] / 2) &
+              (np.abs(points[:, 2] - b[2]) <= b[5] / 2))
+    counts[g] = int(inside.sum())
+  return counts

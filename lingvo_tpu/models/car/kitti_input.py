@@ -1,0 +1,235 @@
+"""KITTI-format input: label/calibration parsing + a file-based scene input
+generator over the native record yielder.
+
+Re-designs `lingvo/tasks/car/kitti_input_generator.py` +
+`tools/kitti_data.py`: the same label-line grammar (15/16 tokens,
+camera-frame h/w/l + x/y/z + rotation_y), the same camera->velodyne box
+conversion (z recentred to the box middle, phi = -rotation_y - pi/2), and
+the same canonical 7-DOF (x, y, z, dx, dy, dz, phi) output — but records
+flow through the C++ shuffle-ring yielder as JSON-line scenes instead of
+TFRecords of TF Examples, and target assignment happens on device
+(`detection_3d.AssignAnchors`), not in the input graph.
+
+Record format (one JSON object per line):
+  {"points": [[x, y, z, reflectance], ...],     # velodyne frame
+   "labels": ["Car 0.00 0 ...", ...],           # raw KITTI label lines
+   "calib": {"R0_rect": [9 floats], "Tr_velo_to_cam": [12 floats]}}
+`calib` may be omitted: boxes are then taken to already be in the velodyne
+frame with the nominal axis swap (the camera at the velodyne origin).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+KITTI_TYPES = ("Car", "Van", "Truck", "Pedestrian", "Person_sitting",
+               "Cyclist", "Tram", "Misc", "DontCare")
+# the reference's standard class splits (kitti train uses these three)
+CLASS_IDS = {"Car": 1, "Pedestrian": 2, "Cyclist": 3}
+
+
+def ParseKittiLabelLine(line: str) -> dict:
+  """One label line -> dict (ref kitti_data.LoadLabelFile:89 grammar)."""
+  parts = line.strip().split(" ")
+  if len(parts) not in (15, 16):
+    raise ValueError(f"expected 15/16 tokens, got {len(parts)}: {line!r}")
+  if len(parts) == 15:
+    parts.append("-1")
+  (obj_type, truncated, occluded, alpha, bl, bt, br, bb, h, w, l,
+   x, y, z, rot_y, score) = parts
+  if obj_type not in KITTI_TYPES:
+    raise ValueError(f"invalid type {obj_type!r}")
+  return {
+      "type": obj_type,
+      "truncated": float(truncated),
+      "occluded": int(occluded),
+      "alpha": float(alpha),
+      "bbox": [float(v) for v in (bl, bt, br, bb)],
+      "dimensions": [float(v) for v in (h, w, l)],   # height, width, length
+      "location": [float(v) for v in (x, y, z)],     # camera frame
+      "rotation_y": float(rot_y),
+      "score": float(score),
+  }
+
+
+def VeloToCameraTransformation(calib: dict) -> np.ndarray:
+  """4x4 velodyne->camera matrix from R0_rect (3x3) + Tr_velo_to_cam (3x4)
+  (ref kitti_data.VeloToCameraTransformation:250)."""
+  r0 = np.eye(4)
+  r0[:3, :3] = np.asarray(calib["R0_rect"], np.float64).reshape(3, 3)
+  tr = np.eye(4)
+  tr[:3, :4] = np.asarray(calib["Tr_velo_to_cam"], np.float64).reshape(3, 4)
+  return r0 @ tr
+
+
+def CameraToVeloTransformation(calib: dict) -> np.ndarray:
+  return np.linalg.pinv(VeloToCameraTransformation(calib))
+
+
+_NOMINAL_CAM_TO_VELO = np.array(
+    # velo_x = cam_z (forward), velo_y = -cam_x (left), velo_z = -cam_y (up)
+    [[0.0, 0, 1, 0], [-1, 0, 0, 0], [0, -1, 0, 0], [0, 0, 0, 1]])
+
+
+def KittiObjectToBBox3D(obj: dict, cam_to_velo: np.ndarray | None = None):
+  """KITTI object -> canonical (x, y, z, dx, dy, dz, phi) in the velodyne
+  frame, or None when the object has no 3D info (ref
+  kitti_data._KITTIObjectToBBox3D:316)."""
+  height, width, length = obj["dimensions"]
+  if height == -1 or width == -1 or length == -1:
+    return None
+  if cam_to_velo is None:
+    cam_to_velo = _NOMINAL_CAM_TO_VELO
+  xyz1 = np.asarray(list(obj["location"]) + [1.0])
+  x, y, z = (cam_to_velo @ xyz1)[:3]
+  z += height / 2.0  # KITTI anchors z at the box bottom
+  phi = -obj["rotation_y"] - np.pi / 2.0
+  return np.array([x, y, z, length, width, height, phi], np.float32)
+
+
+class KittiSceneInputGenerator(
+    base_input_generator.FileBasedSequenceInputGenerator):
+  """JSON-line KITTI scenes -> fixed-shape detection batches.
+
+  Emits the same fields as SyntheticCarInput (lasers/gt boxes + pillar and
+  grid-target views), so StarNet and PointPillars train from real files
+  unchanged."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("max_points", 512, "Lasers padded/subsampled to this count.")
+    p.Define("max_objects", 8, "GT boxes padded to this count.")
+    p.Define("grid_size", 16, "BEV grid cells per axis for the pillars view.")
+    p.Define("grid_range_x", (0.0, 16.0),
+             "(min, max) world x covered by the grid; real KITTI scenes "
+             "want e.g. (0, 70.4).")
+    p.Define("grid_range_y", (0.0, 16.0),
+             "(min, max) world y; real KITTI wants e.g. (-40, 40).")
+    p.Define("max_pillars", 64, "P.")
+    p.Define("points_per_pillar", 8, "N.")
+    p.Define("num_classes", 3,
+             "Foreground classes kept, in CLASS_IDS order (2 drops "
+             "Cyclist, 1 keeps only Car).")
+    p.bucket_upper_bound = [1]
+    return p
+
+  def __init__(self, params):
+    # scenes are fixed-shape: always one bucket of exactly batch_size
+    # (set here, not in Params() — batch_size is configured after Params())
+    params = params.Copy()
+    params.bucket_upper_bound = [1]
+    params.bucket_batch_limit = [params.batch_size or 2]
+    super().__init__(params)
+    self._record_counter = 0
+
+  def ProcessRecord(self, record: bytes):
+    p = self.p
+    self._record_counter += 1
+    try:
+      scene = json.loads(record.decode("utf-8"))
+      labels = [ParseKittiLabelLine(line)
+                for line in scene.get("labels", [])]
+    except (UnicodeDecodeError, json.JSONDecodeError, ValueError):
+      return None  # malformed record: drop, never kill the pipeline
+    pts = np.asarray(scene.get("points", []), np.float32).reshape(-1, 4)
+    cam_to_velo = None
+    if scene.get("calib"):
+      cam_to_velo = CameraToVeloTransformation(scene["calib"])
+    boxes, classes = [], []
+    for obj in labels:
+      cls_id = CLASS_IDS.get(obj["type"], 0)
+      if not 0 < cls_id <= p.num_classes:
+        continue  # DontCare / out-of-split types are dropped, ref behavior
+      bbox = KittiObjectToBBox3D(obj, cam_to_velo)
+      if bbox is None:
+        continue
+      boxes.append(bbox)
+      classes.append(cls_id)
+
+    # lasers: subsample-or-pad to max_points, varying the subsample per
+    # record read so repeated epochs see different points
+    from lingvo_tpu.models.car import detection_3d
+    (lasers,), lpad = detection_3d.RandomPadOrTrimTo(
+        [pts], p.max_points, key=self._record_counter * 2654435761 + len(pts))
+
+    gt_boxes = np.zeros((p.max_objects, 7), np.float32)
+    gt_classes = np.zeros((p.max_objects,), np.int32)
+    for i, (bx, cl) in enumerate(zip(boxes, classes)):
+      if i >= p.max_objects:
+        break
+      gt_boxes[i] = bx
+      gt_classes[i] = cl
+
+    # pillar + grid-target views (same scheme as SyntheticCarInput), with
+    # world->grid scaling so real KITTI ranges (x in [0, 70.4),
+    # y in [-40, 40)) map onto the g x g BEV grid
+    g = p.grid_size
+    x_lo, x_hi = p.grid_range_x
+    y_lo, y_hi = p.grid_range_y
+
+    def _CellXY(x, y):
+      """World xy -> (col, row) grid indices, or None when out of range."""
+      if not (x_lo <= x < x_hi and y_lo <= y < y_hi):
+        return None
+      col = int((x - x_lo) / (x_hi - x_lo) * g)
+      row = int((y - y_lo) / (y_hi - y_lo) * g)
+      return min(col, g - 1), min(row, g - 1)
+
+    pillars = np.zeros((p.max_pillars, p.points_per_pillar, 4), np.float32)
+    ppad = np.ones((p.max_pillars, p.points_per_pillar), np.float32)
+    cells = np.full((p.max_pillars,), -1, np.int32)
+    cls_t = np.zeros((g * g,), np.int32)
+    reg_t = np.zeros((g * g, 7), np.float32)
+    reg_w = np.zeros((g * g,), np.float32)
+    real = lasers[lpad == 0]
+    if len(real):
+      cell_of = np.full((len(real),), -1, np.int64)
+      for i, pt in enumerate(real):
+        cr = _CellXY(float(pt[0]), float(pt[1]))
+        if cr is not None:
+          cell_of[i] = cr[1] * g + cr[0]
+      order = np.argsort(cell_of, kind="stable")
+      order = order[cell_of[order] >= 0]
+      pi = -1
+      last_cell = None
+      fill = 0
+      for idx in order:
+        c = cell_of[idx]
+        if c != last_cell:
+          pi += 1
+          if pi >= p.max_pillars:
+            break
+          last_cell = c
+          cells[pi] = c
+          fill = 0
+        if fill < p.points_per_pillar:
+          pillars[pi, fill] = real[idx]
+          ppad[pi, fill] = 0.0
+          fill += 1
+    cell_w = (x_hi - x_lo) / g
+    cell_h = (y_hi - y_lo) / g
+    for bx, cl in zip(boxes, classes):
+      cr = _CellXY(float(bx[0]), float(bx[1]))
+      if cr is None:
+        continue
+      col, row = cr
+      cell = row * g + col
+      cx_center = x_lo + (col + 0.5) * cell_w
+      cy_center = y_lo + (row + 0.5) * cell_h
+      cls_t[cell] = cl
+      reg_t[cell] = [bx[0] - cx_center, bx[1] - cy_center,
+                     bx[2], bx[3], bx[4], bx[5], bx[6]]
+      reg_w[cell] = 1.0
+
+    return NestedMap(
+        bucket_key=1,
+        pillar_points=pillars, point_paddings=ppad, pillar_cells=cells,
+        cls_targets=cls_t, reg_targets=reg_t, reg_weights=reg_w,
+        lasers=lasers, laser_paddings=lpad,
+        gt_boxes=gt_boxes, gt_classes=gt_classes)

@@ -1,5 +1,6 @@
 """Car configs (ref `lingvo/tasks/car/params/kitti.py` StarNetCarModel /
-PointPillars recipes, on synthetic scenes until real KITTI prep lands)."""
+PointPillars recipes): synthetic-scene smoke configs plus the KITTI-format
+file-based recipe over the native yielder."""
 
 from __future__ import annotations
 
@@ -63,6 +64,36 @@ class StarNetCar(base_model_params.SingleTaskModelParams):
         optimizer=opt_lib.Adam.Params(),
         lr_schedule=sched_lib.Constant.Params())
     p.train.tpu_steps_per_loop = 50
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class StarNetCarKitti(StarNetCar):
+  """StarNet on KITTI-format scene files (ref StarNetCarModel0701 +
+  kitti_input_generator.py). Point KITTI_SCENES at JSONL scene records
+  produced by tools (see models/car/kitti_input.py record format)."""
+
+  KITTI_SCENES = "text:/data/kitti/train_scenes.jsonl-*"
+  KITTI_TEST_SCENES = "text:/data/kitti/val_scenes.jsonl-*"
+  NUM_CLASSES = 3  # Car / Pedestrian / Cyclist
+
+  def Train(self):
+    from lingvo_tpu.models.car import kitti_input
+    return kitti_input.KittiSceneInputGenerator.Params().Set(
+        batch_size=self.BATCH_SIZE, file_pattern=self.KITTI_SCENES,
+        num_classes=self.NUM_CLASSES, max_points=1024, max_objects=32,
+        grid_size=64, grid_range_x=(0.0, 70.4), grid_range_y=(-40.0, 40.0))
+
+  def Test(self):
+    return self.Train().Set(file_pattern=self.KITTI_TEST_SCENES,
+                            shuffle=False, max_epochs=1)
+
+  def Task(self):
+    p = super().Task()
+    p.num_classes = self.NUM_CLASSES
+    p.num_centers = 128
+    p.use_oriented_nms = True
+    p.max_detections = 32
     return p
 
 

@@ -124,8 +124,15 @@ class StarNetModel(base_model.BaseTask):
              "(ref Builder.Atten, starnet.py:89).")
     p.Define("assign_radius", 1.5, "Center-to-GT distance for positives.")
     p.Define("huber_delta", 1.0, "Huber loss transition point.")
-    p.Define("nms_radius", 1.0, "Greedy decode suppression radius.")
-    p.Define("max_detections", 8, "Decode output cap per scene.")
+    p.Define("nms_radius", 1.0, "Greedy decode suppression radius "
+             "(use_oriented_nms=False path).")
+    p.Define("max_detections", 8, "Decode output cap per scene (per class "
+             "when oriented NMS is on).")
+    p.Define("use_oriented_nms", True,
+             "Per-class rotated-IoU NMS (detection_3d.DecodeWithNMS, ref "
+             "detection_decoder.py) instead of center-distance suppression.")
+    p.Define("nms_iou_threshold", 0.3, "Rotated-IoU suppression threshold.")
+    p.Define("nms_score_threshold", 0.01, "Min score to enter NMS.")
     return p
 
   def __init__(self, params, **kwargs):
@@ -250,6 +257,29 @@ class StarNetModel(base_model.BaseTask):
     boxes = jnp.concatenate(
         [preds.centers + res[..., :3], jnp.exp(res[..., 3:6]),
          (res[..., 6] + rot)[..., None]], axis=-1)           # [b, c, 7]
+
+    if p.use_oriented_nms:
+      from lingvo_tpu.models.car import detection_3d
+      # per-center class distribution (best anchor rotation's view)
+      cls_probs = jnp.concatenate(
+          [probs[..., 0:1].min(axis=2), jnp.max(probs[..., 1:], axis=2)],
+          axis=-1)                                           # [b, c, K+1]
+      det = detection_3d.DecodeWithNMS(
+          boxes, cls_probs, nms_iou_threshold=p.nms_iou_threshold,
+          score_threshold=p.nms_score_threshold,
+          max_boxes_per_class=p.max_detections)
+      b = boxes.shape[0]
+      ncls = cls_probs.shape[-1]
+      # flatten per-class outputs; padded slots carry score 0 (filtered in
+      # postprocess, same contract as the center-distance path)
+      out_boxes = det.bboxes[:, 1:].reshape(b, -1, 7)
+      out_scores = det.scores[:, 1:].reshape(b, -1)
+      cls_ids = jnp.broadcast_to(
+          jnp.arange(1, ncls)[None, :, None],
+          (b, ncls - 1, p.max_detections)).reshape(b, -1)
+      return NestedMap(boxes=out_boxes, scores=out_scores,
+                       classes=cls_ids.astype(jnp.int32),
+                       gt_boxes=batch.gt_boxes, gt_classes=batch.gt_classes)
 
     # greedy center-distance NMS with static iteration count; suppressed
     # entries go to -1 so exhausted scenes emit score<=0 slots (filtered in

@@ -1,0 +1,191 @@
+"""detection_3d: rotated IoU vs the independent numpy implementation,
+residual coding round-trip, anchor assignment, oriented NMS, corner loss."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.models.car import ap_metric, detection_3d
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _RandBoxes7(key, n, spread=8.0):
+  k1, k2, k3 = jax.random.split(key, 3)
+  xyz = jax.random.uniform(k1, (n, 3), minval=0.0, maxval=spread)
+  dims = jax.random.uniform(k2, (n, 3), minval=0.5, maxval=3.0)
+  phi = jax.random.uniform(k3, (n, 1), minval=-math.pi, maxval=math.pi)
+  return jnp.concatenate([xyz, dims, phi], -1)
+
+
+class TestRotatedIou:
+
+  def test_matches_numpy_reference(self):
+    # the jax polygon-clip IoU must agree with the independent numpy
+    # implementation used by the AP metric
+    a = np.asarray(_RandBoxes7(KEY, 12))
+    b = np.asarray(_RandBoxes7(jax.random.PRNGKey(1), 9))
+    got = np.asarray(detection_3d.RotatedIou7DOF(jnp.asarray(a),
+                                                 jnp.asarray(b)))
+    for i in range(a.shape[0]):
+      for j in range(b.shape[0]):
+        want = ap_metric.RotatedIou(a[i], b[j])
+        assert abs(got[i, j] - want) < 1e-4, (i, j, got[i, j], want)
+
+  def test_identity_and_disjoint(self):
+    boxes = jnp.asarray([[0.0, 0, 0, 2, 2, 2, 0.3],
+                         [100.0, 100, 0, 2, 2, 2, 0.0]])
+    iou = np.asarray(detection_3d.RotatedIou7DOF(boxes, boxes))
+    np.testing.assert_allclose(np.diag(iou), 1.0, atol=1e-5)
+    assert iou[0, 1] == 0.0
+
+  def test_jits(self):
+    a = _RandBoxes7(KEY, 4)
+    out = jax.jit(detection_3d.RotatedIou7DOF)(a, a)
+    assert out.shape == (4, 4)
+
+
+class TestResidualCoding:
+
+  def test_round_trip(self):
+    anchors = _RandBoxes7(KEY, 20)
+    gt = _RandBoxes7(jax.random.PRNGKey(1), 20)
+    res = detection_3d.LocalizationResiduals(anchors, gt)
+    back = detection_3d.ResidualsToBBoxes(anchors, res)
+    # angle wraps into [-pi, pi); compare sin/cos
+    np.testing.assert_allclose(np.asarray(back[..., :6]),
+                               np.asarray(gt[..., :6]), atol=1e-4)
+    np.testing.assert_allclose(np.sin(np.asarray(back[..., 6])),
+                               np.sin(np.asarray(gt[..., 6])), atol=1e-4)
+
+  def test_zero_residuals_reproduce_anchor(self):
+    anchors = _RandBoxes7(KEY, 5)
+    back = detection_3d.ResidualsToBBoxes(anchors, jnp.zeros((5, 7)))
+    np.testing.assert_allclose(np.asarray(back[..., :6]),
+                               np.asarray(anchors[..., :6]), atol=1e-5)
+
+
+class TestAnchors:
+
+  def test_dense_coordinates(self):
+    coords = detection_3d.CreateDenseCoordinates([(0, 1, 2), (0, 2, 3)])
+    assert coords.shape == (6, 2)
+    np.testing.assert_allclose(np.asarray(coords[0]), [0, 0])
+    np.testing.assert_allclose(np.asarray(coords[-1]), [1, 2])
+
+  def test_make_anchor_boxes(self):
+    centers = jnp.asarray([[0.0, 0, 0], [5, 5, 0]])
+    boxes = detection_3d.MakeAnchorBoxes(
+        centers, [[2.0, 1, 1], [4, 2, 2]], [0.0, math.pi / 2],
+        [[0.0, 0, 0], [0, 0, 1.0]])
+    assert boxes.shape == (2 * 2 * 2, 7)
+    np.testing.assert_allclose(np.asarray(boxes[0]), [0, 0, 0, 2, 1, 1, 0])
+    # second dim config carries its z offset
+    np.testing.assert_allclose(np.asarray(boxes[2]),
+                               [0, 0, 1, 4, 2, 2, 0])
+
+
+class TestAssignAnchors:
+
+  def _Setup(self):
+    anchors = jnp.asarray([
+        [0.0, 0, 0, 2, 2, 2, 0],     # on gt 0
+        [5.0, 5, 0, 2, 2, 2, 0],     # on gt 1
+        [50.0, 50, 0, 2, 2, 2, 0],   # background
+        [1.2, 0, 0, 2, 2, 2, 0],     # partial overlap with gt 0 -> ignore
+    ])
+    gt = jnp.asarray([[0.0, 0, 0, 2, 2, 2, 0],
+                      [5.0, 5, 0, 2, 2, 2, 0],
+                      [0.0, 0, 0, 0.1, 0.1, 0.1, 0]])
+    labels = jnp.asarray([1, 2, 1], jnp.int32)
+    mask = jnp.asarray([1.0, 1.0, 0.0])  # gt 2 is padding
+    return anchors, gt, labels, mask
+
+  def test_fg_bg_ignore(self):
+    anchors, gt, labels, mask = self._Setup()
+    out = detection_3d.AssignAnchors(
+        anchors, gt, labels, mask,
+        foreground_assignment_threshold=0.5,
+        background_assignment_threshold=0.1, force_match=False)
+    got_labels = np.asarray(out.assigned_gt_labels)
+    assert got_labels[0] == 1 and got_labels[1] == 2
+    assert got_labels[2] == 0  # background
+    np.testing.assert_allclose(np.asarray(out.assigned_cls_mask),
+                               [1, 1, 1, 0])  # anchor 3 ignored
+    np.testing.assert_allclose(np.asarray(out.assigned_reg_mask),
+                               [1, 1, 0, 0])
+
+  def test_force_match_rescues_unmatched_gt(self):
+    # one gt whose best anchor is below the fg threshold still gets it
+    anchors = jnp.asarray([[1.5, 0, 0, 2.0, 2, 2, 0],
+                           [50.0, 50, 0, 2, 2, 2, 0]])
+    gt = jnp.asarray([[0.0, 0, 0, 2.0, 2, 2, 0]])
+    labels = jnp.asarray([1], jnp.int32)
+    mask = jnp.asarray([1.0])
+    no_force = detection_3d.AssignAnchors(
+        anchors, gt, labels, mask, foreground_assignment_threshold=0.5,
+        force_match=False)
+    assert np.asarray(no_force.assigned_reg_mask).sum() == 0
+    forced = detection_3d.AssignAnchors(
+        anchors, gt, labels, mask, foreground_assignment_threshold=0.5,
+        force_match=True)
+    np.testing.assert_allclose(np.asarray(forced.assigned_reg_mask), [1, 0])
+    assert np.asarray(forced.assigned_gt_labels)[0] == 1
+
+
+class TestOrientedNMS:
+
+  def test_suppresses_overlaps_keeps_distinct(self):
+    boxes = jnp.asarray([
+        [0.0, 0, 0, 2, 2, 2, 0.0],
+        [0.1, 0, 0, 2, 2, 2, 0.05],   # near-duplicate of 0, lower score
+        [8.0, 8, 0, 2, 2, 2, 0.0],
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idxs, mask = detection_3d.OrientedNMSIndices(
+        boxes, scores, max_output_size=3, nms_iou_threshold=0.3)
+    kept = [int(i) for i, m in zip(np.asarray(idxs), np.asarray(mask)) if m]
+    assert kept == [0, 2]
+
+  def test_score_threshold(self):
+    boxes = jnp.asarray([[0.0, 0, 0, 2, 2, 2, 0.0],
+                         [8.0, 8, 0, 2, 2, 2, 0.0]])
+    scores = jnp.asarray([0.9, 0.005])
+    _, mask = detection_3d.OrientedNMSIndices(
+        boxes, scores, max_output_size=2, score_threshold=0.01)
+    assert np.asarray(mask).sum() == 1
+
+  def test_decode_with_nms_per_class(self):
+    b, n, c = 2, 8, 3
+    boxes = _RandBoxes7(KEY, b * n).reshape(b, n, 7)
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(2), (b, n, c)), -1)
+    out = jax.jit(lambda bb, pp: detection_3d.DecodeWithNMS(
+        bb, pp, max_boxes_per_class=4))(boxes, probs)
+    assert out.bboxes.shape == (b, c, 4, 7)
+    assert out.scores.shape == (b, c, 4)
+    # background class emits nothing
+    assert np.asarray(out.valid_mask)[:, 0].sum() == 0
+
+
+class TestCornerLoss:
+
+  def test_zero_for_exact_and_flipped(self):
+    boxes = _RandBoxes7(KEY, 6)
+    loss = detection_3d.CornerLoss(boxes, boxes)
+    np.testing.assert_allclose(np.asarray(loss), 0.0, atol=1e-5)
+    flipped = boxes.at[:, 6].add(math.pi)
+    loss_f = detection_3d.CornerLoss(boxes, flipped, symmetric=True)
+    np.testing.assert_allclose(np.asarray(loss_f), 0.0, atol=1e-3)
+    loss_nf = detection_3d.CornerLoss(boxes, flipped, symmetric=False)
+    assert np.asarray(loss_nf).min() > 0.1
+
+  def test_scaled_huber(self):
+    lab = jnp.zeros((3,))
+    pred = jnp.asarray([0.5, 2.0, -2.0])
+    loss = np.asarray(detection_3d.ScaledHuberLoss(lab, pred, delta=1.0))
+    np.testing.assert_allclose(loss[0], 0.125, atol=1e-6)  # quadratic zone
+    np.testing.assert_allclose(loss[1], 1.5, atol=1e-6)    # linear zone
+    np.testing.assert_allclose(loss[2], 1.5, atol=1e-6)

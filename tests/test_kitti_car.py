@@ -1,0 +1,242 @@
+"""KITTI-format car pipeline: label/calib parsing, file-based scene input
+over the native yielder, and the e2e fixture test (train -> decode with
+oriented NMS -> mAP + breakdown metrics). VERDICT r2 Next #4."""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu import model_registry
+import lingvo_tpu.models.all_params  # noqa: F401
+from lingvo_tpu.models.car import breakdown_metric, kitti_input
+
+
+def _LabelLine(velo_box, cls="Car"):
+  """Builds a KITTI label line whose parsed bbox3d == velo_box [7]."""
+  x, y, z, l, w, h, phi = [float(v) for v in velo_box]
+  rot_y = -(phi + math.pi / 2.0)
+  # nominal velo->cam: cam_x = -velo_y, cam_y = -velo_z, cam_z = velo_x
+  zb = z - h / 2.0  # KITTI location is at the box bottom
+  cam = (-y, -zb, x)
+  return (f"{cls} 0.00 0 0.0 0 0 50 50 "
+          f"{h:.3f} {w:.3f} {l:.3f} {cam[0]:.3f} {cam[1]:.3f} {cam[2]:.3f} "
+          f"{rot_y:.4f}")
+
+
+class TestLabelParsing:
+
+  def test_parse_valid_line(self):
+    obj = kitti_input.ParseKittiLabelLine(
+        "Car 0.00 0 -1.58 587.01 173.33 614.12 200.12 "
+        "1.65 1.67 3.64 -0.65 1.71 46.70 -1.59")
+    assert obj["type"] == "Car"
+    assert obj["dimensions"] == [1.65, 1.67, 3.64]
+    assert obj["location"] == [-0.65, 1.71, 46.70]
+    assert obj["score"] == -1
+
+  def test_invalid_type_and_token_count_raise(self):
+    with pytest.raises(ValueError, match="invalid type"):
+      kitti_input.ParseKittiLabelLine(
+          "Robot 0 0 0 0 0 0 0 1 1 1 0 0 0 0")
+    with pytest.raises(ValueError, match="tokens"):
+      kitti_input.ParseKittiLabelLine("Car 1 2 3")
+
+  def test_box_conversion_round_trip(self):
+    box = np.array([10.0, 3.0, 0.5, 4.0, 1.6, 1.5, 0.4], np.float32)
+    obj = kitti_input.ParseKittiLabelLine(_LabelLine(box))
+    got = kitti_input.KittiObjectToBBox3D(obj)
+    np.testing.assert_allclose(got[:6], box[:6], atol=1e-3)
+    assert abs(math.sin(got[6] - box[6])) < 1e-3
+
+  def test_no_3d_info_returns_none(self):
+    obj = kitti_input.ParseKittiLabelLine(
+        "DontCare -1 -1 -10 0 0 50 50 -1 -1 -1 -1000 -1000 -1000 -10")
+    assert kitti_input.KittiObjectToBBox3D(obj) is None
+
+  def test_calib_matrices_invert(self):
+    calib = {
+        "R0_rect": [0.9999, 0.01, 0, -0.01, 0.9999, 0, 0, 0, 1.0],
+        "Tr_velo_to_cam": [0, -1, 0, -0.02, 0, 0, -1, -0.06, 1, 0, 0, -0.4],
+    }
+    v2c = kitti_input.VeloToCameraTransformation(calib)
+    c2v = kitti_input.CameraToVeloTransformation(calib)
+    np.testing.assert_allclose(v2c @ c2v, np.eye(4), atol=1e-6)
+
+
+def _WriteScenes(path, num_scenes=8, seed=7):
+  """JSONL fixture: boxes inside the tiny model's [0, 16) grid with
+  class-colored points inside each box."""
+  rng = np.random.RandomState(seed)
+  with open(path, "w") as f:
+    for _ in range(num_scenes):
+      labels, pts = [], []
+      for _ in range(3):
+        cx, cy = rng.uniform(2, 14, 2)
+        cz = rng.uniform(-0.5, 0.5)
+        l, w, h = rng.uniform(0.8, 2.0, 3)
+        phi = rng.uniform(-math.pi, math.pi)
+        cls = rng.choice(["Car", "Pedestrian"])
+        labels.append(_LabelLine([cx, cy, cz, l, w, h, phi], cls))
+        cls_id = kitti_input.CLASS_IDS[cls]
+        for _ in range(12):
+          pts.append([cx + rng.uniform(-l / 2, l / 2),
+                      cy + rng.uniform(-w / 2, w / 2),
+                      cz + rng.uniform(-h / 2, h / 2), float(cls_id)])
+      f.write(json.dumps({"points": pts, "labels": labels}) + "\n")
+
+
+class TestKittiSceneInput:
+
+  def test_process_record_shapes_and_boxes(self, tmp_path):
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=2, max_points=64, max_objects=4)
+    gen = p.Instantiate()
+    box = [5.0, 6.0, 0.0, 2.0, 1.0, 1.0, 0.3]
+    rec = json.dumps({
+        "points": [[5.0, 6.0, 0.0, 1.0]] * 3,
+        "labels": [_LabelLine(box), _LabelLine(box, "DontCare")],
+    }).encode()
+    ex = gen.ProcessRecord(rec)
+    assert ex.lasers.shape == (64, 4)
+    assert ex.gt_boxes.shape == (4, 7)
+    np.testing.assert_allclose(ex.gt_boxes[0][:6], box[:6], atol=1e-3)
+    assert ex.gt_classes[0] == 1 and ex.gt_classes[1] == 0  # DontCare drop
+    assert (ex.laser_paddings == 0).sum() == 3
+    assert ex.reg_weights.sum() == 1.0  # one grid cell carries the target
+
+  def test_batches_from_file(self, tmp_path):
+    path = str(tmp_path / "scenes.jsonl")
+    _WriteScenes(path, num_scenes=6)
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=2, max_points=64, max_objects=4,
+        file_pattern=f"text:{path}")
+    gen = p.Instantiate()
+    batch = gen.GetPreprocessedInputBatch()
+    assert batch.lasers.shape == (2, 64, 4)
+    assert batch.gt_boxes.shape == (2, 4, 7)
+    assert (np.asarray(batch.gt_classes) > 0).any()
+
+
+class TestKittiInputHardening:
+
+  def test_batch_size_propagates_to_batcher(self, tmp_path):
+    path = str(tmp_path / "scenes.jsonl")
+    _WriteScenes(path, num_scenes=8)
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=4, max_points=32, max_objects=4,
+        file_pattern=f"text:{path}")
+    gen = p.Instantiate()
+    batch = gen.GetPreprocessedInputBatch()
+    assert batch.lasers.shape[0] == 4  # not the bucket default
+
+  def test_malformed_label_line_drops_record(self):
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=2)
+    gen = p.Instantiate()
+    bad = json.dumps({"points": [], "labels": ["Car 1 2 3"]}).encode()
+    assert gen.ProcessRecord(bad) is None
+    assert gen.ProcessRecord(b"not json") is None
+
+  def test_real_kitti_grid_ranges(self):
+    # negative-y boxes land in the grid when ranges cover them
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=2, grid_size=8,
+        grid_range_x=(0.0, 70.4), grid_range_y=(-40.0, 40.0))
+    gen = p.Instantiate()
+    box = [35.0, -20.0, 0.0, 4.0, 1.6, 1.5, 0.0]
+    rec = json.dumps({"points": [[35.0, -20.0, 0.0, 1.0]],
+                      "labels": [_LabelLine(box)]}).encode()
+    ex = gen.ProcessRecord(rec)
+    assert ex.reg_weights.sum() == 1.0
+    cell = int(np.argmax(ex.reg_weights))
+    row, col = cell // 8, cell % 8
+    assert row == int((-20.0 + 40) / 80 * 8) and col == int(35.0 / 70.4 * 8)
+
+  def test_num_classes_filters_types(self):
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=2, num_classes=1)  # Car only
+    gen = p.Instantiate()
+    box = [5.0, 6.0, 0.0, 2.0, 1.0, 1.0, 0.3]
+    rec = json.dumps({"points": [],
+                      "labels": [_LabelLine(box, "Car"),
+                                 _LabelLine(box, "Pedestrian")]}).encode()
+    ex = gen.ProcessRecord(rec)
+    assert (np.asarray(ex.gt_classes) > 0).sum() == 1
+
+
+class TestKittiE2E:
+
+  def test_train_decode_map_with_nms(self, tmp_path):
+    """KITTI fixture end to end: file input -> StarNet train -> oriented-NMS
+    decode -> AP + distance-breakdown AP."""
+    path = str(tmp_path / "scenes.jsonl")
+    _WriteScenes(path, num_scenes=8)
+
+    mp = model_registry.GetParams("car.kitti.StarNetCarTiny", "Train")
+    mp.task.num_classes = 3
+    mp.task.use_oriented_nms = True
+    mp.task.max_detections = 4
+    mp.input = kitti_input.KittiSceneInputGenerator.Params().Set(
+        name="kitti", batch_size=2, max_points=64, max_objects=4,
+        num_classes=3, file_pattern=f"text:{path}")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+
+    step = jax.jit(task.TrainStep, donate_argnums=(0,))
+    losses = []
+    for _ in range(6):
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      state, out = step(state, batch)
+      losses.append(float(out.metrics.loss[0]))
+    assert np.isfinite(losses).all()
+
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    dec = jax.jit(task.Decode)(state.theta, batch)
+    assert dec.boxes.shape[-1] == 7
+    metrics = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(dec, metrics)
+    res = task.DecodeFinalize(metrics)
+    assert 0.0 <= res["ap"] <= 1.0
+
+    # breakdown AP by distance over the same decode output
+    bd = breakdown_metric.ByDistance(max_distance=20.0, num_bins=2)
+    boxes = np.asarray(dec.boxes)
+    scores = np.asarray(dec.scores)
+    classes = np.asarray(dec.classes)
+    gtb = np.asarray(dec.gt_boxes)
+    gtc = np.asarray(dec.gt_classes)
+    for i in range(boxes.shape[0]):
+      valid = scores[i] > 0
+      gt_mask = gtc[i] > 0
+      bd.Update(boxes[i][valid], scores[i][valid], gtb[i][gt_mask],
+                pred_classes=classes[i][valid], gt_classes=gtc[i][gt_mask])
+    vals = bd.value
+    assert set(vals) == {"dist_0_10", "dist_10_20"}
+    assert all(0.0 <= v <= 1.0 for v in vals.values())
+
+
+class TestBreakdownMetrics:
+
+  def test_by_rotation_bins(self):
+    m = breakdown_metric.ByRotation(num_bins=2)
+    gt = np.array([[0, 0, 0, 2, 2, 2, 0.1],       # bin 0
+                   [5, 5, 0, 2, 2, 2, 2.0]])      # bin 1
+    pred = gt.copy()
+    m.Update(pred, np.array([0.9, 0.8]), gt,
+             pred_classes=np.array([1, 1]), gt_classes=np.array([1, 1]))
+    vals = m.value
+    assert vals["rot_0_of_2"] == 1.0 and vals["rot_1_of_2"] == 1.0
+
+  def test_count_points_in_boxes(self):
+    pts = np.array([[0, 0, 0], [0.4, 0.4, 0], [5, 5, 5]])
+    boxes = np.array([[0, 0, 0, 1.0, 1.0, 1.0, 0.0]])
+    counts = breakdown_metric.CountPointsInBoxes(pts, boxes)
+    assert counts[0] == 2
