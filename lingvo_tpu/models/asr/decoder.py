@@ -43,6 +43,9 @@ class LasDecoder(base_layer.BaseLayer):
     p.Define("target_eos_id", 2, "EOS.")
     p.Define("beam_search", beam_search_lib.BeamSearchHelper.Params().Set(
         num_hyps_per_beam=8, coverage_penalty=0.2), "Beam search.")
+    p.Define("fusion", None,
+             "Optional LM fusion params (models/asr/fusion.py, ref "
+             "tasks/asr/fusion.py); applied at beam-search decode only.")
     return p
 
   def __init__(self, params):
@@ -68,6 +71,8 @@ class LasDecoder(base_layer.BaseLayer):
         layers_lib.ProjectionLayer.Params().Set(
             input_dim=p.rnn_cell_dim + p.source_dim,
             output_dim=p.vocab_size))
+    if p.fusion is not None:
+      self.CreateChild("fusion", p.fusion)
 
   # -- per-step core ---------------------------------------------------------
   def _InitStates(self, theta, batch_size: int, src_len: int) -> NestedMap:
@@ -142,10 +147,19 @@ class LasDecoder(base_layer.BaseLayer):
         self.ChildTheta(theta, "atten"), encoded, enc_paddings)
     packed = jax.tree_util.tree_map(_Tile, packed)
     init = self._InitStates(theta, b * k, src_len)
+    if p.fusion is not None:
+      # LM state lives in the beam states so parent-gathering keeps each
+      # hypothesis's LM context aligned with its token history
+      init.fusion = self.fusion.InitState(
+          self.ChildTheta(theta, "fusion"), b * k)
 
     def _StepFn(states, ids):
       logits, probs, new_states = self._Step(theta, packed, ids[:, 0],
                                              states)
+      if p.fusion is not None:
+        logits, new_states.fusion = self.fusion.FuseLogits(
+            self.ChildTheta(theta, "fusion"), states.fusion, ids[:, 0],
+            logits)
       return logits, new_states, probs
 
     return helper.Search(b, init, _StepFn, src_len=src_len,

@@ -1,0 +1,124 @@
+"""LM fusion for ASR decoding (ref `lingvo/tasks/asr/fusion.py`
+FusionBase:23 / NullFusion:173).
+
+Shallow fusion combines the acoustic model's per-step distribution with an
+external language model's at DECODE time only:
+  log p(y_t) = log p_am(y_t) + lm_weight * log p_lm(y_t)
+The LM state rides inside the decoder's beam-search state pytree, so beam
+reordering (`beam_search._GatherBeams`) keeps each hypothesis's LM context
+consistent — the TPU-native equivalent of the reference's fused
+PreBeamSearchStepCallback.
+
+Any layer exposing `FusionInit(theta, batch) -> state` and
+`FusionStep(theta, state, prev_ids) -> (logits, state)` can serve as the
+LM; `RnnLmForFusion` is the built-in one (embedding + LSTM stack + proj).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import rnn_cell
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class RnnLmForFusion(base_layer.BaseLayer):
+  """Step-oriented RNN LM: per-token scoring with carried LSTM state."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 0, "Vocab (must match the AM's).")
+    p.Define("emb_dim", 64, "Embedding dim.")
+    p.Define("rnn_dim", 128, "LSTM hidden dim.")
+    p.Define("num_layers", 1, "LSTM stack depth.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.vocab_size > 0
+    self.CreateChild(
+        "emb",
+        layers_lib.SimpleEmbeddingLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.emb_dim))
+    cells = []
+    for i in range(p.num_layers):
+      cells.append(rnn_cell.LSTMCellSimple.Params().Set(
+          num_input_nodes=p.emb_dim if i == 0 else p.rnn_dim,
+          num_output_nodes=p.rnn_dim))
+    self.CreateChildren("rnn", cells)
+    self.CreateChild(
+        "proj",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.rnn_dim, output_dim=p.vocab_size))
+
+  def FusionInit(self, theta, batch_size: int) -> NestedMap:
+    del theta
+    return NestedMap(rnn=[c.InitState(batch_size) for c in self.rnn])
+
+  def FusionStep(self, theta, state, prev_ids):
+    """prev_ids [B] -> (logits [B, V], new state)."""
+    x = self.emb.EmbLookup(self.ChildTheta(theta, "emb"),
+                           prev_ids[:, None])[:, 0]
+    new_rnn = []
+    for i, cell in enumerate(self.rnn):
+      st = cell.FProp(theta.rnn[i], state.rnn[i], x)
+      new_rnn.append(st)
+      x = cell.GetOutput(st)
+    logits = self.proj.FProp(theta.proj, x)
+    return logits, NestedMap(rnn=new_rnn)
+
+
+class FusionBase(base_layer.BaseLayer):
+  """Fusion interface (ref FusionBase:23)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("lm", None, "LM layer params (FusionInit/FusionStep surface).")
+    p.Define("lm_weight", 0.3, "LM interpolation weight at decode.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    if self.p.lm is not None:
+      self.CreateChild("lm", self.p.lm)
+
+  def InitState(self, theta, batch_size: int) -> NestedMap:
+    return NestedMap()
+
+  def FuseLogits(self, theta, state, prev_ids, am_logits):
+    """-> (fused log-space scores [B, V], new fusion state)."""
+    raise NotImplementedError
+
+
+class NullFusion(FusionBase):
+  """No-op fusion (ref NullFusion:173): AM scores pass through."""
+
+  def FuseLogits(self, theta, state, prev_ids, am_logits):
+    del prev_ids
+    return am_logits, state
+
+
+class ShallowFusion(FusionBase):
+  """log p_am + w * log p_lm (decode-time only, the standard recipe)."""
+
+  def __init__(self, params):
+    super().__init__(params)
+    assert self.p.lm is not None, "ShallowFusion needs an lm template"
+
+  def InitState(self, theta, batch_size: int) -> NestedMap:
+    return NestedMap(
+        lm=self.lm.FusionInit(self.ChildTheta(theta, "lm"), batch_size))
+
+  def FuseLogits(self, theta, state, prev_ids, am_logits):
+    lm_logits, lm_state = self.lm.FusionStep(
+        self.ChildTheta(theta, "lm"), state.lm, prev_ids)
+    fused = (jax.nn.log_softmax(am_logits.astype(jnp.float32), -1) +
+             self.p.lm_weight *
+             jax.nn.log_softmax(lm_logits.astype(jnp.float32), -1))
+    return fused, NestedMap(lm=lm_state)

@@ -195,6 +195,96 @@ class RnntDecoder(base_layer.BaseLayer):
     return hyp, hyp_len
 
 
+  def BeamDecode(self, theta, enc, enc_paddings, max_symbols: int,
+                 beam_size: int = 4):
+    """Frame-asynchronous K-hypothesis transducer beam search (VERDICT r2
+    Next #5; ref ASR beam decoding work — the reference ships greedy plus
+    beam variants in `tasks/asr/decoder.py`).
+
+    Each hypothesis carries its own time cursor: a blank consumes a frame,
+    a label steps the prediction net; every global step expands all K
+    hypotheses over the vocab and keeps the top K by accumulated log-prob
+    (no prefix merging — ALSD-style). With beam_size=1 this reduces
+    exactly to GreedyDecode. Returns (hyp [B, max_symbols], hyp_len [B])
+    for the best-scoring hypothesis.
+    """
+    p = self.p
+    b, t_max, _ = enc.shape
+    k = beam_size
+    bk = b * k
+    neg_inf = -1.0e9
+    e = self.enc_proj.FProp(theta.enc_proj, enc)          # [B, T, J]
+    e_tiled = jnp.repeat(e, k, axis=0)                    # [B*K, T, J]
+    t_lens = jnp.repeat(
+        jnp.sum(1.0 - enc_paddings, axis=1).astype(jnp.int32), k)
+
+    def _GatherParents(x, parent):
+      shaped = x.reshape((b, k) + x.shape[1:])
+      idx = parent.reshape((b, k) + (1,) * (x.ndim - 1)).astype(jnp.int32)
+      return jnp.take_along_axis(shaped, idx, axis=1).reshape(x.shape)
+
+    def _Step(carry, _):
+      t_idx, pred_state, pred_out, hyp, hyp_len, score = carry
+      e_t = jnp.take_along_axis(
+          e_tiled, jnp.clip(t_idx, 0, t_max - 1)[:, None, None].repeat(
+              e_tiled.shape[-1], 2), axis=1)[:, 0]        # [B*K, J]
+      g = self.pred_proj.FProp(theta.pred_proj, pred_out)
+      logits = self.joint_out.FProp(theta.joint_out, jnp.tanh(e_t + g))
+      log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+      vocab = log_probs.shape[-1]
+      # exhausted hyps are frozen: blank continuation at zero cost
+      done = t_idx >= t_lens
+      frozen = jnp.full((vocab,), neg_inf).at[0].set(0.0)
+      log_probs = jnp.where(done[:, None], frozen[None, :], log_probs)
+
+      total = (score[:, None] + log_probs).reshape(b, k * vocab)
+      new_score, flat = jax.lax.top_k(total, k)           # [B, K]
+      parent = flat // vocab
+      token = (flat % vocab).astype(jnp.int32).reshape(bk)
+      new_score = new_score.reshape(bk)
+
+      t_idx = _GatherParents(t_idx, parent)
+      pred_state = jax.tree_util.tree_map(
+          lambda x: _GatherParents(x, parent), pred_state)
+      pred_out = _GatherParents(pred_out, parent)
+      hyp = _GatherParents(hyp, parent)
+      hyp_len = _GatherParents(hyp_len, parent)
+
+      is_blank = token == 0
+      emb = self.emb.EmbLookup(self.ChildTheta(theta, "emb"),
+                               token[:, None])[:, 0]
+      stepped = self.pred_cell.FProp(theta.pred_cell, pred_state, emb)
+
+      def _Sel(new, old):
+        m = is_blank.reshape((-1,) + (1,) * (new.ndim - 1)).astype(new.dtype)
+        return old * m + new * (1 - m)
+
+      pred_state = jax.tree_util.tree_map(_Sel, stepped, pred_state)
+      pred_out = _Sel(self.pred_cell.GetOutput(stepped), pred_out)
+      write = (~is_blank) & (hyp_len < hyp.shape[1])
+      hyp = jnp.where(
+          (jnp.arange(hyp.shape[1])[None] == hyp_len[:, None])
+          & write[:, None], token[:, None], hyp)
+      hyp_len = hyp_len + write.astype(jnp.int32)
+      t_idx = t_idx + is_blank.astype(jnp.int32)
+      return (t_idx, pred_state, pred_out, hyp, hyp_len, new_score), ()
+
+    # beam 0 live, others -inf so all start from one empty hypothesis
+    score0 = jnp.tile(jnp.asarray([0.0] + [neg_inf] * (k - 1)), (b,))
+    carry = (jnp.zeros((bk,), jnp.int32), self.pred_cell.InitState(bk),
+             jnp.zeros((bk, p.pred_dim), enc.dtype),
+             jnp.zeros((bk, max_symbols), jnp.int32),
+             jnp.zeros((bk,), jnp.int32), score0)
+    (t_idx, _, _, hyp, hyp_len, score), _ = jax.lax.scan(
+        _Step, carry, None, length=t_max + max_symbols)
+    best = jnp.argmax(score.reshape(b, k), axis=1)        # [B]
+    hyp = jnp.take_along_axis(
+        hyp.reshape(b, k, max_symbols), best[:, None, None], axis=1)[:, 0]
+    hyp_len = jnp.take_along_axis(
+        hyp_len.reshape(b, k), best[:, None], axis=1)[:, 0]
+    return hyp, hyp_len
+
+
 class RnntAsrModel(model_lib._AsrTaskBase):
   """Conformer encoder + RNN-T decoder (shares _AsrTaskBase's encoder
   wiring and WER decode metrics).
@@ -207,7 +297,10 @@ class RnntAsrModel(model_lib._AsrTaskBase):
   def Params(cls):
     p = super().Params()
     p.Define("decoder", RnntDecoder.Params(), "RNN-T decoder.")
-    p.Define("max_decode_symbols", 32, "Greedy decode label budget.")
+    p.Define("max_decode_symbols", 32, "Decode label budget.")
+    p.Define("decode_beam_size", 1,
+             "1 = frame-synchronous greedy; >1 = transducer beam search "
+             "(RnntDecoder.BeamDecode).")
     return p
 
   def __init__(self, params):
@@ -234,9 +327,14 @@ class RnntAsrModel(model_lib._AsrTaskBase):
 
   def Decode(self, theta, input_batch):
     enc, enc_pad = self._Encode(theta, input_batch)
-    hyp, hyp_len = self.decoder.GreedyDecode(
-        self.ChildTheta(theta, "decoder"), enc, enc_pad,
-        self.p.max_decode_symbols)
+    if self.p.decode_beam_size > 1:
+      hyp, hyp_len = self.decoder.BeamDecode(
+          self.ChildTheta(theta, "decoder"), enc, enc_pad,
+          self.p.max_decode_symbols, beam_size=self.p.decode_beam_size)
+    else:
+      hyp, hyp_len = self.decoder.GreedyDecode(
+          self.ChildTheta(theta, "decoder"), enc, enc_pad,
+          self.p.max_decode_symbols)
     return NestedMap(hyp_ids=hyp, hyp_lens=hyp_len,
                      target_ids=input_batch.tgt.ids,
                      target_paddings=input_batch.tgt.paddings)
