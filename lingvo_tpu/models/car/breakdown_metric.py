@@ -4,10 +4,10 @@ ByNumPoints:471).
 
 Each breakdown partitions boxes into bins (distance from the sensor, box
 rotation, points inside the box) and reports a per-bin AP: ground truths
-are binned by their own attribute and predictions by theirs (the
-reference's convention — both sides of the match are sliced the same way,
-so a perfect detector scores 1.0 in every populated bin). Host-side numpy
-like ap_metric.
+are binned by their own attribute; predictions by theirs when they carry
+it (distance, rotation) and by their max-IoU matched gt's attribute when
+only gt boxes have it (num points) — so a perfect detector scores 1.0 in
+every populated bin. Host-side numpy like ap_metric.
 """
 
 from __future__ import annotations
@@ -22,24 +22,53 @@ from lingvo_tpu.models.car import ap_metric
 class BreakdownApMetric:
   """AP per bin of a ground-truth attribute."""
 
-  def __init__(self, bin_edges, bin_of_gt, iou_threshold: float = 0.5):
+  def __init__(self, bin_edges, bin_of_gt, iou_threshold: float = 0.5,
+               bin_preds_by_matched_gt: bool = False):
     """bin_edges: labels only (len = num bins); bin_of_gt(gt_box [7]) ->
-    bin index in [0, num_bins) or -1 to exclude."""
+    bin index in [0, num_bins) or -1 to exclude.
+
+    bin_preds_by_matched_gt: bin each prediction by the attribute of the
+    gt box it overlaps most (BEV IoU), not by its own attribute — required
+    when the attribute only exists on gt boxes (e.g. point counts, ref
+    breakdown_metric.ByNumPoints:471). Unmatched predictions (no
+    overlapping gt) are pure false positives and count against every bin,
+    matching the KITTI slicing convention.
+    """
     self._labels = list(bin_edges)
     self._bin_of_gt = bin_of_gt
+    self._bin_preds_by_matched_gt = bin_preds_by_matched_gt
     self._metrics = [ap_metric.ApMetric(iou_threshold)
                      for _ in self._labels]
+
+  def _MatchedGtBins(self, pred_boxes, gt_boxes, gt_bins):
+    """Bin index of the max-IoU gt for each prediction (-1 if none)."""
+    bins = np.full((len(pred_boxes),), -1, np.int64)
+    for i, pb in enumerate(pred_boxes):
+      best_iou, best_j = 0.0, -1
+      for j, gb in enumerate(gt_boxes):
+        iou = ap_metric.RotatedIou(np.asarray(pb)[:7], np.asarray(gb)[:7])
+        if iou > best_iou:
+          best_iou, best_j = iou, j
+      if best_j >= 0:
+        bins[i] = gt_bins[best_j]
+    return bins
 
   def Update(self, pred_boxes, pred_scores, gt_boxes,
              pred_classes=None, gt_classes=None):
     gt_bins = np.array([self._bin_of_gt(g) for g in gt_boxes], np.int64) \
         if len(gt_boxes) else np.zeros((0,), np.int64)
-    pred_bins = np.array([self._bin_of_gt(g) for g in pred_boxes],
-                         np.int64) if len(pred_boxes) else np.zeros(
-                             (0,), np.int64)
+    if not len(pred_boxes):
+      pred_bins = np.zeros((0,), np.int64)
+    elif self._bin_preds_by_matched_gt:
+      pred_bins = self._MatchedGtBins(pred_boxes, gt_boxes, gt_bins)
+    else:
+      pred_bins = np.array([self._bin_of_gt(g) for g in pred_boxes],
+                           np.int64)
     for b, metric in enumerate(self._metrics):
       sel = gt_bins == b
       psel = pred_bins == b
+      if self._bin_preds_by_matched_gt:
+        psel = psel | (pred_bins == -1)  # pure FPs penalize every bin
       metric.Update(
           pred_boxes[psel], pred_scores[psel], gt_boxes[sel],
           pred_classes=(pred_classes[psel] if pred_classes is not None
@@ -84,7 +113,8 @@ def ByNumPoints(edges=(1, 50, 200, 100000),
                 iou_threshold: float = 0.5):
   """AP binned by the number of laser points inside the gt box
   (ref ByNumPoints:471). The caller must annotate gt boxes with a point
-  count in column 7 (i.e. pass [..., 8] boxes: 7-DOF + count)."""
+  count in column 7 (i.e. pass [..., 8] boxes: 7-DOF + count); predictions
+  are 7-DOF and are binned by their max-IoU matched gt's count."""
   labels = [f"pts_lt_{e}" for e in edges]
 
   def _Bin(gt):
@@ -94,7 +124,8 @@ def ByNumPoints(edges=(1, 50, 200, 100000),
         return i
     return len(edges) - 1
 
-  return BreakdownApMetric(labels, _Bin, iou_threshold)
+  return BreakdownApMetric(labels, _Bin, iou_threshold,
+                           bin_preds_by_matched_gt=True)
 
 
 def CountPointsInBoxes(points: np.ndarray, boxes: np.ndarray) -> np.ndarray:

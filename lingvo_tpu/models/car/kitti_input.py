@@ -133,14 +133,16 @@ class KittiSceneInputGenerator(
     self._record_counter += 1
     try:
       scene = json.loads(record.decode("utf-8"))
+      if not isinstance(scene, dict):
+        return None
       labels = [ParseKittiLabelLine(line)
                 for line in scene.get("labels", [])]
-    except (UnicodeDecodeError, json.JSONDecodeError, ValueError):
-      return None  # malformed record: drop, never kill the pipeline
-    pts = np.asarray(scene.get("points", []), np.float32).reshape(-1, 4)
-    cam_to_velo = None
-    if scene.get("calib"):
-      cam_to_velo = CameraToVeloTransformation(scene["calib"])
+      pts = np.asarray(scene.get("points", []), np.float32).reshape(-1, 4)
+      cam_to_velo = None
+      if scene.get("calib"):
+        cam_to_velo = CameraToVeloTransformation(scene["calib"])
+    except (UnicodeDecodeError, json.JSONDecodeError, ValueError, TypeError):
+      return None  # malformed record/geometry: drop, never kill the pipeline
     boxes, classes = [], []
     for obj in labels:
       cls_id = CLASS_IDS.get(obj["type"], 0)

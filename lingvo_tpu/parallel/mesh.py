@@ -147,11 +147,15 @@ def TrainStateShardings(mesh: Mesh, task, state: NestedMap,
     if not fsdp_size or fsdp_size == 1:
       return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
-    for i, (entry, dim) in enumerate(zip(entries, shape)):
-      names = entry if isinstance(entry, tuple) else (
+
+    def _Names(entry):
+      return entry if isinstance(entry, tuple) else (
           (entry,) if entry is not None else ())
-      if fsdp_axis in names:
-        return spec  # already sharded over it
+
+    if any(fsdp_axis in _Names(e) for e in entries):
+      return spec  # already sharded over it (on any dim)
+    for i, (entry, dim) in enumerate(zip(entries, shape)):
+      names = _Names(entry)
       taken = int(np.prod([mesh.shape[nm] for nm in names])) if names else 1
       if dim % (taken * fsdp_size) == 0:
         new = tuple(names) + (fsdp_axis,)

@@ -106,7 +106,14 @@ class GShardDecode:
     prompts = np.asarray(prompts)
     out = np.zeros_like(prompts)
     p = prompts.shape[1]
-    for i, ln in enumerate(np.asarray(prompt_lens)):
+    lens = np.asarray(prompt_lens)
+    if lens.shape[0] != prompts.shape[0] or (lens < 0).any() or (
+        lens > p).any():
+      rng = f"[{lens.min()}, {lens.max()}]" if lens.size else "[]"
+      raise ValueError(
+          f"prompt_lens must be [batch={prompts.shape[0]}] with values in "
+          f"[0, {p}]; got shape {lens.shape}, values in {rng}")
+    for i, ln in enumerate(lens):
       ln = int(ln)
       out[i, p - ln:] = prompts[i, :ln]
     return out

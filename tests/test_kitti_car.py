@@ -240,3 +240,15 @@ class TestBreakdownMetrics:
     boxes = np.array([[0, 0, 0, 1.0, 1.0, 1.0, 0.0]])
     counts = breakdown_metric.CountPointsInBoxes(pts, boxes)
     assert counts[0] == 2
+
+  def test_by_num_points_bins_preds_by_matched_gt(self):
+    # 7-DOF predictions (no count column) must land in the bin of the gt
+    # they overlap, so a perfect detector scores 1.0 in every populated bin.
+    m = breakdown_metric.ByNumPoints(edges=(10, 100))
+    gt = np.array([[0, 0, 0, 2, 2, 2, 0.0, 5.0],     # 5 pts -> bin 0
+                   [20, 20, 0, 2, 2, 2, 0.0, 50.0]])  # 50 pts -> bin 1
+    pred = gt[:, :7].copy()
+    m.Update(pred, np.array([0.9, 0.8]), gt,
+             pred_classes=np.array([1, 1]), gt_classes=np.array([1, 1]))
+    vals = m.value
+    assert vals["pts_lt_10"] == 1.0 and vals["pts_lt_100"] == 1.0
