@@ -106,6 +106,14 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     p.Define("rel_pos_emb_dim", 0,
              "If >0, learned relative position bias buckets (T5-style).")
     p.Define("rel_pos_max_distance", 128, "Relative bucket clip distance.")
+    p.Define("qdomain_weight", None,
+             "QDomain params for the q/k/v/post projection weights (ref "
+             "batch_major_attention.py:303 TrackQWeight).")
+    p.Define("qdomain_softmax", None,
+             "QDomain for post-softmax attention probs (ref attention.py:440 "
+             "qsoftmax; natural range [0,1] — FixedRangeQDomain(0,1) is the "
+             "scan-safe choice). Disables the flash-kernel path: the fused "
+             "kernel never materializes probs.")
     return p
 
   def __init__(self, params):
@@ -148,19 +156,38 @@ class MultiHeadedAttention(base_layer.BaseLayer):
                        WeightInit.Constant(0.0), p.dtype))
     self.CreateChild("atten_dropout",
                      layers_lib.DeterministicDropoutLayer.Params())
+    if p.qdomain_weight is not None:
+      self.CreateChild("qdomain_weight", p.qdomain_weight.Copy())
+    if p.qdomain_softmax is not None:
+      self.CreateChild("qdomain_softmax", p.qdomain_softmax.Copy())
 
   # -- projections -----------------------------------------------------------
 
+  def _QProjWeight(self, theta, w):
+    if self.p.qdomain_weight is None:
+      return w
+    return self.qdomain_weight.QuantizeWeight(
+        self.ChildTheta(theta, "qdomain_weight"), w)
+
+  def _QProbs(self, theta, probs):
+    """Fake-quantize post-softmax probs (all softmax sites route here)."""
+    if self.p.qdomain_softmax is None:
+      return probs
+    return self.qdomain_softmax.QuantizeAct(
+        self.ChildTheta(theta, "qdomain_softmax"), "softmax", probs)
+
   def _HeadsProj(self, theta, name, x):
     th = self.CastTheta(theta)
-    out = jnp.einsum("BTD,DNH->BTNH", self.ToFPropDtype(x), th[f"w_{name}"])
+    out = jnp.einsum("BTD,DNH->BTNH", self.ToFPropDtype(x),
+                     self._QProjWeight(theta, th[f"w_{name}"]))
     if self.p.use_bias:
       out = out + th[f"b_{name}"]
     return out
 
   def _PostProj(self, theta, ctx):
     th = self.CastTheta(theta)
-    out = jnp.einsum("BTNH,DNH->BTD", ctx, th.w_post)
+    out = jnp.einsum("BTNH,DNH->BTD", ctx,
+                     self._QProjWeight(theta, th.w_post))
     if self.p.use_bias:
       out = out + th.b_post
     return out
@@ -196,7 +223,8 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     # Stacked masks can sum below f32 min (-inf -> NaN softmax rows on fully
     # masked queries); clamp keeps rows finite, padding zeroes them later.
     logits = jnp.maximum(logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = self._QProbs(theta, jax.nn.softmax(logits, axis=-1).astype(
+        q.dtype))
     if p.atten_dropout_prob > 0:
       probs = self.atten_dropout.FProp(
           self.ChildTheta(theta, "atten_dropout"), probs,
@@ -213,7 +241,8 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     if not (p.use_flash_attention and key_vec is None
             and atten_mask is None and
             p.rel_pos_emb_dim == 0 and p.atten_logit_cap == 0 and
-            p.atten_dropout_prob == 0 and t % 16 == 0):
+            p.atten_dropout_prob == 0 and p.qdomain_softmax is None and
+            t % 16 == 0):
       return False
     if jax.default_backend() == "tpu":
       from lingvo_tpu.ops import flash_attention
@@ -494,7 +523,8 @@ class LocalSelfAttention(MultiHeadedAttention):
       logits = jnp.where(same[:, :, None, :, :], logits, _NEG_INF)
     logits = jnp.maximum(logits, _NEG_INF)
 
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = self._QProbs(theta, jax.nn.softmax(logits, axis=-1).astype(
+        q.dtype))
     if p.atten_dropout_prob > 0:
       probs = self.atten_dropout.FProp(
           self.ChildTheta(theta, "atten_dropout"), probs,
@@ -562,7 +592,7 @@ class ChunkwiseSelfAttention(MultiHeadedAttention):
       same = seg_c[:, :, :, None] == seg_c[:, :, None, :]     # [B,L,Q,K]
       logits = jnp.where(same[:, :, None, :, :], logits, _NEG_INF)
     logits = jnp.maximum(logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+    probs = self._QProbs(theta, jax.nn.softmax(logits, -1).astype(q.dtype))
     ctx = jnp.einsum("BLNQK,BLKNH->BLQNH", probs, vc)
     ctx = ctx.reshape(b, num_chunks * c, n, h)[:, :t]
     out = self._PostProj(theta, ctx)

@@ -107,7 +107,8 @@ class TransformerXLAttention(attention_lib.MultiHeadedAttention,
     if mask is not None:
       logits = logits + mask.astype(jnp.float32)
     logits = jnp.maximum(logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = self._QProbs(theta, jax.nn.softmax(logits, axis=-1).astype(
+        q.dtype))
     if p.atten_dropout_prob > 0:
       probs = self.atten_dropout.FProp(
           self.ChildTheta(theta, "atten_dropout"), probs,
@@ -310,7 +311,7 @@ class RoutingAttention(attention_lib.MultiHeadedAttention):
     logits = jnp.where(q_assign[..., None] > 0, logits, _NEG_INF)
     logits = jnp.maximum(logits.astype(jnp.float32), _NEG_INF)
     flat = logits.reshape(b, t, p.num_heads, c * w)
-    probs = jax.nn.softmax(flat, axis=-1).astype(q.dtype)
+    probs = self._QProbs(theta, jax.nn.softmax(flat, axis=-1).astype(q.dtype))
     # a query whose cluster has no visible key has a fully-masked row:
     # softmax would go uniform and leak — zero masked slots outright
     probs = probs * (flat > 0.5 * _NEG_INF).astype(probs.dtype)

@@ -14,14 +14,17 @@ from lingvo_tpu.core import attention as attention_lib
 from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import layers as layers_lib
 from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import quant_utils
 from lingvo_tpu.core import transformer as transformer_lib
 from lingvo_tpu.core.nested_map import NestedMap
 from lingvo_tpu.core.py_utils import WeightInit, WeightParams
 
 
-class LConvLayer(base_layer.BaseLayer):
+class LConvLayer(quant_utils.QuantizableLayer):
   """Lightweight conv block: LN -> pw-GLU -> dw-conv -> norm -> swish -> pw
-  (ref LConvLayer:35)."""
+  (ref LConvLayer:35). The inherited `qdomain` param fake-quantizes the
+  pointwise + depthwise conv weights (ref batch_major_attention.py:9016-9097
+  conv_qdomain/linear_qdomain threading into the conformer builder)."""
 
   @classmethod
   def Params(cls):
@@ -46,12 +49,13 @@ class LConvLayer(base_layer.BaseLayer):
     else:
       self.CreateChild("norm", layers_lib.LayerNorm.Params().Set(input_dim=d))
     self.CreateVariable("pw_out", WeightParams((d, d), p.params_init, p.dtype))
+    self._CreateQDomain()
 
   def FProp(self, theta, inputs, paddings=None):
     p = self.p
     th = self.CastTheta(theta)
     x = self.ln.FProp(theta.ln, inputs)
-    gated = jnp.einsum("btd,de->bte", x, th.pw_in)
+    gated = jnp.einsum("btd,de->bte", x, self.QWeight(theta, th.pw_in))
     a, b = jnp.split(gated, 2, axis=-1)
     x = a * jax.nn.sigmoid(b)  # GLU
     if paddings is not None:
@@ -64,7 +68,7 @@ class LConvLayer(base_layer.BaseLayer):
       pad = [(0, 0), ((k - 1) // 2, k // 2), (0, 0)]
     xp = jnp.pad(x, pad)
     x = jax.lax.conv_general_dilated(
-        xp, th.dw[:, None, :],  # [k, 1, d] HIO-ish
+        xp, self.QWeight(theta, th.dw)[:, None, :],  # [k, 1, d] HIO-ish
         window_strides=(1,),
         padding="VALID",
         feature_group_count=p.input_dim,
@@ -74,7 +78,7 @@ class LConvLayer(base_layer.BaseLayer):
     else:
       x = self.norm.FProp(theta.norm, x)
     x = jax.nn.silu(x)
-    x = jnp.einsum("btd,de->bte", x, th.pw_out)
+    x = jnp.einsum("btd,de->bte", x, self.QWeight(theta, th.pw_out))
     if paddings is not None:
       x = py_utils.ApplyPadding(paddings, x)
     return inputs + x
@@ -96,7 +100,7 @@ class LConvLayer(base_layer.BaseLayer):
     p = self.p
     th = self.CastTheta(theta)
     x = self.ln.FProp(theta.ln, inputs)
-    gated = jnp.einsum("btd,de->bte", x, th.pw_in)
+    gated = jnp.einsum("btd,de->bte", x, self.QWeight(theta, th.pw_in))
     a, b_ = jnp.split(gated, 2, axis=-1)
     x = a * jax.nn.sigmoid(b_)  # GLU
     if paddings is not None:
@@ -104,12 +108,12 @@ class LConvLayer(base_layer.BaseLayer):
     xc = jnp.concatenate(
         [cached_states.conv_input.astype(x.dtype), x], axis=1)
     y = jax.lax.conv_general_dilated(
-        xc, th.dw[:, None, :], window_strides=(1,), padding="VALID",
-        feature_group_count=p.input_dim,
+        xc, self.QWeight(theta, th.dw)[:, None, :], window_strides=(1,),
+        padding="VALID", feature_group_count=p.input_dim,
         dimension_numbers=("NHC", "HIO", "NHC"))
     y = self.norm.FProp(theta.norm, y)
     y = jax.nn.silu(y)
-    y = jnp.einsum("btd,de->bte", y, th.pw_out)
+    y = jnp.einsum("btd,de->bte", y, self.QWeight(theta, th.pw_out))
     if paddings is not None:
       y = py_utils.ApplyPadding(paddings, y)
     c = inputs.shape[1]

@@ -161,6 +161,44 @@ class PassiveAsymQDomain(QDomain):
                          zero_point.astype(x.dtype), p.bits)
 
 
+class FixedRangeQDomain(QDomain):
+  """Stateless fake quant over a fixed activation range (ref the reference's
+  natural-range handling, e.g. `fns.qsoftmax` quantizing post-softmax probs
+  over [0, 1]).
+
+  The right domain wherever the range is known a priori — softmax probs
+  [0, 1], tanh/cell states [-cap, cap] — and the ONLY kind (besides
+  ScheduledClipQDomain) that is safe inside `lax.scan` bodies (RNN cells,
+  repeated transformer stacks): it carries no tracked range state, so
+  nothing has to escape the scan trace.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("range_min", -1.0, "Lower bound of the activation range.")
+    p.Define("range_max", 1.0, "Upper bound.")
+    return p
+
+  def QuantizeWeight(self, theta, w):
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (
+        2.0 ** (self.p.bits - 1) - 1)
+    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+
+  def QuantizeAct(self, theta, name: str, x):
+    p = self.p
+    lo, hi = float(p.range_min), float(p.range_max)
+    assert hi > lo, (lo, hi)
+    x = jnp.clip(x, lo, hi)
+    if lo == -hi:  # symmetric
+      scale = hi / (2.0 ** (p.bits - 1) - 1)
+      return FakeQuant(x, jnp.asarray(scale, x.dtype), p.bits)
+    scale = (hi - lo) / (2.0 ** p.bits - 1)
+    zero_point = round(-lo / scale)
+    return FakeQuantAsym(x, jnp.asarray(scale, x.dtype),
+                         jnp.asarray(zero_point, x.dtype), p.bits)
+
+
 class PerChannelSymmetricQDomain(SymmetricQDomain):
   """Symmetric fake quant with per-output-channel weight scales (the
   standard int8 deployment recipe; ref quant domains' per-channel option).

@@ -66,7 +66,15 @@ class RNNCell(base_layer.BaseLayer):
 
 class LSTMCellSimple(RNNCell):
   """Standard LSTM with forget bias, optional cell clipping + projection
-  (ref LSTMCellSimple:213)."""
+  (ref LSTMCellSimple:213).
+
+  Quantization: four QDomain hooks matching the reference's placement
+  (ref `rnn_cell.py:279-297` qdomain.{weight,fullyconnected,c_state,m_state}
+  and the QWeight/QAct calls at `:578-645`). Because cells run inside
+  `lax.scan`, use stateless domains (FixedRangeQDomain /
+  ScheduledClipQDomain) for the activation hooks — EMA-tracked domains
+  would try to emit forward-state updates from inside the scan trace.
+  """
 
   @classmethod
   def Params(cls):
@@ -76,7 +84,17 @@ class LSTMCellSimple(RNNCell):
     p.Define("num_hidden_nodes", 0,
              "If >0, cell dim differs from output (adds a projection).")
     p.Define("enable_lstm_bias", True, "Use a bias term.")
+    p.Define("qdomain_weight", None,
+             "QDomain params for the gate matmul weight (ref qdomain.weight).")
+    p.Define("qdomain_fullyconnected", None,
+             "QDomain for the gate pre-activations ('add_bias' hook).")
+    p.Define("qdomain_c_state", None,
+             "QDomain for the cell state ('c_output_gate' hook).")
+    p.Define("qdomain_m_state", None,
+             "QDomain for the emitted m state and output projection.")
     return p
+
+  _QDOMAINS = ("weight", "fullyconnected", "c_state", "m_state")
 
   def __init__(self, params):
     super().__init__(params)
@@ -92,6 +110,23 @@ class LSTMCellSimple(RNNCell):
       self.CreateVariable(
           "w_proj",
           WeightParams((h, p.num_output_nodes), p.params_init, p.dtype))
+    for dom in self._QDOMAINS:
+      tpl = p.Get(f"qdomain_{dom}")
+      if tpl is not None:
+        self.CreateChild(f"qdomain_{dom}", tpl.Copy())
+
+  def _QWeight(self, theta, dom: str, w):
+    if self.p.Get(f"qdomain_{dom}") is None:
+      return w
+    child = getattr(self, f"qdomain_{dom}")
+    return child.QuantizeWeight(self.ChildTheta(theta, f"qdomain_{dom}"), w)
+
+  def _QAct(self, theta, dom: str, name: str, x):
+    if self.p.Get(f"qdomain_{dom}") is None:
+      return x
+    child = getattr(self, f"qdomain_{dom}")
+    return child.QuantizeAct(
+        self.ChildTheta(theta, f"qdomain_{dom}"), name, x)
 
   @property
   def hidden_size(self):
@@ -106,7 +141,7 @@ class LSTMCellSimple(RNNCell):
   def _Gates(self, theta, xm):
     """Gate pre-activations [b, 4H]; subclass hook (LN variant)."""
     th = self.CastTheta(theta)
-    gates = xm @ th.wm
+    gates = xm @ self._QWeight(theta, "weight", th.wm)
     if self.p.enable_lstm_bias:
       gates = gates + th.b
     return gates
@@ -117,15 +152,19 @@ class LSTMCellSimple(RNNCell):
     p = self.p
     th = self.CastTheta(theta)
     xm = jnp.concatenate([self.ToFPropDtype(inputs), state0.m], axis=-1)
-    gates = self._Gates(theta, xm)
+    gates = self._QAct(theta, "fullyconnected", "add_bias",
+                       self._Gates(theta, xm))
     i, g, f, o = jnp.split(gates, 4, axis=-1)
     f = f + p.forget_gate_bias
     c = jax.nn.sigmoid(f) * state0.c + jax.nn.sigmoid(i) * jnp.tanh(g)
     if p.cell_value_cap > 0:
       c = jnp.clip(c, -p.cell_value_cap, p.cell_value_cap)
-    m = jax.nn.sigmoid(o) * jnp.tanh(c)
+    c = self._QAct(theta, "c_state", "c_output_gate", c)
+    m = self._QAct(theta, "m_state", "m_output",
+                   jax.nn.sigmoid(o) * jnp.tanh(c))
     if p.num_hidden_nodes:
-      m = m @ th.w_proj
+      m = self._QAct(theta, "m_state", "m_output_projection",
+                     m @ self._QWeight(theta, "m_state", th.w_proj))
     return self._ApplyPadding(NestedMap(m=m, c=c), state0, padding)
 
 
@@ -148,7 +187,7 @@ class LayerNormalizedLSTMCellSimple(LSTMCellSimple):
   def _Gates(self, theta, xm):
     p = self.p
     th = self.CastTheta(theta)
-    gates = xm @ th.wm
+    gates = xm @ self._QWeight(theta, "weight", th.wm)
     # per-gate LN over each H-slice, applied before the bias
     h = self.hidden_size
     gates = gates.reshape(gates.shape[0], 4, h)

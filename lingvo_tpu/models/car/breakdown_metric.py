@@ -40,17 +40,25 @@ class BreakdownApMetric:
     self._metrics = [ap_metric.ApMetric(iou_threshold)
                      for _ in self._labels]
 
-  def _MatchedGtBins(self, pred_boxes, gt_boxes, gt_bins):
-    """Bin index of the max-IoU gt for each prediction (-1 if none)."""
-    bins = np.full((len(pred_boxes),), -1, np.int64)
+  _UNMATCHED = -1   # no overlapping same-class gt: pure FP, hits every bin
+  _EXCLUDED = -2    # matched a gt that bin_of_gt excluded: not scored
+
+  def _MatchedGtBins(self, pred_boxes, gt_boxes, gt_bins,
+                     pred_classes, gt_classes):
+    """Bin of each prediction's max-IoU same-class gt (sentinels above)."""
+    bins = np.full((len(pred_boxes),), self._UNMATCHED, np.int64)
     for i, pb in enumerate(pred_boxes):
       best_iou, best_j = 0.0, -1
       for j, gb in enumerate(gt_boxes):
+        if (pred_classes is not None and gt_classes is not None and
+            pred_classes[i] != gt_classes[j]):
+          continue  # ApMetric matches class-aware; mirror it
         iou = ap_metric.RotatedIou(np.asarray(pb)[:7], np.asarray(gb)[:7])
         if iou > best_iou:
           best_iou, best_j = iou, j
       if best_j >= 0:
-        bins[i] = gt_bins[best_j]
+        b = gt_bins[best_j]
+        bins[i] = b if b >= 0 else self._EXCLUDED
     return bins
 
   def Update(self, pred_boxes, pred_scores, gt_boxes,
@@ -60,7 +68,8 @@ class BreakdownApMetric:
     if not len(pred_boxes):
       pred_bins = np.zeros((0,), np.int64)
     elif self._bin_preds_by_matched_gt:
-      pred_bins = self._MatchedGtBins(pred_boxes, gt_boxes, gt_bins)
+      pred_bins = self._MatchedGtBins(pred_boxes, gt_boxes, gt_bins,
+                                      pred_classes, gt_classes)
     else:
       pred_bins = np.array([self._bin_of_gt(g) for g in pred_boxes],
                            np.int64)
@@ -68,7 +77,9 @@ class BreakdownApMetric:
       sel = gt_bins == b
       psel = pred_bins == b
       if self._bin_preds_by_matched_gt:
-        psel = psel | (pred_bins == -1)  # pure FPs penalize every bin
+        # pure FPs penalize every bin; matched-to-excluded preds score
+        # nowhere (their gt was deliberately out of protocol)
+        psel = psel | (pred_bins == self._UNMATCHED)
       metric.Update(
           pred_boxes[psel], pred_scores[psel], gt_boxes[sel],
           pred_classes=(pred_classes[psel] if pred_classes is not None

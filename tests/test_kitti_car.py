@@ -241,6 +241,18 @@ class TestBreakdownMetrics:
     counts = breakdown_metric.CountPointsInBoxes(pts, boxes)
     assert counts[0] == 2
 
+  def test_matched_excluded_gt_not_counted_as_fp(self):
+    # A prediction matching a gt that bin_of_gt excludes (-1) must score in
+    # no bin — not flood every bin as a false positive.
+    m = breakdown_metric.BreakdownApMetric(
+        ["b0"], lambda g: -1 if g[0] > 100 else 0,
+        bin_preds_by_matched_gt=True)
+    gt = np.array([[200.0, 0, 0, 2, 2, 2, 0.0],   # excluded
+                   [0.0, 0, 0, 2, 2, 2, 0.0]])    # bin 0
+    pred = gt.copy()
+    m.Update(pred, np.array([0.9, 0.8]), gt)
+    assert m.value["b0"] == 1.0
+
   def test_by_num_points_bins_preds_by_matched_gt(self):
     # 7-DOF predictions (no count column) must land in the bin of the gt
     # they overlap, so a perfect detector scores 1.0 in every populated bin.

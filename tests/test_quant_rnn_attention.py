@@ -1,0 +1,203 @@
+"""Quantized RNN-cell / attention / conformer-conv domains (VERDICT r3
+Missing #2, round-3 task #8): QDomain hooks matching the reference's
+placement — `lingvo/core/rnn_cell.py:279-297,578-645` (weight /
+fullyconnected / c_state / m_state domains in LSTMCellSimple),
+`lingvo/core/attention.py:440` (qsoftmax), `batch_major_attention.py:303`
+(projection TrackQWeight) — plus int8-deployment equivalence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lingvo_tpu.core import attention as attention_lib
+from lingvo_tpu.core import conformer_layer
+from lingvo_tpu.core import quant_utils
+from lingvo_tpu.core import rnn_cell
+from lingvo_tpu.core import rnn_layers
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _QuantLstmParams(**kw):
+  """LSTM with the full reference domain placement; every activation
+  domain is stateless (scan-safe)."""
+  return rnn_cell.LSTMCellSimple.Params().Set(
+      name="lstm",
+      num_input_nodes=8,
+      num_output_nodes=8,
+      qdomain_weight=quant_utils.PerChannelSymmetricQDomain.Params().Set(
+          act_names=()),
+      qdomain_fullyconnected=quant_utils.ScheduledClipQDomain.Params().Set(
+          start_cap=8.0, end_cap=8.0),
+      qdomain_c_state=quant_utils.FixedRangeQDomain.Params().Set(
+          range_min=-10.0, range_max=10.0),
+      qdomain_m_state=quant_utils.FixedRangeQDomain.Params().Set(
+          range_min=-1.0, range_max=1.0),
+      **kw)
+
+
+class TestQuantizedLstm:
+
+  def test_quantized_cell_fprop_close_to_float(self):
+    qp = _QuantLstmParams()
+    fp = rnn_cell.LSTMCellSimple.Params().Set(
+        name="lstm", num_input_nodes=8, num_output_nodes=8)
+    qcell = qp.Instantiate()
+    qcell.FinalizePaths()
+    fcell = fp.Instantiate()
+    fcell.FinalizePaths()
+    qtheta = qcell.InstantiateVariables(KEY)
+    ftheta = fcell.InstantiateVariables(KEY)  # same seed -> same wm/b
+    np.testing.assert_allclose(np.asarray(qtheta.wm), np.asarray(ftheta.wm))
+
+    x = 0.5 * jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    state0 = qcell.InitState(4)
+    qs = qcell.FProp(qtheta, state0, x)
+    fs = fcell.FProp(ftheta, state0, x)
+    # 8-bit fake quant perturbs but tracks the float math
+    assert float(jnp.max(jnp.abs(qs.m - fs.m))) < 0.1
+    assert not np.allclose(np.asarray(qs.m), np.asarray(fs.m))
+
+  def test_quantized_lstm_trains_under_scan(self):
+    """The stateless domains must survive lax.scan (FRNN) + grad."""
+    p = rnn_layers.FRNN.Params().Set(name="frnn", cell=_QuantLstmParams())
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 5, 8))
+
+    def _Loss(th):
+      out, _ = layer.FProp(th, x)
+      return jnp.sum(out ** 2)
+
+    loss, grads = jax.jit(jax.value_and_grad(_Loss))(theta)
+    assert np.isfinite(float(loss))
+    gsum = float(sum(jnp.sum(jnp.abs(g)) for g in jax.tree.leaves(grads)))
+    assert np.isfinite(gsum) and gsum > 0
+
+  def test_lstm_qat_matches_int8_deployment(self):
+    """QAT weight simulation == dequantized int8 serving weight (the same
+    guarantee the projection layer test gives, now for the gate matmul)."""
+    qp = _QuantLstmParams()
+    cell = qp.Instantiate()
+    cell.FinalizePaths()
+    theta = cell.InstantiateVariables(KEY)
+    w_qat = cell._QWeight(theta, "weight", theta.wm)
+    w_int8, scale = quant_utils.Int8QuantizeWeight(theta.wm, per_channel=True)
+    w_deploy = w_int8.astype(jnp.float32) * scale
+    np.testing.assert_allclose(np.asarray(w_qat), np.asarray(w_deploy),
+                               atol=1e-6)
+
+  def test_layer_norm_variant_quantizes_weight(self):
+    p = _QuantLstmParams()
+    lp = rnn_cell.LayerNormalizedLSTMCellSimple.Params().Set(
+        name="lnlstm", num_input_nodes=8, num_output_nodes=8,
+        qdomain_weight=p.qdomain_weight)
+    cell = lp.Instantiate()
+    cell.FinalizePaths()
+    theta = cell.InstantiateVariables(KEY)
+    state = cell.FProp(theta, cell.InitState(2),
+                       jax.random.normal(KEY, (2, 8)))
+    assert np.all(np.isfinite(np.asarray(state.m)))
+
+
+class TestQuantizedAttention:
+
+  def _mha(self, **kw):
+    p = attention_lib.MultiHeadedAttention.Params().Set(
+        name="mha", input_dim=16, hidden_dim=16, num_heads=2, **kw)
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    return layer, layer.InstantiateVariables(KEY)
+
+  def test_softmax_domain_quantizes_probs(self):
+    layer, theta = self._mha(
+        qdomain_softmax=quant_utils.FixedRangeQDomain.Params().Set(
+            range_min=0.0, range_max=1.0))
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 6, 16))
+    out, probs = layer.FProp(theta, x)
+    assert out.shape == (2, 6, 16) and probs is not None
+    # probs land on the 8-bit lattice over [0, 1]
+    lattice = np.asarray(probs, np.float64) * 255.0
+    np.testing.assert_allclose(lattice, np.round(lattice), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(jnp.sum(probs, -1)), 1.0, atol=0.05)
+
+  def test_weight_domain_perturbs_but_tracks_float(self):
+    qlayer, qtheta = self._mha(
+        qdomain_weight=quant_utils.PerChannelSymmetricQDomain.Params().Set(
+            act_names=()))
+    flayer, ftheta = self._mha()
+    np.testing.assert_allclose(
+        np.asarray(qtheta.w_query), np.asarray(ftheta.w_query))
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 6, 16))
+    qout, _ = qlayer.FProp(qtheta, x)
+    fout, _ = flayer.FProp(ftheta, x)
+    assert float(jnp.max(jnp.abs(qout - fout))) < 0.1
+    assert not np.allclose(np.asarray(qout), np.asarray(fout))
+
+  def test_softmax_domain_disables_flash(self):
+    layer, _ = self._mha(
+        use_flash_attention=True,
+        qdomain_softmax=quant_utils.FixedRangeQDomain.Params().Set(
+            range_min=0.0, range_max=1.0))
+    assert not layer._FlashEligible(None, None, False, 64)
+
+  def test_quantized_extend_step_matches_fprop(self):
+    """Incremental decode must see the same quantized weights/probs."""
+    layer, theta = self._mha(
+        use_bias=False,
+        qdomain_weight=quant_utils.PerChannelSymmetricQDomain.Params().Set(
+            act_names=()),
+        qdomain_softmax=quant_utils.FixedRangeQDomain.Params().Set(
+            range_min=0.0, range_max=1.0))
+    x = 0.3 * jax.random.normal(jax.random.PRNGKey(5), (1, 4, 16))
+    full, _ = layer.FProp(theta, x, atten_mask=attention_lib.CausalMask(4))
+    states = layer.InitStates(theta, 1, 4)
+    outs = []
+    for t in range(4):
+      o, states = layer.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(o)
+    inc = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(inc),
+                               atol=2e-5, rtol=2e-3)
+
+
+class TestQuantizedAttentionVariants:
+
+  def test_xl_softmax_domain_quantizes_probs(self):
+    from lingvo_tpu.core import attention_variants
+    p = attention_variants.TransformerXLAttention.Params().Set(
+        name="xl", input_dim=16, hidden_dim=16, num_heads=2,
+        qdomain_softmax=quant_utils.FixedRangeQDomain.Params().Set(
+            range_min=0.0, range_max=1.0))
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 6, 16))
+    _, probs = layer.FProp(theta, x)
+    lattice = np.asarray(probs, np.float64) * 255.0
+    np.testing.assert_allclose(lattice, np.round(lattice), atol=1e-3)
+
+
+class TestQuantizedConformerConv:
+
+  def test_lconv_quantized_stream_equals_offline(self):
+    p = conformer_layer.LConvLayer.Params().Set(
+        name="lconv", input_dim=8, kernel_size=4, causal=True,
+        conv_norm="ln",
+        qdomain=quant_utils.PerChannelSymmetricQDomain.Params().Set(
+            act_names=()))
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    theta = layer.InstantiateVariables(KEY)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 8))
+    offline = layer.FProp(theta, x)
+    states = layer.InitStreamStates(2)
+    chunks = []
+    for c in range(0, 8, 4):
+      y, states = layer.StreamStep(theta, x[:, c:c + 4], None, states)
+      chunks.append(y)
+    streamed = jnp.concatenate(chunks, axis=1)
+    np.testing.assert_allclose(np.asarray(offline), np.asarray(streamed),
+                               atol=1e-5)
