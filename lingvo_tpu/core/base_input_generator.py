@@ -181,12 +181,13 @@ class FileBasedSequenceInputGenerator(BaseSequenceInputGenerator):
       return np.pad(a, pad, constant_values=0)
 
     out = batch.Transform(_Pad)
-    # padded rows are all-padding: paddings=1, weights=0
+    # padded rows are all-padding: paddings=1, weights=0 (suffix match so
+    # modality-prefixed leaves like 'text_paddings' are fixed up too)
     for key, val in out.FlattenItems():
       leaf = key.split(".")[-1]
-      if leaf == "paddings":
+      if leaf == "paddings" or leaf.endswith("_paddings"):
         val[b:] = 1.0
-      elif leaf == "weights":
+      elif leaf == "weights" or leaf.endswith("_weights"):
         val[b:] = 0.0
     return out
 

@@ -37,6 +37,13 @@ def FakeQuant(x, scale, bits: int = 8):
   return x + jax.lax.stop_gradient(q - x)
 
 
+def MaxAbsSymmetricFakeQuant(w, bits: int):
+  """Per-tensor symmetric weight fake quant (scale = max-abs / qmax) —
+  the shared weight recipe of every non-per-channel domain."""
+  scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (2.0 ** (bits - 1) - 1)
+  return FakeQuant(w, scale.astype(w.dtype), bits)
+
+
 class QDomain(base_layer.BaseLayer):
   """Base quantization domain (ref QDomain): no-op."""
 
@@ -75,9 +82,7 @@ class SymmetricQDomain(QDomain):
                        collections=("non_trainable", "moving_stats")))
 
   def QuantizeWeight(self, theta, w):
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (
-        2.0 ** (self.p.bits - 1) - 1)
-    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+    return MaxAbsSymmetricFakeQuant(w, self.p.bits)
 
   def QuantizeAct(self, theta, name: str, x):
     p = self.p
@@ -134,9 +139,7 @@ class PassiveAsymQDomain(QDomain):
 
   def QuantizeWeight(self, theta, w):
     # weights stay symmetric (zero-centered by construction)
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (
-        2.0 ** (self.p.bits - 1) - 1)
-    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+    return MaxAbsSymmetricFakeQuant(w, self.p.bits)
 
   def QuantizeAct(self, theta, name: str, x):
     p = self.p
@@ -181,9 +184,7 @@ class FixedRangeQDomain(QDomain):
     return p
 
   def QuantizeWeight(self, theta, w):
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32))) / (
-        2.0 ** (self.p.bits - 1) - 1)
-    return FakeQuant(w, scale.astype(w.dtype), self.p.bits)
+    return MaxAbsSymmetricFakeQuant(w, self.p.bits)
 
   def QuantizeAct(self, theta, name: str, x):
     p = self.p

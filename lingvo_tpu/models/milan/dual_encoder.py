@@ -55,6 +55,12 @@ class DualEncoderTask(base_model.BaseTask):
     p = super().Params()
     p.Define("image_encoder", MlpEncoder.Params(), "Tower A.")
     p.Define("text_encoder", MlpEncoder.Params(), "Tower B.")
+    p.Define("image_input_features", "image",
+             "Input-batch field(s) fed to the image tower — a name or tuple "
+             "of names, passed positionally (ref EncoderConfig."
+             "input_features / Selector, dual_encoder.py:44-52).")
+    p.Define("text_input_features", "text",
+             "Input-batch field(s) fed to the text tower.")
     p.Define("init_temperature", 0.07, "Softmax temperature (learned log).")
     p.Define("recall_at", (1, 5), "Ks for retrieval recall metrics.")
     return p
@@ -69,11 +75,19 @@ class DualEncoderTask(base_model.BaseTask):
         WeightParams((), WeightInit.Constant(
             float(np.log(1.0 / p.init_temperature))), jnp.float32))
 
+  @staticmethod
+  def _SelectFeatures(input_batch, features):
+    names = (features,) if isinstance(features, str) else tuple(features)
+    return [input_batch[n] for n in names]
+
   def _Embed(self, theta, input_batch):
+    p = self.p
     img = self.image_encoder.FProp(
-        self.ChildTheta(theta, "image_encoder"), input_batch.image)
+        self.ChildTheta(theta, "image_encoder"),
+        *self._SelectFeatures(input_batch, p.image_input_features))
     txt = self.text_encoder.FProp(
-        self.ChildTheta(theta, "text_encoder"), input_batch.text)
+        self.ChildTheta(theta, "text_encoder"),
+        *self._SelectFeatures(input_batch, p.text_input_features))
     img = img / jnp.maximum(
         jnp.linalg.norm(img, axis=-1, keepdims=True), 1e-6)
     txt = txt / jnp.maximum(

@@ -1,0 +1,126 @@
+"""Milan encoder towers: conv image tower + transformer text tower.
+
+Re-designs the reference's modality encoders (ref
+`lingvo/tasks/milan/dual_encoder.py:1-120` EncoderConfig consumers,
+`tasks/milan/transformers.py` GetTransformerStackWithEmbeddingInput, and the
+tf-hub image towers in `tasks/milan/tf_hub_layers.py`) as TPU-native layers:
+the image tower is a strided NHWC conv stack (MXU-friendly, BN in-graph)
+with global average pooling; the text tower embeds token ids and runs a
+batch-major transformer stack with masked mean pooling.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core import transformer as transformer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+class ConvImageEncoder(base_layer.BaseLayer):
+  """[B, H, W, C] images -> [B, output_dim] embeddings.
+
+  A strided conv stack (stride 2 per block, ref tf_hub image towers'
+  downsampling) + global average pool + linear projection.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_channels", 3, "Image channels.")
+    p.Define("filter_counts", [32, 64, 128],
+             "Output channels per stride-2 conv block.")
+    p.Define("filter_size", 3, "Square kernel size.")
+    p.Define("output_dim", 128, "Joint embedding dim.")
+    p.Define("batch_norm", True, "BN after each conv.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    cin = p.input_channels
+    convs = []
+    for cout in p.filter_counts:
+      convs.append(layers_lib.Conv2DLayer.Params().Set(
+          filter_shape=(p.filter_size, p.filter_size, cin, cout),
+          filter_stride=(2, 2),
+          activation="RELU",
+          batch_norm=p.batch_norm,
+          has_bias=not p.batch_norm))
+      cin = cout
+    self.CreateChildren("convs", convs)
+    self.CreateChild(
+        "proj",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=cin, output_dim=p.output_dim, activation="NONE"))
+
+  def FProp(self, theta, images):
+    """images: [B, H, W, C] floats."""
+    x = self.ToFPropDtype(images)
+    for i, conv in enumerate(self.convs):
+      x = conv.FProp(theta.convs[i], x)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool -> [B, C]
+    return self.proj.FProp(theta.proj, x)
+
+
+class TransformerTextEncoder(base_layer.BaseLayer):
+  """[B, T] token ids (+ paddings) -> [B, output_dim] embeddings.
+
+  Embedding + positional encoding + transformer stack + masked mean pool +
+  projection (ref `tasks/milan/transformers.py`
+  GetTransformerStackWithEmbeddingInput: input projection, N transformer
+  layers, fixed-dim output).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("vocab_size", 0, "Token vocabulary size.")
+    p.Define("model_dim", 128, "Transformer width.")
+    p.Define("num_layers", 2, "Transformer depth.")
+    p.Define("num_heads", 4, "Attention heads.")
+    p.Define("hidden_dim", 0, "FFN dim (0 = 4x model_dim).")
+    p.Define("output_dim", 128, "Joint embedding dim.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.vocab_size > 0, "vocab_size required"
+    self.CreateChild(
+        "emb",
+        layers_lib.SimpleEmbeddingLayer.Params().Set(
+            vocab_size=p.vocab_size, embedding_dim=p.model_dim,
+            scale_sqrt_depth=True))
+    self.CreateChild(
+        "pos_emb",
+        layers_lib.PositionalEmbeddingLayer.Params().Set(
+            embedding_dim=p.model_dim))
+    tl = transformer_lib.TransformerLayer.Params().Set(
+        num_heads=p.num_heads, hidden_dim=p.hidden_dim or 4 * p.model_dim)
+    self.CreateChild(
+        "stack",
+        transformer_lib.StackedTransformerLayers.Params().Set(
+            num_layers=p.num_layers, input_dim=p.model_dim,
+            transformer_layer_params_tpl=tl))
+    self.CreateChild(
+        "proj",
+        layers_lib.ProjectionLayer.Params().Set(
+            input_dim=p.model_dim, output_dim=p.output_dim,
+            activation="NONE"))
+
+  def FProp(self, theta, ids, paddings=None):
+    """ids: [B, T] int32; paddings: optional [B, T] (1 = pad)."""
+    if paddings is None:
+      paddings = jnp.zeros(ids.shape, jnp.float32)
+    x = self.emb.FProp(theta.emb, ids)
+    # stateless sinusoidal embedding: no vars, so no theta entry
+    x = x + self.pos_emb.FProp(NestedMap(),
+                               seq_length=ids.shape[1])[None].astype(x.dtype)
+    x = self.stack.FProp(theta.stack, x, paddings)
+    w = (1.0 - paddings).astype(x.dtype)[:, :, None]
+    pooled = jnp.sum(x * w, axis=1) / jnp.maximum(
+        jnp.sum(w, axis=1), 1.0)
+    return self.proj.FProp(theta.proj, pooled)

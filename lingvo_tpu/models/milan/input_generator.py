@@ -1,11 +1,22 @@
-"""Milan input: synthetic paired (image-feature, text-feature) batches.
+"""Milan inputs: paired image/text batches.
 
-Pairs share a latent code rendered through two fixed random linear maps +
-noise — cross-modal retrieval is learnable but not trivial (ref milan's
-image/text input pipelines over tfrecords; plug TextMtInput-style file
-generators for real data)."""
+Three generators, mirroring the reference's milan input stack
+(`lingvo/tasks/milan/input_generator.py`, `dataset_spec.py`,
+`params/generic_datasets.py`):
+
+- `SyntheticPairedInput`: feature-vector pairs through fixed linear maps
+  (kept for the MLP-tower parity config).
+- `SyntheticImageTextInput`: REAL modalities — [H, W, 3] images rendered
+  from discrete sprite codes, and token sequences naming those sprites.
+  Retrieval requires the conv tower to recognize sprites in pixels and the
+  text tower to read them from tokens.
+- `MilanFileInput`: file-backed paired records (JSON: image + token ids)
+  over the native C++ record yielder, the production path.
+"""
 
 from __future__ import annotations
+
+import json
 
 import numpy as np
 
@@ -42,3 +53,104 @@ class SyntheticPairedInput(base_input_generator.BaseInputGenerator):
     txt = z @ self._txt_map + p.noise * rng.randn(p.batch_size, p.text_dim)
     return NestedMap(image=img.astype(np.float32),
                      text=txt.astype(np.float32))
+
+
+def RenderSprites(attr_ids: np.ndarray, sprites: np.ndarray,
+                  noise: float, rng) -> np.ndarray:
+  """[B, K] sprite ids + [V, H, W, 3] sprite bank -> [B, H, W, 3] images."""
+  img = sprites[attr_ids].sum(axis=1)  # [B, H, W, 3]
+  if noise > 0:
+    img = img + noise * rng.randn(*img.shape)
+  return np.clip(img, -3.0, 3.0).astype(np.float32)
+
+
+class SyntheticImageTextInput(base_input_generator.BaseInputGenerator):
+  """Paired ([B,H,W,3] image, [B,T] token ids) batches from sprite codes.
+
+  Each example draws `attrs_per_example` distinct sprite ids; the image is
+  the sum of those sprites' fixed random patterns (+noise), the text is the
+  sprite ids as tokens (1-based; 0 is pad). Cross-modal retrieval demands
+  both towers actually encode their modality.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("image_size", 16, "Square image height/width.")
+    p.Define("num_sprites", 16, "Sprite vocabulary size.")
+    p.Define("attrs_per_example", 3, "Sprites per example.")
+    p.Define("text_len", 6, "Token row length (>= attrs_per_example).")
+    p.Define("noise", 0.05, "Pixel observation noise.")
+    p.Define("seed", 0, "Per-dataset seed.")
+    return p
+
+  @property
+  def text_vocab_size(self) -> int:
+    return self.p.num_sprites + 1  # + pad token 0
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    rng = np.random.RandomState(7321)  # sprite bank fixed across datasets
+    s = p.image_size
+    # smooth-ish sprites: random low-res patterns upsampled 4x
+    lo = rng.randn(p.num_sprites, (s + 3) // 4, (s + 3) // 4, 3)
+    self._sprites = lo.repeat(4, axis=1)[:, :s].repeat(
+        4, axis=2)[:, :, :s].astype(np.float32)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    p = self.p
+    rng = np.random.RandomState((p.seed + 92821 * self._step) % (2 ** 31))
+    self._step += 1
+    b, k = p.batch_size, p.attrs_per_example
+    attrs = np.stack(
+        [rng.choice(p.num_sprites, size=k, replace=False) for _ in range(b)])
+    image = RenderSprites(attrs, self._sprites, p.noise, rng)
+    ids = np.zeros((b, p.text_len), np.int32)
+    ids[:, :k] = np.sort(attrs, axis=1) + 1  # canonical order; 0 = pad
+    paddings = (ids == 0).astype(np.float32)
+    return NestedMap(image=image, text_ids=ids, text_paddings=paddings)
+
+
+class MilanFileInput(base_input_generator.FileBasedSequenceInputGenerator):
+  """File-backed paired input: one JSON record per example with
+  {"image": [H, W, 3] nested list (or flat list + "image_shape"),
+   "text_ids": [T'] tokens} — the production path over the native yielder
+  (ref milan `dataset_spec.py` tfrecord pipelines).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("image_size", 16, "Square image size records must match.")
+    p.Define("text_len", 6, "Token row length (truncate/pad records).")
+    return p
+
+  def __init__(self, params):
+    params = params.Copy()
+    params.bucket_upper_bound = [1]
+    params.bucket_batch_limit = [params.batch_size or 8]
+    super().__init__(params)
+
+  def ProcessRecord(self, record: bytes):
+    p = self.p
+    try:
+      ex = json.loads(record.decode("utf-8"))
+      if not isinstance(ex, dict):
+        return None
+      img = np.asarray(ex["image"], np.float32)
+      if "image_shape" in ex:
+        img = img.reshape(ex["image_shape"])
+      if img.shape != (p.image_size, p.image_size, 3):
+        return None
+      toks = np.asarray(ex["text_ids"], np.int64).reshape(-1)[:p.text_len]
+    except (KeyError, ValueError, TypeError, json.JSONDecodeError,
+            UnicodeDecodeError):
+      return None  # malformed record: drop, never kill the pipeline
+    ids = np.zeros((p.text_len,), np.int32)
+    ids[:len(toks)] = toks
+    return NestedMap(
+        image=img, text_ids=ids,
+        text_paddings=(ids == 0).astype(np.float32),
+        bucket_key=1)

@@ -8,6 +8,7 @@ from lingvo_tpu.core import learner as learner_lib
 from lingvo_tpu.core import optimizer as opt_lib
 from lingvo_tpu.core import schedule as sched_lib
 from lingvo_tpu.models.milan import dual_encoder
+from lingvo_tpu.models.milan import encoders
 from lingvo_tpu.models.milan import input_generator
 
 
@@ -40,3 +41,57 @@ class MilanDualEncoder(base_model_params.SingleTaskModelParams):
         lr_schedule=sched_lib.Constant.Params())
     p.train.tpu_steps_per_loop = 50
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class MilanImageText(base_model_params.SingleTaskModelParams):
+  """Real modality towers: conv image encoder + transformer text encoder
+  over synthetic sprite images (ref `tasks/milan/params/cxc.py` shape:
+  image tower + text transformer into a joint space)."""
+
+  BATCH_SIZE = 32
+  IMAGE_SIZE = 16
+  NUM_SPRITES = 16
+  TEXT_LEN = 6
+  EMB_DIM = 64
+
+  def Train(self):
+    return input_generator.SyntheticImageTextInput.Params().Set(
+        batch_size=self.BATCH_SIZE, image_size=self.IMAGE_SIZE,
+        num_sprites=self.NUM_SPRITES, text_len=self.TEXT_LEN)
+
+  def Test(self):
+    return self.Train().Set(seed=99)
+
+  def Task(self):
+    p = dual_encoder.DualEncoderTask.Params()
+    p.name = "milan_image_text"
+    p.image_encoder = encoders.ConvImageEncoder.Params().Set(
+        filter_counts=[32, 64], output_dim=self.EMB_DIM)
+    p.text_encoder = encoders.TransformerTextEncoder.Params().Set(
+        vocab_size=self.NUM_SPRITES + 1, model_dim=64, num_layers=2,
+        num_heads=4, output_dim=self.EMB_DIM)
+    p.image_input_features = "image"
+    p.text_input_features = ("text_ids", "text_paddings")
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1e-3,
+        optimizer=opt_lib.Adam.Params(),
+        lr_schedule=sched_lib.Constant.Params())
+    p.train.tpu_steps_per_loop = 50
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class MilanImageTextFiles(MilanImageText):
+  """Same towers over the file-backed input (native record yielder); point
+  file_pattern at JSON-lines records (see MilanFileInput docstring)."""
+
+  FILE_PATTERN = "text:/tmp/milan/*.jsonl"
+
+  def Train(self):
+    return input_generator.MilanFileInput.Params().Set(
+        batch_size=self.BATCH_SIZE, image_size=self.IMAGE_SIZE,
+        text_len=self.TEXT_LEN, file_pattern=self.FILE_PATTERN)
+
+  def Test(self):
+    return self.Train()

@@ -1,0 +1,65 @@
+"""Registry-wide smoke test (VERDICT r3 Missing #6): one generated check per
+registered model config x dataset, with stubbed (abstract) variables.
+
+Mirrors `lingvo/core/models_test_helper.py:96,172`
+(CreateTestMethodsForAllRegisteredModels + _StubOutCreateVariable): the
+reference instantiates every registered model's params with initializer
+stubs to catch param/shape wiring errors across the whole zoo without real
+compute. Here `VariableSpecs()` (pure shape math) plus
+`jax.eval_shape(CreateTrainState)` (abstract trace: full variable creation,
+learner/optimizer state trees, EMA) give the same insurance — every
+registered config must build its task and trace its state tree.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from lingvo_tpu import datasets as datasets_lib
+from lingvo_tpu import model_registry
+import lingvo_tpu.models.all_params  # noqa: F401 — populates the registry
+
+# Giant-LM configs whose abstract state trace is slow/huge; their sharded
+# train step is already AOT-validated every round by
+# __graft_entry__.dryrun_multichip, so specs-only here.
+_SPECS_ONLY = ("8B", "128B", "175B", "1T")
+
+
+def _AllModelDatasetPairs():
+  pairs = []
+  for name, cls in sorted(model_registry.GetRegisteredModels().items()):
+    for ds in datasets_lib.GetDatasets(cls, warn_on_error=False):
+      pairs.append((name, ds))
+  return pairs
+
+
+_PAIRS = _AllModelDatasetPairs()
+
+
+def test_registry_is_populated():
+  assert len(_PAIRS) >= 20, _PAIRS
+
+
+@pytest.mark.parametrize("name,ds", _PAIRS,
+                         ids=[f"{n}:{d}" for n, d in _PAIRS])
+def test_registered_config_builds_and_traces(name, ds):
+  mp = model_registry.GetParams(name, ds)
+  mp.task.input = mp.input
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+
+  specs = task.VariableSpecs()
+  flat = specs.FlattenItems()
+  assert flat, f"{name}:{ds} has no variables"
+  for path, spec in flat:
+    assert all(int(d) >= 0 for d in spec.shape), (name, path, spec.shape)
+
+  n_params = sum(int(np.prod(spec.shape)) for _, spec in flat)
+  assert n_params > 0
+
+  if any(tag in name for tag in _SPECS_ONLY):
+    return
+  # Abstract state creation: catches optimizer-slot / learner wiring errors
+  # (shape mismatches raise inside the trace; nothing is materialized).
+  state = jax.eval_shape(task.CreateTrainState, jax.random.PRNGKey(0))
+  assert "theta" in state and "opt_states" in state
