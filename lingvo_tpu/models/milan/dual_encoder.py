@@ -94,35 +94,74 @@ class DualEncoderTask(base_model.BaseTask):
         jnp.linalg.norm(txt, axis=-1, keepdims=True), 1e-6)
     return img, txt
 
+  def _RowValidity(self, input_batch):
+    """[B] 1.0 for real examples, 0.0 for padded flush rows.
+
+    Finite-epoch file inputs pad the last batch; padded rows arrive with
+    all-padding text (`_PadBatchDim` sets *_paddings leaves to 1), and must
+    not act as contrastive examples or count in recall.
+    """
+    for names in (self.p.text_input_features, self.p.image_input_features):
+      names = (names,) if isinstance(names, str) else tuple(names)
+      for n in names:
+        if n == "paddings" or n.endswith("_paddings"):
+          pad = input_batch[n]
+          return (jnp.min(pad, axis=-1) < 0.5).astype(jnp.float32)
+    return None
+
   def ComputePredictions(self, theta, input_batch):
     th = self.CastTheta(theta)
     img, txt = self._Embed(theta, input_batch)
     scale = jnp.exp(th.log_inv_temperature)
     sims = scale * jnp.einsum("id,jd->ij", img, txt)     # [B, B]
-    return NestedMap(similarities=sims, image_emb=img, text_emb=txt)
+    return NestedMap(similarities=sims, image_emb=img, text_emb=txt,
+                     example_weights=self._RowValidity(input_batch))
+
+  def _MaskedContrastive(self, sims, valid):
+    """Per-direction losses + weight, excluding invalid rows/columns."""
+    b = sims.shape[0]
+    labels = jnp.arange(b)
+    if valid is None:
+      valid = jnp.ones((b,), jnp.float32)
+    neg_inf = jnp.asarray(-1e9, sims.dtype)
+    # invalid examples can't serve as negatives in either direction
+    col_masked = jnp.where(valid[None, :] > 0.5, sims, neg_inf)
+    row_masked = jnp.where(valid[:, None] > 0.5, sims, neg_inf)
+    denom = jnp.maximum(jnp.sum(valid), 1.0)
+    i2t = -jnp.sum(
+        jax.nn.log_softmax(col_masked, axis=1)[labels, labels] * valid
+    ) / denom
+    t2i = -jnp.sum(
+        jax.nn.log_softmax(row_masked, axis=0)[labels, labels] * valid
+    ) / denom
+    return i2t, t2i, valid, denom
 
   def ComputeLoss(self, theta, predictions, input_batch):
     sims = predictions.similarities.astype(jnp.float32)
     b = sims.shape[0]
     labels = jnp.arange(b)
-    i2t = -jnp.mean(jax.nn.log_softmax(sims, axis=1)[labels, labels])
-    t2i = -jnp.mean(jax.nn.log_softmax(sims, axis=0)[labels, labels])
+    i2t, t2i, valid, denom = self._MaskedContrastive(
+        sims, predictions.example_weights)
     loss = 0.5 * (i2t + t2i)
     metrics = NestedMap(
-        loss=(loss, float(b)),
-        i2t_loss=(i2t, float(b)),
-        t2i_loss=(t2i, float(b)))
+        loss=(loss, denom),
+        i2t_loss=(i2t, denom),
+        t2i_loss=(t2i, denom))
+    ranked = jnp.where(valid[None, :] > 0.5, sims, -1e9)
     for k in self.p.recall_at:
       if k <= b:
-        topk = jnp.argsort(-sims, axis=1)[:, :k]          # i2t retrieval
+        topk = jnp.argsort(-ranked, axis=1)[:, :k]        # i2t retrieval
         hit = jnp.any(topk == labels[:, None], axis=1)
-        metrics.Set(f"recall_at_{k}", (jnp.mean(
-            hit.astype(jnp.float32)), float(b)))
+        metrics.Set(f"recall_at_{k}", (jnp.sum(
+            hit.astype(jnp.float32) * valid) / denom, denom))
     return metrics, NestedMap()
 
   def Decode(self, theta, input_batch):
     preds = self.ComputePredictions(theta, input_batch)
-    return NestedMap(similarities=preds.similarities)
+    out = NestedMap(similarities=preds.similarities)
+    if preds.example_weights is not None:
+      out.example_weights = preds.example_weights
+    return out
 
   def CreateDecoderMetrics(self):
     from lingvo_tpu.core import metrics as metrics_lib
@@ -132,9 +171,14 @@ class DualEncoderTask(base_model.BaseTask):
   def PostProcessDecodeOut(self, decode_out, decoder_metrics):
     sims = np.asarray(decode_out.similarities)
     b = sims.shape[0]
+    valid = np.asarray(decode_out.example_weights) if (
+        "example_weights" in decode_out and
+        decode_out.example_weights is not None) else np.ones(b)
+    sims = np.where(valid[None, :] > 0.5, sims, -1e9)  # no phantom targets
     order = np.argsort(-sims, axis=1)
     for k in self.p.recall_at:
       if k <= b:
         hit = (order[:, :k] == np.arange(b)[:, None]).any(axis=1)
-        for h in hit:
-          decoder_metrics[f"recall_at_{k}"].Update(float(h))
+        for h, v in zip(hit, valid):
+          if v > 0.5:
+            decoder_metrics[f"recall_at_{k}"].Update(float(h))

@@ -8,6 +8,8 @@ encoder attention (reversal) while remaining quickly learnable.
 
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
 
 from lingvo_tpu.core import base_input_generator
@@ -70,6 +72,12 @@ class SyntheticMtInput(base_input_generator.BaseInputGenerator):
     p.Define("offset", 3, "Token mapping offset.")
     p.Define("reverse", False,
              "Reverse source order in the target (harder task).")
+    p.Define("strided", False,
+             "Source sentences are strided arithmetic sequences (the "
+             "SyntheticMassInput distribution) instead of iid tokens — "
+             "models fine-tuning on the same text domain the MASS "
+             "pretraining saw.")
+    p.Define("num_strides", 3, "Stride range for strided=True.")
     p.Define("seed", 0, "Seed.")
     return p
 
@@ -90,7 +98,12 @@ class SyntheticMtInput(base_input_generator.BaseInputGenerator):
     content = p.vocab_size - 3
     for i in range(b):
       n = rng.randint(3, p.src_seq_len + 1)
-      src = rng.randint(0, content, n)
+      if p.strided:
+        start = rng.randint(0, content)
+        stride = rng.randint(1, p.num_strides + 1)
+        src = (start + stride * np.arange(n)) % content
+      else:
+        src = rng.randint(0, content, n)
       src_ids[i, :n] = 3 + src
       src_pad[i, :n] = 0.0
       mapped = src[::-1] if p.reverse else src
@@ -105,3 +118,109 @@ class SyntheticMtInput(base_input_generator.BaseInputGenerator):
     return NestedMap(
         src=NestedMap(ids=src_ids, paddings=src_pad),
         tgt=NestedMap(ids=tgt_ids, labels=tgt_labels, paddings=tgt_pad))
+
+
+class SyntheticMassInput(base_input_generator.BaseInputGenerator):
+  """Monolingual MASS pretraining batches (ref `core/ops/mass_op.cc` feeding
+  `tasks/mt` MASS recipes): random content sentences through
+  `core.mass.MassExample` — the encoder sees the sentence with a span
+  masked, the decoder reconstructs the span (teacher-forced inside the
+  span, loss weighted span-only via tgt.paddings)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("seq_len", 16, "Sentence length bound.")
+    p.Define("vocab_size", 64, "Vocab; top id is the MASS mask token.")
+    p.Define("mask_ratio", 0.5, "Masked span fraction.")
+    p.Define("num_strides", 3,
+             "Sentences are strided arithmetic token sequences with stride "
+             "in [1, num_strides] — the masked span is then exactly "
+             "reconstructable from context, so the reconstruction loss "
+             "can approach zero (iid tokens would pin it at the entropy "
+             "floor).")
+    p.Define("seed", 0, "Seed.")
+    return p
+
+  @property
+  def mask_id(self) -> int:
+    return self.p.vocab_size - 1
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._step = 0
+
+  def _InputBatch(self) -> NestedMap:
+    from lingvo_tpu.core import mass
+    p = self.p
+    rng = np.random.RandomState((p.seed + 77447 * self._step) % (2 ** 31))
+    self._step += 1
+    b, t = p.batch_size, p.seq_len
+    src_ids = np.zeros((b, t), np.int32)
+    src_pad = np.ones((b, t), np.float32)
+    tgt_ids = np.zeros((b, t), np.int32)
+    tgt_labels = np.zeros((b, t), np.int32)
+    tgt_pad = np.ones((b, t), np.float32)
+    content = p.vocab_size - 4  # 0 pad, 1 sos, 2 eos, top mask
+    for i in range(b):
+      n = rng.randint(4, t + 1)
+      start = rng.randint(0, content)
+      stride = rng.randint(1, p.num_strides + 1)
+      ids = 3 + (start + stride * np.arange(n)) % content
+      ex = mass.MassExample(ids, self.mask_id,
+                            seed=int(rng.randint(2 ** 31)),
+                            mask_ratio=p.mask_ratio)
+      src_ids[i, :n] = ex.src.ids
+      src_pad[i, :n] = 0.0
+      tgt_ids[i, :n] = ex.tgt.ids
+      tgt_labels[i, :n] = ex.tgt.labels
+      # span-only loss/attention: non-span decoder positions are padding
+      tgt_pad[i, :n] = 1.0 - ex.tgt.weights
+    return NestedMap(
+        src=NestedMap(ids=src_ids, paddings=src_pad),
+        tgt=NestedMap(ids=tgt_ids, labels=tgt_labels, paddings=tgt_pad))
+
+
+class MassFileInput(base_input_generator.FileBasedSequenceInputGenerator):
+  """File-backed MASS pretraining: monolingual text lines -> tokenized ->
+  MassExample (the production path: native yielder + tokenizer + numpy
+  MASS synthesis, = the reference's GenericInput + mass_op.cc chain)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("max_length", 64, "Max tokens per sentence.")
+    p.Define("mask_ratio", 0.5, "Masked span fraction.")
+    p.Define("mask_id", None,
+             "Mask token id (None = tokenizer vocab_size - 1).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._record_counter = 0
+
+  def ProcessRecord(self, record: bytes):
+    from lingvo_tpu.core import mass
+    p = self.p
+    text = record.decode("utf-8", errors="replace").strip()
+    if not text:
+      return None
+    _, ids_row, pad_row = self.StringsToIds([text], p.max_length)
+    n = int((1.0 - pad_row[0]).sum())
+    if n <= 3:
+      return None
+    mask_id = p.mask_id if p.mask_id is not None else (
+        self.tokenizer.p.vocab_size - 1)
+    # Stable digest + per-read counter: reproducible under a fixed p.seed
+    # (python hash() is salted per process) while re-randomizing each
+    # epoch's span like the reference mass_op.
+    self._record_counter += 1
+    seed = (zlib.crc32(record) ^ (p.seed * 2654435761) ^
+            (self._record_counter * 40503)) & 0x7FFFFFFF
+    ex = mass.MassExample(ids_row[0][:n], mask_id, seed=seed,
+                          mask_ratio=p.mask_ratio)
+    return NestedMap(
+        src=NestedMap(ids=ex.src.ids, paddings=np.zeros(n, np.float32)),
+        tgt=NestedMap(ids=ex.tgt.ids, labels=ex.tgt.labels,
+                      paddings=(1.0 - ex.tgt.weights).astype(np.float32)),
+        bucket_key=n)

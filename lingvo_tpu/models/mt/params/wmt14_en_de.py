@@ -181,3 +181,62 @@ class WmtEnDeRNMTPlusTiny(WmtEnDeRNMTPlus):
     p.train.max_steps = 60
     p.train.tpu_steps_per_loop = 20
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeMassPretrain(WmtEnDeTransformerBase):
+  """MASS masked-seq2seq pretraining over monolingual data (ref
+  `core/ops/mass_op.cc` + the MASS recipes under `tasks/mt/params/`):
+  same transformer as WmtEnDeTransformerBase, trained to reconstruct
+  masked spans; fine-tune by warm-starting the MT config from its
+  checkpoint (core/checkpointer.py init_from_checkpoint_rules)."""
+
+  def Train(self):
+    return input_generator.SyntheticMassInput.Params().Set(
+        batch_size=self.BATCH_SIZE, vocab_size=self.VOCAB,
+        seq_len=self.SRC_LEN)
+
+  def Test(self):
+    return self.Train().Set(seed=123)
+
+  def Task(self):
+    p = super().Task()
+    p.name = "wmt14_en_de_mass"
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeMassPretrainTiny(WmtEnDeTransformerTiny):
+  """Smoke-scale MASS pretraining (pairs with WmtEnDeTransformerTiny for
+  the pretrain -> fine-tune path)."""
+
+  def Train(self):
+    return input_generator.SyntheticMassInput.Params().Set(
+        batch_size=self.BATCH_SIZE, vocab_size=self.VOCAB,
+        seq_len=self.SRC_LEN)
+
+  def Test(self):
+    return self.Train().Set(seed=123)
+
+  def Task(self):
+    p = super().Task()
+    p.name = "wmt14_en_de_mass_tiny"
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeMassFinetuneTiny(WmtEnDeTransformerTiny):
+  """Fine-tune fixture whose source sentences share the MASS pretraining
+  distribution (strided sequences) — the pretrain -> fine-tune pair models
+  monolingual pretraining + same-domain translation."""
+
+  def Train(self):
+    return super().Train().Set(strided=True)
+
+  def Test(self):
+    return super().Test().Set(strided=True)
+
+  def Task(self):
+    p = super().Task()
+    p.name = "wmt14_en_de_mass_ft"
+    return p

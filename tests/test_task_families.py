@@ -85,6 +85,37 @@ class TestMilan:
     assert batch.text_paddings.shape == (4, 4)
     assert (batch.text_ids >= 0).all()
 
+  def test_padded_flush_rows_excluded(self):
+    """Padded rows in a finite-epoch flush batch (all-padding text) must
+    not serve as contrastive examples or count in recall."""
+    from lingvo_tpu.core.nested_map import NestedMap
+    mp = model_registry.GetParams("milan.dual_encoder.MilanImageText",
+                                  "Train")
+    mp.task.input = mp.input
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = mp.input.Instantiate()
+    batch = gen.GetPreprocessedInputBatch()
+    b = batch.image.shape[0]
+    # fabricate a flush batch: last half is padding rows
+    half = b // 2
+    batch.image[half:] = 0.0
+    batch.text_ids[half:] = 0
+    batch.text_paddings[half:] = 1.0
+    jbatch = batch.Transform(jnp.asarray)
+    preds = jax.jit(task.ComputePredictions)(state.theta, jbatch)
+    assert np.allclose(np.asarray(preds.example_weights[:half]), 1.0)
+    assert np.allclose(np.asarray(preds.example_weights[half:]), 0.0)
+    metrics, _ = task.ComputeLoss(state.theta, preds, jbatch)
+    assert float(metrics.loss[1]) == half  # weight counts real rows only
+    assert np.isfinite(float(metrics.loss[0]))
+    # decode recall averages over real rows only
+    dec = jax.jit(task.Decode)(state.theta, jbatch)
+    m = task.CreateDecoderMetrics()
+    task.PostProcessDecodeOut(jax.tree_util.tree_map(np.asarray, dec), m)
+    assert m["recall_at_1"].total_weight == half
+
   def test_contrastive_retrieval_learns(self):
     task, state, losses, out, gen = _train("milan.dual_encoder.MilanDualEncoder", 60)
     assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
