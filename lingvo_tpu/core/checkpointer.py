@@ -61,9 +61,39 @@ class Checkpointer:
   def _SanityCheck(self, state: NestedMap) -> None:
     """All saved floats must be finite (ref saver.py IsFinite checks).
 
-    Fast path: one device-side all-finite reduce -> one scalar transfer.
-    Only on failure do we walk leaves host-side to name the offender.
+    Single-process fast path: one device-side all-finite reduce -> one
+    scalar transfer; only on failure walk leaves host-side to name the
+    offender. Multi-process: each host checks ONLY its addressable shards
+    (what it will write) — eager cross-host reductions outside an
+    explicitly coordinated jit can deadlock the collective runtime.
     """
+    if jax.process_count() > 1:
+      import jax.numpy as jnp
+      bad_path = None
+      for path, leaf in state.FlattenItems():
+        if not (isinstance(leaf, jax.Array) and
+                jnp.issubdtype(leaf.dtype, jnp.floating)):
+          continue
+        # device-side per-shard reduce: one scalar transfer per local
+        # shard, no cross-host collectives
+        for s in leaf.addressable_shards:
+          if not bool(jnp.isfinite(s.data).all()):
+            bad_path = path
+            break
+        if bad_path:
+          break
+      # Coordinated verdict: every host must agree before entering (or
+      # skipping) the collective orbax save, else the healthy hosts hang
+      # in the save barrier waiting for the raising one.
+      from jax.experimental import multihost_utils
+      all_ok = multihost_utils.process_allgather(
+          np.asarray([bad_path is None]))
+      if not bool(np.all(all_ok)):
+        raise ValueError(
+            "Checkpoint sanity check failed: non-finite values"
+            + (f" in {bad_path} (this host)" if bad_path else
+               " on another host"))
+      return
     if bool(py_utils.IsFinite(state)):
       return
     for path, leaf in state.FlattenItems():

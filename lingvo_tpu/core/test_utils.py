@@ -1,0 +1,104 @@
+"""Test harness utilities: self-rewriting golden values + numeric-gradient
+checks (ref `lingvo/core/test_utils.py:406-468` ReplaceGoldenSingleFloat /
+CompareToGoldenSingleFloat / ComputeNumericGradient).
+
+Golden tests lock layer numerics against silent drift: the deterministic
+name-derived variable seeds (core/base_layer.py) make outputs reproducible,
+so a stored float pins the whole init+FProp path. Run with
+`LINGVO_TPU_UPDATE_GOLDENS=1 pytest ...` to rewrite mismatched goldens
+in-place in the calling test file (call sites must be one-liners, same
+contract as the reference).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+
+import numpy as np
+
+_GOLDEN_CALL_RE = re.compile(
+    r"(?P<prefix>.*)\bCompareToGoldenSingleFloat\(\s*"
+    r"[-+]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][-+]?\d+)?\s*,\s*"
+    r"(?P<rest>.*)\)(?P<postfix>.*)\n")
+
+
+def _ReplaceOneLineInFile(fpath: str, linenum: int, old: str,
+                          new: str) -> None:
+  with open(fpath) as f:
+    lines = f.readlines()
+  assert lines[linenum] == old, (
+      f"Expected {lines[linenum]!r} at line {linenum + 1} in {fpath}, "
+      f"got {old!r}")
+  lines[linenum] = new
+  with open(fpath, "w") as f:
+    f.writelines(lines)
+
+
+def _ReplaceGoldenSingleFloat(old_line: str, value: float) -> str:
+  m = _GOLDEN_CALL_RE.match(old_line)
+  assert m, (
+      "CompareToGoldenSingleFloat call site must be a one-liner with a "
+      f"float literal first argument; got: {old_line!r}")
+  assert old_line.count("(") == old_line.count(")"), (
+      "CompareToGoldenSingleFloat call site spans multiple lines "
+      f"(unbalanced parens) — make it a one-liner: {old_line!r}")
+  return (f"{m.group('prefix')}CompareToGoldenSingleFloat("
+          f"{value:.6f}, {m.group('rest')}){m.group('postfix')}\n")
+
+
+def _GoldenCallSite():
+  """(fpath, linenum, old_line) of the nearest caller line containing the
+  golden comparison (ref ReplaceGoldenStackAnalysis)."""
+  for frame in inspect.stack():
+    ctx = frame.code_context
+    if ctx and "CompareToGoldenSingleFloat" in ctx[0] and (
+        frame.filename != __file__):
+      return frame.filename, frame.lineno - 1, ctx[0]
+  raise AssertionError("no CompareToGoldenSingleFloat call site found")
+
+
+def UpdateGoldensEnabled() -> bool:
+  return bool(os.environ.get("LINGVO_TPU_UPDATE_GOLDENS"))
+
+
+def CompareToGoldenSingleFloat(golden: float, value, rtol: float = 1e-5,
+                               atol: float = 1e-6) -> None:
+  """Asserts `value` == the stored golden float; under
+  LINGVO_TPU_UPDATE_GOLDENS=1 rewrites the golden literal in the calling
+  test source instead (one-liner call sites only)."""
+  value = float(np.asarray(value))
+  if UpdateGoldensEnabled():
+    if not np.isclose(golden, value, rtol=rtol, atol=atol):
+      fpath, linenum, old_line = _GoldenCallSite()
+      _ReplaceOneLineInFile(fpath, linenum, old_line,
+                            _ReplaceGoldenSingleFloat(old_line, value))
+    return
+  np.testing.assert_allclose(
+      value, golden, rtol=rtol, atol=atol,
+      err_msg=("golden mismatch — if the change is intentional, rerun with "
+               "LINGVO_TPU_UPDATE_GOLDENS=1 to rewrite"))
+
+
+def ComputeNumericGradient(fn, x, delta: float = 1e-4,
+                           step: int = 1) -> np.ndarray:
+  """Central-difference gradient of scalar fn at x (ref
+  ComputeNumericGradient): checks custom VJPs against finite differences.
+
+  x: np array; returns d fn / d x with every `step`-th element probed
+  (others zero) to bound cost on big tensors.
+  """
+  x = np.asarray(x, np.float64)
+  grad = np.zeros_like(x)
+  flat = x.reshape(-1)
+  gflat = grad.reshape(-1)
+  for i in range(0, flat.size, step):
+    orig = flat[i]
+    flat[i] = orig + delta
+    fp = float(fn(x.reshape(x.shape)))
+    flat[i] = orig - delta
+    fm = float(fn(x.reshape(x.shape)))
+    flat[i] = orig
+    gflat[i] = (fp - fm) / (2.0 * delta)
+  return grad

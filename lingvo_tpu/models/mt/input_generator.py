@@ -192,7 +192,11 @@ class MassFileInput(base_input_generator.FileBasedSequenceInputGenerator):
     p.Define("max_length", 64, "Max tokens per sentence.")
     p.Define("mask_ratio", 0.5, "Masked span fraction.")
     p.Define("mask_id", None,
-             "Mask token id (None = tokenizer vocab_size - 1).")
+             "Mask token id — MUST be an id the tokenizer never produces "
+             "(reserve one in the vocab, as the reference's MASS recipes "
+             "do). None auto-derives vocab_size - 1 for AsciiTokenizer "
+             "only (its id space tops out at 73); other tokenizers "
+             "require an explicit value.")
     return p
 
   def __init__(self, params):
@@ -209,8 +213,17 @@ class MassFileInput(base_input_generator.FileBasedSequenceInputGenerator):
     n = int((1.0 - pad_row[0]).sum())
     if n <= 3:
       return None
-    mask_id = p.mask_id if p.mask_id is not None else (
-        self.tokenizer.p.vocab_size - 1)
+    if p.mask_id is not None:
+      mask_id = p.mask_id
+    else:
+      from lingvo_tpu.core import tokenizers
+      if not isinstance(self.tokenizer, tokenizers.AsciiTokenizer):
+        raise ValueError(
+            "MassFileInput.mask_id must be set explicitly for "
+            f"{type(self.tokenizer).__name__}: vocab_size - 1 is a real "
+            "token there, and a colliding mask id silently corrupts the "
+            "MASS signal.")
+      mask_id = self.tokenizer.p.vocab_size - 1  # ascii ids end at 73
     # Stable digest + per-read counter: reproducible under a fixed p.seed
     # (python hash() is salted per process) while re-randomizing each
     # epoch's span like the reference mass_op.
