@@ -89,7 +89,10 @@ def ComputeNumericGradient(fn, x, delta: float = 1e-4,
   x: np array; returns d fn / d x with every `step`-th element probed
   (others zero) to bound cost on big tensors.
   """
-  x = np.asarray(x, np.float64)
+  # Fresh C-contiguous copy: flat writes must alias x (asarray of a
+  # non-contiguous input would make reshape(-1) a copy and the probes
+  # no-ops), and the caller's array must never be mutated.
+  x = np.array(x, np.float64, order="C")
   grad = np.zeros_like(x)
   flat = x.reshape(-1)
   gflat = grad.reshape(-1)

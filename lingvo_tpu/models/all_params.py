@@ -39,3 +39,7 @@ try:
   from lingvo_tpu.models.car.params import kitti  # noqa: F401
 except ImportError:
   pass
+try:
+  from lingvo_tpu.models.car.params import waymo  # noqa: F401
+except ImportError:
+  pass

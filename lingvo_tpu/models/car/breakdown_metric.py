@@ -139,6 +139,21 @@ def ByNumPoints(edges=(1, 50, 200, 100000),
                            bin_preds_by_matched_gt=True)
 
 
+def ByDifficulty(iou_threshold: float = 0.5) -> BreakdownApMetric:
+  """AP per Waymo difficulty level (LEVEL_1 / LEVEL_2, ref waymo metrics
+  config + `breakdown_metric.py` difficulty slicing). Annotate gt boxes
+  with the difficulty in column 7 ([..., 8] boxes); predictions are 7-DOF
+  and bin by their matched gt."""
+  labels = ["level_1", "level_2"]
+
+  def _Bin(gt):
+    d = int(gt[7]) if len(gt) > 7 else 1
+    return min(max(d, 1), 2) - 1
+
+  return BreakdownApMetric(labels, _Bin, iou_threshold,
+                           bin_preds_by_matched_gt=True)
+
+
 def CountPointsInBoxes(points: np.ndarray, boxes: np.ndarray) -> np.ndarray:
   """points [N, >=3], boxes [G, 7] -> [G] count of points inside each
   (rotated BEV footprint x z-extent)."""

@@ -168,70 +168,16 @@ class KittiSceneInputGenerator(
       gt_boxes[i] = bx
       gt_classes[i] = cl
 
-    # pillar + grid-target views (same scheme as SyntheticCarInput), with
-    # world->grid scaling so real KITTI ranges (x in [0, 70.4),
-    # y in [-40, 40)) map onto the g x g BEV grid
-    g = p.grid_size
-    x_lo, x_hi = p.grid_range_x
-    y_lo, y_hi = p.grid_range_y
-
-    def _CellXY(x, y):
-      """World xy -> (col, row) grid indices, or None when out of range."""
-      if not (x_lo <= x < x_hi and y_lo <= y < y_hi):
-        return None
-      col = int((x - x_lo) / (x_hi - x_lo) * g)
-      row = int((y - y_lo) / (y_hi - y_lo) * g)
-      return min(col, g - 1), min(row, g - 1)
-
-    pillars = np.zeros((p.max_pillars, p.points_per_pillar, 4), np.float32)
-    ppad = np.ones((p.max_pillars, p.points_per_pillar), np.float32)
-    cells = np.full((p.max_pillars,), -1, np.int32)
-    cls_t = np.zeros((g * g,), np.int32)
-    reg_t = np.zeros((g * g, 7), np.float32)
-    reg_w = np.zeros((g * g,), np.float32)
-    real = lasers[lpad == 0]
-    if len(real):
-      cell_of = np.full((len(real),), -1, np.int64)
-      for i, pt in enumerate(real):
-        cr = _CellXY(float(pt[0]), float(pt[1]))
-        if cr is not None:
-          cell_of[i] = cr[1] * g + cr[0]
-      order = np.argsort(cell_of, kind="stable")
-      order = order[cell_of[order] >= 0]
-      pi = -1
-      last_cell = None
-      fill = 0
-      for idx in order:
-        c = cell_of[idx]
-        if c != last_cell:
-          pi += 1
-          if pi >= p.max_pillars:
-            break
-          last_cell = c
-          cells[pi] = c
-          fill = 0
-        if fill < p.points_per_pillar:
-          pillars[pi, fill] = real[idx]
-          ppad[pi, fill] = 0.0
-          fill += 1
-    cell_w = (x_hi - x_lo) / g
-    cell_h = (y_hi - y_lo) / g
-    for bx, cl in zip(boxes, classes):
-      cr = _CellXY(float(bx[0]), float(bx[1]))
-      if cr is None:
-        continue
-      col, row = cr
-      cell = row * g + col
-      cx_center = x_lo + (col + 0.5) * cell_w
-      cy_center = y_lo + (row + 0.5) * cell_h
-      cls_t[cell] = cl
-      reg_t[cell] = [bx[0] - cx_center, bx[1] - cy_center,
-                     bx[2], bx[3], bx[4], bx[5], bx[6]]
-      reg_w[cell] = 1.0
-
-    return NestedMap(
+    # pillar + grid-target views (shared assembly), with world->grid
+    # scaling so real KITTI ranges (x in [0, 70.4), y in [-40, 40)) map
+    # onto the g x g BEV grid
+    views = detection_3d.SceneToDetectionViews(
+        lasers, lpad, boxes, classes,
+        grid_size=p.grid_size, grid_range_x=p.grid_range_x,
+        grid_range_y=p.grid_range_y, max_pillars=p.max_pillars,
+        points_per_pillar=p.points_per_pillar)
+    views.update(
         bucket_key=1,
-        pillar_points=pillars, point_paddings=ppad, pillar_cells=cells,
-        cls_targets=cls_t, reg_targets=reg_t, reg_weights=reg_w,
         lasers=lasers, laser_paddings=lpad,
         gt_boxes=gt_boxes, gt_classes=gt_classes)
+    return views

@@ -1,0 +1,162 @@
+"""Waymo Open Dataset-format input over the native record yielder.
+
+Re-designs `lingvo/tasks/car/waymo/waymo_open_input_generator.py` (frame
+metadata + multi-laser extraction + label extraction with speed and
+difficulty) for the TPU-native pipeline: records flow through the C++
+shuffle-ring yielder as JSON-line frames instead of TFRecords of waymo
+protos, and featurization happens host-side in numpy with on-device target
+assignment downstream (same split as the KITTI path).
+
+Record format (one JSON object per line):
+  {"lasers": {"TOP": [[x, y, z, intensity, elongation], ...], ...}
+     or "points": [[x, y, z, intensity, elongation], ...],
+   "labels": [{"box": [cx, cy, cz, l, w, h, heading],   # vehicle frame
+               "type": "TYPE_VEHICLE" | 1,
+               "num_points": 17,            # optional
+               "difficulty": 1 | 2,          # optional (derived if absent)
+               "speed": [vx, vy],            # optional
+               "accel": [ax, ay]}, ...],
+   "pose": [16 floats],                      # optional world<-SDC 4x4
+   "run_segment": "...", "time_of_day": "Day", "weather": "sunny"}
+
+Waymo gives 7-DOF boxes directly in the vehicle frame (no camera->velo
+conversion) and 2 extra per-point features (intensity, elongation) vs
+KITTI's reflectance — point_dim is 5.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from lingvo_tpu.core import base_input_generator
+from lingvo_tpu.core.nested_map import NestedMap
+
+# ref waymo_open_dataset label.proto Type enum
+WAYMO_CLASS_IDS = {
+    "TYPE_VEHICLE": 1,
+    "TYPE_PEDESTRIAN": 2,
+    "TYPE_SIGN": 3,
+    "TYPE_CYCLIST": 4,
+}
+POINT_DIM = 5  # x, y, z, intensity, elongation
+
+# ref waymo difficulty: boxes with <= 5 points are LEVEL_2
+LEVEL_2_MAX_POINTS = 5
+
+
+def ParseWaymoLabel(obj: dict, keep_classes: int):
+  """Label dict -> (box7, class_id, num_points, difficulty, speed2) or
+  None for out-of-split / malformed labels."""
+  box = np.asarray(obj.get("box", ()), np.float32).reshape(-1)
+  if box.shape != (7,):
+    return None
+  cls = obj.get("type", 0)
+  if isinstance(cls, str):
+    cls = WAYMO_CLASS_IDS.get(cls, 0)
+  cls = int(cls)
+  if not 0 < cls <= keep_classes:
+    return None
+  num_points = int(obj.get("num_points", 0))
+  difficulty = obj.get("difficulty")
+  if difficulty is None:
+    difficulty = 2 if num_points <= LEVEL_2_MAX_POINTS else 1
+  speed = np.zeros((2,), np.float32)
+  if obj.get("speed") is not None:
+    sp = np.asarray(obj["speed"], np.float32).reshape(-1)[:2]
+    speed[:len(sp)] = sp
+  return box, cls, num_points, int(difficulty), speed
+
+
+class WaymoSceneInputGenerator(
+    base_input_generator.FileBasedSequenceInputGenerator):
+  """JSON-line Waymo frames -> fixed-shape detection batches.
+
+  Emits the KITTI-path fields (pillar/grid views + gt boxes/classes) plus
+  Waymo extras: gt_difficulty, gt_num_points, gt_speed — what the
+  per-difficulty/per-range breakdown metrics slice on (ref
+  waymo_open_input_generator.WaymoLaserExtractor + label extraction).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("max_points", 4096, "Lasers padded/subsampled to this count.")
+    p.Define("max_objects", 64, "GT boxes padded to this count.")
+    p.Define("grid_size", 64, "BEV grid cells per axis.")
+    p.Define("grid_range_x", (-76.8, 76.8),
+             "(min, max) world x covered by the grid (ref waymo.py "
+             "pointpillars ranges).")
+    p.Define("grid_range_y", (-76.8, 76.8), "(min, max) world y.")
+    p.Define("max_pillars", 512, "P.")
+    p.Define("points_per_pillar", 16, "N.")
+    p.Define("num_classes", 4,
+             "Foreground classes kept in WAYMO_CLASS_IDS order "
+             "(1 keeps only vehicles).")
+    p.bucket_upper_bound = [1]
+    return p
+
+  def __init__(self, params):
+    params = params.Copy()
+    params.bucket_upper_bound = [1]
+    params.bucket_batch_limit = [params.batch_size or 2]
+    super().__init__(params)
+    self._record_counter = 0
+
+  def ProcessRecord(self, record: bytes):
+    p = self.p
+    self._record_counter += 1
+    try:
+      frame = json.loads(record.decode("utf-8"))
+      if not isinstance(frame, dict):
+        return None
+      if "lasers" in frame:
+        clouds = [np.asarray(v, np.float32).reshape(-1, POINT_DIM)
+                  for v in frame["lasers"].values()]
+        pts = (np.concatenate(clouds, axis=0) if clouds
+               else np.zeros((0, POINT_DIM), np.float32))
+      else:
+        pts = np.asarray(frame.get("points", []),
+                         np.float32).reshape(-1, POINT_DIM)
+      labels = [ParseWaymoLabel(o, p.num_classes)
+                for o in frame.get("labels", [])]
+    except (UnicodeDecodeError, json.JSONDecodeError, ValueError,
+            TypeError, AttributeError):
+      return None  # malformed frame: drop, never kill the pipeline
+    labels = [l for l in labels if l is not None]
+
+    from lingvo_tpu.models.car import detection_3d
+    (lasers,), lpad = detection_3d.RandomPadOrTrimTo(
+        [pts], p.max_points,
+        key=self._record_counter * 2654435761 + len(pts))
+
+    gt_boxes = np.zeros((p.max_objects, 7), np.float32)
+    gt_classes = np.zeros((p.max_objects,), np.int32)
+    gt_difficulty = np.zeros((p.max_objects,), np.int32)
+    gt_num_points = np.zeros((p.max_objects,), np.int32)
+    gt_speed = np.zeros((p.max_objects, 2), np.float32)
+    boxes, classes = [], []
+    for i, (box, cls, npts, diff, speed) in enumerate(labels):
+      if i >= p.max_objects:
+        break
+      gt_boxes[i] = box
+      gt_classes[i] = cls
+      gt_difficulty[i] = diff
+      gt_num_points[i] = npts
+      gt_speed[i] = speed
+      boxes.append(box)
+      classes.append(cls)
+
+    views = detection_3d.SceneToDetectionViews(
+        lasers, lpad, boxes, classes,
+        grid_size=p.grid_size, grid_range_x=p.grid_range_x,
+        grid_range_y=p.grid_range_y, max_pillars=p.max_pillars,
+        points_per_pillar=p.points_per_pillar)
+    views.update(
+        bucket_key=1,
+        lasers=lasers, laser_paddings=lpad,
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+        gt_difficulty=gt_difficulty, gt_num_points=gt_num_points,
+        gt_speed=gt_speed)
+    return views

@@ -202,6 +202,18 @@ class MassFileInput(base_input_generator.FileBasedSequenceInputGenerator):
   def __init__(self, params):
     super().__init__(params)
     self._record_counter = 0
+    p = self.p
+    if p.mask_id is not None:
+      self._mask_id = p.mask_id
+    else:
+      from lingvo_tpu.core import tokenizers
+      if not isinstance(self.tokenizer, tokenizers.AsciiTokenizer):
+        raise ValueError(
+            "MassFileInput.mask_id must be set explicitly for "
+            f"{type(self.tokenizer).__name__}: vocab_size - 1 is a real "
+            "token there, and a colliding mask id silently corrupts the "
+            "MASS signal.")
+      self._mask_id = self.tokenizer.p.vocab_size - 1  # ascii ids end at 73
 
   def ProcessRecord(self, record: bytes):
     from lingvo_tpu.core import mass
@@ -213,17 +225,7 @@ class MassFileInput(base_input_generator.FileBasedSequenceInputGenerator):
     n = int((1.0 - pad_row[0]).sum())
     if n <= 3:
       return None
-    if p.mask_id is not None:
-      mask_id = p.mask_id
-    else:
-      from lingvo_tpu.core import tokenizers
-      if not isinstance(self.tokenizer, tokenizers.AsciiTokenizer):
-        raise ValueError(
-            "MassFileInput.mask_id must be set explicitly for "
-            f"{type(self.tokenizer).__name__}: vocab_size - 1 is a real "
-            "token there, and a colliding mask id silently corrupts the "
-            "MASS signal.")
-      mask_id = self.tokenizer.p.vocab_size - 1  # ascii ids end at 73
+    mask_id = self._mask_id
     # Stable digest + per-read counter: reproducible under a fixed p.seed
     # (python hash() is salted per process) while re-randomizing each
     # epoch's span like the reference mass_op.

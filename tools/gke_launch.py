@@ -1,12 +1,25 @@
 #!/usr/bin/env python
-"""Emits Kubernetes YAML for a TPU training job + follower evaler/decoder +
-tensorboard (ref `lingvo/tools/gke_launch.py` up/down/reload verbs; this
-writes the manifests — apply them with kubectl)."""
+"""GKE launcher: build/up/down/reload verbs around a TPU training job +
+follower evaler + tensorboard (ref `lingvo/tools/gke_launch.py:398` verb
+dispatch; `print` emits the manifests, `build` docker-builds + pushes the
+image, `up` applies, `down` deletes, `reload` = down + up).
+
+Examples:
+  gke_launch.py print --name=lm1 --model=lm.synthetic_packed_input.DenseLm8B \
+      --image=gcr.io/proj/lingvo-tpu:live --logdir=gs://bucket/lm1
+  gke_launch.py build --image=gcr.io/proj/lingvo-tpu:live
+  gke_launch.py up --name=lm1 ... [--build]
+  gke_launch.py down --name=lm1
+  gke_launch.py reload --name=lm1 ...
+"""
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
+import tempfile
 
 JOB_TEMPLATE = """\
 apiVersion: batch/v1
@@ -54,19 +67,7 @@ spec:
 """
 
 
-def main(argv=None):
-  ap = argparse.ArgumentParser(description=__doc__)
-  ap.add_argument("--name", required=True)
-  ap.add_argument("--model", required=True)
-  ap.add_argument("--image", required=True)
-  ap.add_argument("--logdir", required=True, help="GCS path.")
-  ap.add_argument("--accelerator", default="tpu-v5p-slice")
-  ap.add_argument("--topology", default="2x2x1")
-  ap.add_argument("--num_chips", type=int, default=4)
-  ap.add_argument("--with_evaler", action="store_true")
-  ap.add_argument("--output", default="-")
-  args = ap.parse_args(argv)
-
+def BuildManifests(args) -> str:
   docs = [JOB_TEMPLATE.format(
       name=f"{args.name}-train", model=args.model, image=args.image,
       logdir=args.logdir, mode="train", job="executor_tpu",
@@ -79,13 +80,118 @@ def main(argv=None):
         accelerator=args.accelerator, topology=args.topology, num_chips=1))
   docs.append(TB_TEMPLATE.format(name=args.name, image=args.image,
                                  logdir=args.logdir))
-  yaml = "---\n".join(docs)
+  return "---\n".join(docs)
+
+
+def _Run(cmd: list[str], dry_run: bool) -> int:
+  print("+ " + " ".join(cmd), file=sys.stderr)
+  if dry_run:
+    return 0
+  return subprocess.call(cmd)
+
+
+def DoPrint(args) -> int:
+  yaml = BuildManifests(args)
   if args.output == "-":
     print(yaml)
   else:
     with open(args.output, "w") as f:
       f.write(yaml)
   return 0
+
+
+def DoBuild(args) -> int:
+  """docker build + push (ref gke_launch build_docker_image)."""
+  root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  rc = _Run(["docker", "build", "-t", args.image, "-f", args.dockerfile,
+             root], args.dry_run)
+  if rc:
+    return rc
+  return _Run(["docker", "push", args.image], args.dry_run)
+
+
+def DoUp(args) -> int:
+  if args.build:
+    rc = DoBuild(args)
+    if rc:
+      return rc
+  with tempfile.NamedTemporaryFile(
+      "w", suffix=".yaml", delete=False) as f:
+    f.write(BuildManifests(args))
+    path = f.name
+  try:
+    return _Run(["kubectl", "apply", "-f", path], args.dry_run)
+  finally:
+    # dry-run keeps the manifest so the printed command is replayable
+    if not args.keep_manifest and not args.dry_run:
+      os.unlink(path)
+
+
+def DoDown(args) -> int:
+  rc = 0
+  for resource in (f"job/{args.name}-train", f"job/{args.name}-evaler",
+                   f"deployment/{args.name}-tensorboard"):
+    rc |= _Run(["kubectl", "delete", "--ignore-not-found", resource],
+               args.dry_run)
+  return rc
+
+
+def DoReload(args) -> int:
+  rc = DoDown(args)
+  if rc:
+    return rc
+  return DoUp(args)
+
+
+def _AddCommonFlags(ap, need_model: bool):
+  ap.add_argument("--name", required=True)
+  ap.add_argument("--image", required=need_model)
+  if need_model:
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--logdir", required=True, help="GCS path.")
+    ap.add_argument("--accelerator", default="tpu-v5p-slice")
+    ap.add_argument("--topology", default="2x2x1")
+    ap.add_argument("--num_chips", type=int, default=4)
+    ap.add_argument("--with_evaler", action="store_true")
+  ap.add_argument("--dry_run", action="store_true",
+                  help="Print the docker/kubectl commands, don't run them.")
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  sub = ap.add_subparsers(dest="verb", required=True)
+
+  p_print = sub.add_parser("print", help="Emit manifests.")
+  _AddCommonFlags(p_print, need_model=True)
+  p_print.add_argument("--output", default="-")
+  p_print.set_defaults(fn=DoPrint)
+
+  p_build = sub.add_parser("build", help="docker build + push the image.")
+  p_build.add_argument("--image", required=True)
+  p_build.add_argument("--dockerfile", default="docker/dev.dockerfile")
+  p_build.add_argument("--dry_run", action="store_true")
+  p_build.set_defaults(fn=DoBuild)
+
+  p_up = sub.add_parser("up", help="Apply manifests (optionally build).")
+  _AddCommonFlags(p_up, need_model=True)
+  p_up.add_argument("--build", action="store_true")
+  p_up.add_argument("--dockerfile", default="docker/dev.dockerfile")
+  p_up.add_argument("--keep_manifest", action="store_true")
+  p_up.set_defaults(fn=DoUp)
+
+  p_down = sub.add_parser("down", help="Delete the jobs + tensorboard.")
+  _AddCommonFlags(p_down, need_model=False)
+  p_down.set_defaults(fn=DoDown)
+
+  p_reload = sub.add_parser("reload", help="down then up.")
+  _AddCommonFlags(p_reload, need_model=True)
+  p_reload.add_argument("--build", action="store_true")
+  p_reload.add_argument("--dockerfile", default="docker/dev.dockerfile")
+  p_reload.add_argument("--keep_manifest", action="store_true")
+  p_reload.set_defaults(fn=DoReload)
+
+  args = ap.parse_args(argv)
+  return args.fn(args)
 
 
 if __name__ == "__main__":
