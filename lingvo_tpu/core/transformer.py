@@ -192,8 +192,13 @@ class TransformerLayer(base_layer.BaseLayer):
         segment_ids=segment_ids)
     if self.p.has_aux_atten:
       assert aux_vecs is not None
-      x, _ = self.aux_atten.FProp(
+      x, aux_probs = self.aux_atten.FProp(
           theta.aux_atten, x, source_vecs=aux_vecs, paddings=aux_paddings)
+      # consumers that need alignment (e.g. XEnDec target lambdas) collect
+      # per-layer cross-attention probs trace-side, no API change
+      coll = py_utils.NamedCollectionTop("cross_atten_probs")
+      if coll is not None and aux_probs is not None:
+        coll[self.path] = aux_probs
     return self.fflayer.FProp(theta.fflayer, x, paddings)
 
   def InitStates(self, theta, batch_size, max_len):

@@ -151,3 +151,12 @@ class ApMetric:
   @property
   def num_ground_truth(self) -> int:
     return sum(self._num_gt.values())
+
+  @property
+  def detections(self) -> list[tuple[float, bool]]:
+    """All accumulated (score, matched) pairs across classes — the stream
+    calibration metrics consume."""
+    out = []
+    for matches in self._matches.values():
+      out.extend(matches)
+    return out

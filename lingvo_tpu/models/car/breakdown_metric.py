@@ -22,8 +22,14 @@ from lingvo_tpu.models.car import ap_metric
 class BreakdownApMetric:
   """AP per bin of a ground-truth attribute."""
 
+  # matched-gt binning only honors overlaps at least this fraction of the
+  # AP match threshold; weaker touches stay pure FPs (KITTI's min-overlap
+  # rule for ignored regions)
+  _MIN_MATCH_FRACTION = 0.5
+
   def __init__(self, bin_edges, bin_of_gt, iou_threshold: float = 0.5,
-               bin_preds_by_matched_gt: bool = False):
+               bin_preds_by_matched_gt: bool = False,
+               cumulative: bool = False):
     """bin_edges: labels only (len = num bins); bin_of_gt(gt_box [7]) ->
     bin index in [0, num_bins) or -1 to exclude.
 
@@ -33,10 +39,20 @@ class BreakdownApMetric:
     breakdown_metric.ByNumPoints:471). Unmatched predictions (no
     overlapping gt) are pure false positives and count against every bin,
     matching the KITTI slicing convention.
+
+    cumulative: bin b scores gts with bin <= b (the KITTI easy/moderate/
+    hard protocol: moderate includes easy boxes; detections matched to
+    HARDER gts are ignored, ref kitti_ap_metric.py gt_ignore semantics).
     """
     self._labels = list(bin_edges)
     self._bin_of_gt = bin_of_gt
     self._bin_preds_by_matched_gt = bin_preds_by_matched_gt
+    self._cumulative = cumulative
+    self._iou_threshold = iou_threshold
+    if cumulative:
+      assert bin_preds_by_matched_gt, (
+          "cumulative slicing needs matched-gt prediction binning to "
+          "implement the ignore-harder-gt rule")
     self._metrics = [ap_metric.ApMetric(iou_threshold)
                      for _ in self._labels]
 
@@ -45,7 +61,13 @@ class BreakdownApMetric:
 
   def _MatchedGtBins(self, pred_boxes, gt_boxes, gt_bins,
                      pred_classes, gt_classes):
-    """Bin of each prediction's max-IoU same-class gt (sentinels above)."""
+    """Bin of each prediction's max-IoU same-class gt (sentinels above).
+
+    Overlaps below _MIN_MATCH_FRACTION of the AP threshold don't count as
+    matches: a grazing touch of a harder/excluded gt must stay a pure FP
+    rather than vanish from the other slices.
+    """
+    min_iou = self._MIN_MATCH_FRACTION * self._iou_threshold
     bins = np.full((len(pred_boxes),), self._UNMATCHED, np.int64)
     for i, pb in enumerate(pred_boxes):
       best_iou, best_j = 0.0, -1
@@ -56,7 +78,7 @@ class BreakdownApMetric:
         iou = ap_metric.RotatedIou(np.asarray(pb)[:7], np.asarray(gb)[:7])
         if iou > best_iou:
           best_iou, best_j = iou, j
-      if best_j >= 0:
+      if best_j >= 0 and best_iou >= min_iou:
         b = gt_bins[best_j]
         bins[i] = b if b >= 0 else self._EXCLUDED
     return bins
@@ -74,8 +96,12 @@ class BreakdownApMetric:
       pred_bins = np.array([self._bin_of_gt(g) for g in pred_boxes],
                            np.int64)
     for b, metric in enumerate(self._metrics):
-      sel = gt_bins == b
-      psel = pred_bins == b
+      if self._cumulative:
+        sel = (gt_bins >= 0) & (gt_bins <= b)
+        psel = (pred_bins >= 0) & (pred_bins <= b)
+      else:
+        sel = gt_bins == b
+        psel = pred_bins == b
       if self._bin_preds_by_matched_gt:
         # pure FPs penalize every bin; matched-to-excluded preds score
         # nowhere (their gt was deliberately out of protocol)
@@ -137,6 +163,21 @@ def ByNumPoints(edges=(1, 50, 200, 100000),
 
   return BreakdownApMetric(labels, _Bin, iou_threshold,
                            bin_preds_by_matched_gt=True)
+
+
+def ByKittiDifficulty(iou_threshold: float = 0.5) -> BreakdownApMetric:
+  """Cumulative easy/moderate/hard AP per the KITTI protocol (ref
+  `kitti_ap_metric.py`: moderate includes easy gts; matches to harder gts
+  are ignored). Annotate gt boxes with the difficulty code in column 7
+  (0 easy / 1 moderate / 2 hard, -1 to exclude; see
+  kitti_input.KittiDifficulty)."""
+  labels = ["easy", "moderate", "hard"]
+
+  def _Bin(gt):
+    return int(gt[7]) if len(gt) > 7 else 2
+
+  return BreakdownApMetric(labels, _Bin, iou_threshold,
+                           bin_preds_by_matched_gt=True, cumulative=True)
 
 
 def ByDifficulty(iou_threshold: float = 0.5) -> BreakdownApMetric:

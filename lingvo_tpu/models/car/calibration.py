@@ -1,0 +1,96 @@
+"""Detection calibration: reliability curves + expected calibration error
+(ref `lingvo/tasks/car/calibration_processing.py` CalibrationCurve /
+ExpectedCalibrationError / CalibrationCalculator).
+
+Consumes the same (score, hit) stream ApMetric accumulates: a detection's
+confidence should predict its probability of matching a ground truth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def CalibrationCurve(scores: np.ndarray, hits: np.ndarray,
+                     num_bins: int = 10):
+  """(scores [N], hits [N] 0/1) -> (mean_predicted, mean_empirical,
+  num_examples) per score bin (ref CalibrationCurve; bin 0 is skipped,
+  zero scores land in bin 1)."""
+  scores = np.asarray(scores, np.float64)
+  hits = np.asarray(hits, np.float64)
+  edges = np.linspace(0.0, 1.0, num_bins + 1)
+  bin_indices = np.digitize(scores, edges, right=True)
+  bin_indices = np.where(scores == 0.0, 1, bin_indices)
+  mean_pred, mean_emp, counts = [], [], []
+  for j in range(1, num_bins + 1):
+    idx = np.where(bin_indices == j)[0]
+    if len(idx):
+      mean_pred.append(float(np.mean(scores[idx])))
+      mean_emp.append(float(np.mean(hits[idx])))
+      counts.append(len(idx))
+    else:
+      mean_pred.append(float((edges[j - 1] + edges[j]) / 2.0))
+      mean_emp.append(0.0)
+      counts.append(0)
+  return np.asarray(mean_pred), np.asarray(mean_emp), np.asarray(counts)
+
+
+def ExpectedCalibrationError(confidence: np.ndarray,
+                             empirical_accuracy: np.ndarray,
+                             num_examples: np.ndarray,
+                             min_confidence: float | None = None) -> float:
+  """Count-weighted mean |empirical - predicted| over bins (ref
+  ExpectedCalibrationError)."""
+  confidence = np.asarray(confidence, np.float64)
+  empirical_accuracy = np.asarray(empirical_accuracy, np.float64)
+  num_examples = np.asarray(num_examples, np.float64)
+  ece = np.abs(empirical_accuracy - confidence) * num_examples
+  if min_confidence is not None:
+    keep = confidence > min_confidence
+    ece = ece[keep]
+    num_examples = num_examples[keep]
+  total = float(np.sum(num_examples))
+  return float(np.sum(ece) / total) if total else 0.0
+
+
+class CalibrationMetric:
+  """Accumulates (score, hit) detections; value = ECE.
+
+  Feed directly via Update, or adopt an ApMetric's match stream with
+  FromApMetric (the reference's CalibrationCalculator consumes the same
+  per-detection (prob, matched) pairs the AP pipeline produces).
+  """
+
+  def __init__(self, num_bins: int = 10,
+               min_confidence: float | None = None):
+    self._num_bins = num_bins
+    self._min_confidence = min_confidence
+    self._scores: list[float] = []
+    self._hits: list[float] = []
+
+  def Update(self, scores, hits) -> None:
+    self._scores.extend(float(s) for s in np.ravel(scores))
+    self._hits.extend(float(h) for h in np.ravel(hits))
+
+  def FromApMetric(self, ap_metric) -> "CalibrationMetric":
+    for score, matched in ap_metric.detections:
+      self._scores.append(float(score))
+      self._hits.append(1.0 if matched else 0.0)
+    return self
+
+  @property
+  def curve(self):
+    return CalibrationCurve(np.asarray(self._scores),
+                            np.asarray(self._hits), self._num_bins)
+
+  @property
+  def value(self) -> float:
+    if not self._scores:
+      return 0.0
+    mean_pred, mean_emp, counts = self.curve
+    return ExpectedCalibrationError(mean_pred, mean_emp, counts,
+                                    self._min_confidence)
+
+  @property
+  def total_weight(self) -> float:
+    return float(len(self._scores))

@@ -57,6 +57,25 @@ def ParseKittiLabelLine(line: str) -> dict:
   }
 
 
+def KittiDifficulty(obj: dict) -> int:
+  """KITTI protocol difficulty from 2D bbox height / occlusion /
+  truncation (ref kitti eval protocol thresholds used by
+  `kitti_ap_metric.py` MinHeight2D/DifficultyLevels):
+  0 easy (h>=40px, occ 0, trunc<=0.15), 1 moderate (h>=25, occ<=1,
+  trunc<=0.3), 2 hard (h>=25, occ<=2, trunc<=0.5), -1 excluded."""
+  bl, bt, br, bb = obj["bbox"]
+  height = abs(bb - bt)
+  occ = obj["occluded"]
+  trunc = obj["truncated"]
+  if height >= 40.0 and occ <= 0 and trunc <= 0.15:
+    return 0
+  if height >= 25.0 and occ <= 1 and trunc <= 0.30:
+    return 1
+  if height >= 25.0 and occ <= 2 and trunc <= 0.50:
+    return 2
+  return -1
+
+
 def VeloToCameraTransformation(calib: dict) -> np.ndarray:
   """4x4 velodyne->camera matrix from R0_rect (3x3) + Tr_velo_to_cam (3x4)
   (ref kitti_data.VeloToCameraTransformation:250)."""
@@ -143,7 +162,7 @@ class KittiSceneInputGenerator(
         cam_to_velo = CameraToVeloTransformation(scene["calib"])
     except (UnicodeDecodeError, json.JSONDecodeError, ValueError, TypeError):
       return None  # malformed record/geometry: drop, never kill the pipeline
-    boxes, classes = [], []
+    boxes, classes, difficulties = [], [], []
     for obj in labels:
       cls_id = CLASS_IDS.get(obj["type"], 0)
       if not 0 < cls_id <= p.num_classes:
@@ -153,6 +172,7 @@ class KittiSceneInputGenerator(
         continue
       boxes.append(bbox)
       classes.append(cls_id)
+      difficulties.append(KittiDifficulty(obj))
 
     # lasers: subsample-or-pad to max_points, varying the subsample per
     # record read so repeated epochs see different points
@@ -162,11 +182,13 @@ class KittiSceneInputGenerator(
 
     gt_boxes = np.zeros((p.max_objects, 7), np.float32)
     gt_classes = np.zeros((p.max_objects,), np.int32)
-    for i, (bx, cl) in enumerate(zip(boxes, classes)):
+    gt_difficulty = np.full((p.max_objects,), -1, np.int32)
+    for i, (bx, cl, df) in enumerate(zip(boxes, classes, difficulties)):
       if i >= p.max_objects:
         break
       gt_boxes[i] = bx
       gt_classes[i] = cl
+      gt_difficulty[i] = df
 
     # pillar + grid-target views (shared assembly), with world->grid
     # scaling so real KITTI ranges (x in [0, 70.4), y in [-40, 40)) map
@@ -179,5 +201,6 @@ class KittiSceneInputGenerator(
     views.update(
         bucket_key=1,
         lasers=lasers, laser_paddings=lpad,
-        gt_boxes=gt_boxes, gt_classes=gt_classes)
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+        gt_difficulty=gt_difficulty)
     return views

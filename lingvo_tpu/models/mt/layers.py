@@ -57,15 +57,25 @@ class TransformerEncoder(base_layer.BaseLayer):
             transformer_layer_params_tpl=tpl))
     self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
 
-  def FProp(self, theta, ids, paddings):
+  def EmbedTokens(self, theta, ids):
+    """[b, t] ids -> [b, t, d] token embeddings (no positional) — the
+    crossover point for XEnDec-style embedding mixing."""
+    return self.emb.EmbLookup(theta.emb, ids)
+
+  def FPropEmb(self, theta, token_embs, paddings):
+    """Runs the encoder from (possibly mixed) token embeddings."""
     p = self.p
-    x = self.emb.EmbLookup(theta.emb, ids)
-    x = x + self.pos_emb.FProp(NestedMap(), seq_length=ids.shape[1])[None]
+    x = token_embs + self.pos_emb.FProp(
+        NestedMap(), seq_length=token_embs.shape[1])[None].astype(
+            token_embs.dtype)
     if p.input_dropout_prob > 0:
       x = self.dropout.FProp(
           self.ChildTheta(theta, "dropout"), x,
           keep_prob=1.0 - p.input_dropout_prob)
     return self.stack.FProp(theta.stack, x, paddings)
+
+  def FProp(self, theta, ids, paddings):
+    return self.FPropEmb(theta, self.EmbedTokens(theta, ids), paddings)
 
 
 class TransformerDecoder(base_layer.BaseLayer):
@@ -115,18 +125,27 @@ class TransformerDecoder(base_layer.BaseLayer):
             input_dim=p.model_dim, num_classes=p.vocab_size))
     self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
 
-  def _Embed(self, theta, ids, position=None, seq_length=None):
-    x = self.emb.EmbLookup(theta.emb, ids)
+  def _PosDropout(self, theta, token_embs, position=None, seq_length=None):
+    """Shared prologue: positional encoding + input dropout over token
+    embeddings (used by id-input and mixed-embedding-input paths)."""
     if position is not None:
       pe = self.pos_emb.FProp(NestedMap(), position=position)
     else:
       pe = self.pos_emb.FProp(NestedMap(), seq_length=seq_length)[None]
-    x = x + pe.astype(x.dtype)
+    x = token_embs + pe.astype(token_embs.dtype)
     if self.p.input_dropout_prob > 0:
       x = self.dropout.FProp(
           self.ChildTheta(theta, "dropout"), x,
           keep_prob=1.0 - self.p.input_dropout_prob)
     return x
+
+  def _Embed(self, theta, ids, position=None, seq_length=None):
+    return self._PosDropout(theta, self.emb.EmbLookup(theta.emb, ids),
+                            position=position, seq_length=seq_length)
+
+  def EmbedTokens(self, theta, ids):
+    """[b, t] ids -> [b, t, d] token embeddings (no positional)."""
+    return self.emb.EmbLookup(theta.emb, ids)
 
   def FProp(self, theta, encoder_out, src_paddings, target_ids,
             target_paddings, target_labels):
@@ -145,6 +164,34 @@ class TransformerDecoder(base_layer.BaseLayer):
     return NestedMap(
         per_example_xent=xent.per_example_xent, logits=xent.logits,
         avg_xent=avg, total_weight=total_weight)
+
+  def FPropMixture(self, theta, encoder_out, src_paddings, tgt_token_embs,
+                   target_paddings, labels_pair, label_lambdas):
+    """Crossover decode: mixed target-input embeddings + two-parent
+    mixture labels (XEnDec F1/F2 loss; ref TransformerXDecoder).
+
+    tgt_token_embs: [b, t, d] already-interpolated token embeddings;
+    labels_pair: ([b, t] ids, [b, t] ids); label_lambdas: matching pair of
+    [b, t] weights (summing to ~1 on valid positions). Returns
+    NestedMap(avg_xent, total_weight).
+    """
+    x = self._PosDropout(theta, tgt_token_embs,
+                         seq_length=tgt_token_embs.shape[1])
+    x = self.stack.FProp(theta.stack, x, target_paddings,
+                         aux_vecs=encoder_out, aux_paddings=src_paddings)
+    logits = self.softmax.Logits(theta.softmax, x).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    y0, y1 = labels_pair
+    l0, l1 = label_lambdas
+    lp0 = jnp.take_along_axis(logp, y0[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    lp1 = jnp.take_along_axis(logp, y1[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    l0 = l0.astype(jnp.float32)
+    l1 = l1.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(l0 + l1), 1e-8)
+    avg = -jnp.sum(l0 * lp0 + l1 * lp1) / total
+    return NestedMap(avg_xent=avg, total_weight=total)
 
   def BeamSearchDecode(self, theta, encoder_out, src_paddings):
     """Beam search over the KV-cache ExtendStep path."""

@@ -240,3 +240,36 @@ class WmtEnDeMassFinetuneTiny(WmtEnDeTransformerTiny):
     p = super().Task()
     p.name = "wmt14_en_de_mass_ft"
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeXEnDec(WmtEnDeTransformerBase):
+  """XEnDec crossover joint training (ref
+  `tasks/mt/params/xendec/wmt14_en_de.py` WmtEnDeXEnDec, arXiv:2106.04060)."""
+
+  def Task(self):
+    from lingvo_tpu.models.mt import xendec
+    base = super().Task()
+    p = xendec.TransformerXEnDecModel.Params()
+    # adopt the base transformer geometry + training recipe
+    for name in ("encoder", "decoder", "train", "name"):
+      p.Set(**{name: base.Get(name)})
+    p.name = "wmt14_en_de_xendec"
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeXEnDecTiny(WmtEnDeTransformerTiny):
+  """Smoke-scale XEnDec."""
+
+  def Task(self):
+    from lingvo_tpu.models.mt import xendec
+    base = super().Task()
+    p = xendec.TransformerXEnDecModel.Params()
+    for name in ("encoder", "decoder", "train", "name"):
+      p.Set(**{name: base.Get(name)})
+    p.name = "wmt14_en_de_xendec_tiny"
+    # at smoke scale the full-weight crossover loss drowns the supervised
+    # gradient; the paper's 1.0 default stays on the full-size config
+    p.loss_mix_weight = 0.5
+    return p

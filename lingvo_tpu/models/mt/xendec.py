@@ -1,0 +1,168 @@
+"""XEnDec: crossover encoder-decoder joint self-/supervised training.
+
+Re-designs `lingvo/tasks/mt/model.py:401` TransformerXEnDecModel
+(Cheng et al., ICML 2021, arXiv:2106.04060) TPU-first: the crossover pair
+is the batch rolled by one (the reference's fallback when no separate
+monolingual stream is wired), source embeddings are mixed under a
+per-position Bernoulli mask, and the mixture-label target lambdas follow
+the reference's attention-apportioned credit
+(`model.py:420 _CreateTargetLambdas`): stop-gradient cross-attention probs
+decide how much of each target position's loss belongs to each parent.
+Everything is one jitted program — no Defuns, no graph surgery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.models.mt import model as mt_model
+
+
+class TransformerXEnDecModel(mt_model.TransformerModel):
+  """Transformer MT with the XEnDec crossover loss added in training."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("loss_clean_weight", 1.0, "Weight of the supervised loss.")
+    p.Define("loss_mix_weight", 1.0, "Weight of the crossover (F1) loss.")
+    p.Define("loss_mono_weight", 0.0,
+             "Weight of the rolled-parent loss (ref loss_mono_weight; the "
+             "roll fallback duplicates the clean loss, so default 0).")
+    p.Define("crossover_prob", 0.5,
+             "Bernoulli(source position comes from the OTHER parent).")
+    p.Define("lambda_smooth", 0.0,
+             "Additive smoothing of target lambdas before normalization.")
+    return p
+
+  # -- crossover machinery ---------------------------------------------------
+
+  def _SourceMask(self, src_ids, step):
+    """Deterministic per-step Bernoulli crossover mask [b, t] (1 = take
+    the other parent's embedding)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(0x9E3779B9),
+                             jnp.asarray(step, jnp.uint32))
+    return jax.random.bernoulli(
+        key, self.p.crossover_prob, src_ids.shape).astype(jnp.float32)
+
+  def _TargetLambdas(self, atten_pair, src_lambdas_pair, src_pad_pair,
+                     tgt_pad_pair):
+    """Attention-apportioned target credit (ref _CreateTargetLambdas).
+
+    atten_pair: two [b, tgt, src] stop-gradient cross-attention prob maps.
+    Returns (input_lambdas, label_lambdas), each a pair of [b, tgt].
+    """
+    smooth = self.p.lambda_smooth
+    a0 = jax.lax.stop_gradient(atten_pair[0])
+    a1 = jax.lax.stop_gradient(atten_pair[1])
+    l0 = jnp.sum(a0 * (src_lambdas_pair[0] *
+                       (1.0 - src_pad_pair[0]))[:, None, :], -1)
+    l0 = (l0 + smooth) * (1.0 - tgt_pad_pair[0])
+    l1 = jnp.sum(a1 * (src_lambdas_pair[1] *
+                       (1.0 - src_pad_pair[1]))[:, None, :], -1)
+    l1 = (l1 + smooth) * (1.0 - tgt_pad_pair[1])
+    # normalize EACH side (positions padded in both parents get (0, 0),
+    # not (0, 1) — they carry no loss weight)
+    denom = l0 + l1 + 1e-9
+    label_lambdas = (l0 / denom, l1 / denom)
+    # decoder INPUT at position t carries the previous label's credit
+    input0 = jnp.pad(label_lambdas[0], ((0, 0), (1, 0)),
+                     constant_values=1.0)[:, :-1]
+    input_lambdas = (input0 * (1.0 - tgt_pad_pair[0]),
+                     (1.0 - input0) * (1.0 - tgt_pad_pair[1]))
+    return input_lambdas, label_lambdas
+
+  def _CrossAttenProbs(self, collected):
+    """Last decoder layer's cross-attention probs, head-averaged
+    [b, tgt, src]."""
+    assert collected, "no cross-attention probs collected"
+
+    def _LayerIndex(path: str):
+      # paths end in .../x_layers_<i>; numeric sort (lexicographic would
+      # put x_layers_9 after x_layers_11)
+      tail = path.rsplit("_", 1)[-1]
+      return (int(tail) if tail.isdigit() else -1, path)
+
+    last = collected[max(collected, key=_LayerIndex)]
+    return jnp.mean(last.astype(jnp.float32), axis=1)
+
+  # -- task hooks ------------------------------------------------------------
+
+  def ComputePredictions(self, theta, input_batch):
+    """Clean pass, collecting the decoder's cross-attention probs so the
+    crossover loss doesn't pay a second clean forward."""
+    with py_utils.NamedCollectionContext("cross_atten_probs") as coll:
+      preds = super().ComputePredictions(theta, input_batch)
+    preds.cross_atten = self._CrossAttenProbs(coll)
+    return preds
+
+  def ComputeLoss(self, theta, predictions, input_batch):
+    p = self.p
+    metrics, per_example = super().ComputeLoss(theta, predictions,
+                                               input_batch)
+    if py_utils.DoEval():
+      return metrics, per_example
+
+    clean_out, atten = predictions, predictions.cross_atten
+    other = input_batch.Transform(lambda x: jnp.roll(x, 1, axis=0))
+    other_atten = jnp.roll(atten, 1, axis=0)
+
+    step = py_utils.GetGlobalStep()
+    mask = self._SourceMask(input_batch.src.ids,
+                            0 if step is None else step)
+    src_pad = (input_batch.src.paddings.astype(jnp.float32),
+               other.src.paddings.astype(jnp.float32))
+    tgt_pad = (input_batch.tgt.paddings.astype(jnp.float32),
+               other.tgt.paddings.astype(jnp.float32))
+    # other side contributes where the mask picks it AND it's real; where
+    # only the other parent is real, take it regardless of the mask (else
+    # the position would be marked valid but carry a zero embedding)
+    other_lambdas = jnp.where(
+        (src_pad[0] > 0.5) & (src_pad[1] < 0.5), 1.0,
+        mask * (1.0 - src_pad[1]))
+    src_lambdas = ((1.0 - other_lambdas) * (1.0 - src_pad[0]),
+                   other_lambdas)
+
+    input_lambdas, label_lambdas = self._TargetLambdas(
+        (atten, other_atten), src_lambdas, src_pad, tgt_pad)
+
+    # mixed source through the encoder (the other parent IS the rolled
+    # batch, so its embeddings are a batch-axis roll — no second gather)
+    e_src = self.enc.EmbedTokens(theta.enc, input_batch.src.ids)
+    e_src_other = jnp.roll(e_src, 1, axis=0)
+    mix_src = (src_lambdas[0][..., None] * e_src +
+               src_lambdas[1][..., None] * e_src_other)
+    mix_src_pad = src_pad[0] * src_pad[1]  # valid if either parent is
+    mix_enc = self.enc.FPropEmb(theta.enc, mix_src, mix_src_pad)
+
+    # mixed target inputs + mixture labels through the decoder
+    e_tgt = self.dec.EmbedTokens(theta.dec, input_batch.tgt.ids)
+    e_tgt_other = jnp.roll(e_tgt, 1, axis=0)
+    mix_tgt = (input_lambdas[0][..., None] * e_tgt +
+               input_lambdas[1][..., None] * e_tgt_other)
+    mix_tgt_pad = tgt_pad[0] * tgt_pad[1]
+    mix_out = self.dec.FPropMixture(
+        theta.dec, mix_enc, mix_src_pad, mix_tgt, mix_tgt_pad,
+        (input_batch.tgt.labels, other.tgt.labels), label_lambdas)
+
+    clean_loss = clean_out.avg_xent
+    mix_loss = mix_out.avg_xent
+    total = (p.loss_clean_weight * clean_loss +
+             p.loss_mix_weight * mix_loss)
+    if p.loss_mono_weight > 0:
+      other_enc = self.enc.FProp(theta.enc, other.src.ids,
+                                 other.src.paddings)
+      mono = self.dec.FProp(theta.dec, other_enc, other.src.paddings,
+                            other.tgt.ids, other.tgt.paddings,
+                            other.tgt.labels)
+      total = total + p.loss_mono_weight * mono.avg_xent
+      metrics.mono_loss = (mono.avg_xent, mono.total_weight)
+
+    w = clean_out.total_weight
+    metrics.loss = (total, w)
+    metrics.clean_loss = (clean_loss, w)
+    metrics.mix_loss = (mix_loss, mix_out.total_weight)
+    return metrics, per_example

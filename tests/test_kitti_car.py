@@ -223,6 +223,63 @@ class TestKittiE2E:
     assert all(0.0 <= v <= 1.0 for v in vals.values())
 
 
+class TestCalibration:
+
+  def test_curve_and_ece(self):
+    from lingvo_tpu.models.car import calibration
+    # perfectly calibrated: score == empirical hit rate
+    scores = np.concatenate([np.full(50, 0.25), np.full(50, 0.75)])
+    hits = np.concatenate([(np.arange(50) < 13), (np.arange(50) < 37)])
+    pred, emp, counts = calibration.CalibrationCurve(scores, hits, 10)
+    assert counts.sum() == 100
+    ece = calibration.ExpectedCalibrationError(pred, emp, counts)
+    assert ece < 0.02, ece
+    # badly calibrated: confident but always wrong
+    m = calibration.CalibrationMetric()
+    m.Update(np.full(100, 0.9), np.zeros(100))
+    assert m.value > 0.8
+
+  def test_from_ap_metric(self):
+    from lingvo_tpu.models.car import ap_metric, calibration
+    m = ap_metric.ApMetric(iou_threshold=0.5)
+    gt = np.array([[0, 0, 0, 4, 2, 1.5, 0.0]])
+    pred = np.concatenate([gt, [[50, 50, 0, 4, 2, 1.5, 0.0]]])
+    m.Update(pred, np.array([0.9, 0.8]), gt)
+    cal = calibration.CalibrationMetric().FromApMetric(m)
+    assert cal.total_weight == 2  # one hit, one miss accumulated
+
+  def test_kitti_difficulty_protocol(self):
+    from lingvo_tpu.models.car import kitti_input
+    easy = {"bbox": [0, 0, 10, 50], "occluded": 0, "truncated": 0.1}
+    mod = {"bbox": [0, 0, 10, 30], "occluded": 1, "truncated": 0.2}
+    hard = {"bbox": [0, 0, 10, 30], "occluded": 2, "truncated": 0.4}
+    excl = {"bbox": [0, 0, 10, 10], "occluded": 3, "truncated": 0.9}
+    assert kitti_input.KittiDifficulty(easy) == 0
+    assert kitti_input.KittiDifficulty(mod) == 1
+    assert kitti_input.KittiDifficulty(hard) == 2
+    assert kitti_input.KittiDifficulty(excl) == -1
+
+  def test_cumulative_difficulty_ap(self):
+    # easy gt counts in every level; hard gt only at 'hard'; a detection
+    # matched to a hard gt must not poison the easy slice
+    m = breakdown_metric.ByKittiDifficulty()
+    gt = np.array([[0, 0, 0, 4, 2, 1.5, 0.0, 0],      # easy
+                   [20, 20, 0, 4, 2, 1.5, 0.0, 2]])   # hard
+    pred = gt[:, :7].copy()
+    m.Update(pred, np.array([0.9, 0.8]), gt,
+             pred_classes=np.array([1, 1]), gt_classes=np.array([1, 1]))
+    vals = m.value
+    assert vals["easy"] == 1.0 and vals["moderate"] == 1.0
+    assert vals["hard"] == 1.0
+    # a second scene with only the hard gt detected late (missed easy)
+    m2 = breakdown_metric.ByKittiDifficulty()
+    m2.Update(gt[1:, :7], np.array([0.8]), gt,
+              pred_classes=np.array([1]), gt_classes=np.array([1, 1]))
+    v2 = m2.value
+    assert v2["easy"] == 0.0        # easy gt missed entirely
+    assert v2["hard"] < 1.0         # hard slice: 1 of 2 gts found
+
+
 class TestBreakdownMetrics:
 
   def test_by_rotation_bins(self):
