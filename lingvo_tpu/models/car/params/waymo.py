@@ -44,9 +44,13 @@ class PointPillarsWaymoVehicle(base_model_params.SingleTaskModelParams):
     return self._Input(self.WAYMO_TEST_FRAMES).Set(
         shuffle=False, max_epochs=1)
 
+  # subclasses swap the detector while inheriting the full recipe
+  TASK_CLASS = pillars.PointPillarsModel
+  TASK_NAME = "pillars_waymo_vehicle"
+
   def Task(self):
-    p = pillars.PointPillarsModel.Params()
-    p.name = "pillars_waymo_vehicle"
+    p = self.TASK_CLASS.Params()
+    p.name = self.TASK_NAME
     p.featurizer.point_dim = waymo_input.POINT_DIM  # + intensity/elongation
     p.featurizer.feature_dim = self.FEATURE_DIM
     p.backbone.grid_size = self.GRID
@@ -86,4 +90,54 @@ class PointPillarsWaymoTiny(PointPillarsWaymoVehicle):
     p.train.learner.learning_rate = 1e-3
     p.train.max_steps = 60
     p.train.tpu_steps_per_loop = 20
+    return p
+
+
+class _DeepFusionMixin:
+  """Swaps the detector to DeepFusionModel and wires the camera stream;
+  composes with any PointPillarsWaymo* recipe (DeepFusionModel.Params
+  extends PointPillarsModel.Params, so the inherited Task() config applies
+  unchanged)."""
+
+  TASK_NAME = "deep_fusion_waymo_vehicle"
+  CAMERA_SIZE = 192
+  IMAGE_CHANNELS = 64
+  ATTEN_DROPOUT = 0.3  # ref LearnableAlign keep_prob 0.7
+
+  @property
+  def TASK_CLASS(self):
+    from lingvo_tpu.models.car import deep_fusion
+    return deep_fusion.DeepFusionModel
+
+  def _Input(self, pattern):
+    return super()._Input(pattern).Set(camera_size=self.CAMERA_SIZE)
+
+  def Task(self):
+    p = super().Task()
+    p.camera_featurizer.image_channels = self.IMAGE_CHANNELS
+    p.aligner.lidar_channels = self.FEATURE_DIM
+    p.aligner.image_channels = self.IMAGE_CHANNELS
+    p.aligner.qkv_channels = self.FEATURE_DIM
+    p.aligner.atten_dropout_prob = self.ATTEN_DROPOUT
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class DeepFusionWaymoVehicle(_DeepFusionMixin, PointPillarsWaymoVehicle):
+  """Camera+lidar fusion detector (ref `deep_fusion.py`,
+  arXiv:2203.08195): PointPillars with LearnableAlign cross-attention
+  over camera patch tokens."""
+
+
+@model_registry.RegisterSingleTaskModel
+class DeepFusionWaymoTiny(_DeepFusionMixin, PointPillarsWaymoTiny):
+  """CPU-smoke scale: the tiny pillars recipe + fusion."""
+
+  CAMERA_SIZE = 32
+  IMAGE_CHANNELS = 16
+  ATTEN_DROPOUT = 0.0
+
+  def Task(self):
+    p = super().Task()
+    p.camera_featurizer.filter_counts = [8, 16]
     return p

@@ -94,6 +94,12 @@ class WaymoSceneInputGenerator(
     p.Define("num_classes", 4,
              "Foreground classes kept in WAYMO_CLASS_IDS order "
              "(1 keeps only vehicles).")
+    p.Define("camera_size", 0,
+             "If >0, emit a `camera` [S, S, 3] image per frame (records "
+             "carry \"camera\" as a flat or nested float list; frames "
+             "without one — or with a different resolution — get zeros) "
+             "— the DeepFusion input (ref deep_fusion.py "
+             "MultiModalFeaturizer camera_names).")
     p.bucket_upper_bound = [1]
     return p
 
@@ -121,6 +127,17 @@ class WaymoSceneInputGenerator(
                          np.float32).reshape(-1, POINT_DIM)
       labels = [ParseWaymoLabel(o, p.num_classes)
                 for o in frame.get("labels", [])]
+      camera = None
+      if p.camera_size > 0:
+        s = p.camera_size
+        camera = np.zeros((s, s, 3), np.float32)
+        if frame.get("camera") is not None:
+          raw = np.asarray(frame["camera"], np.float32)
+          if raw.size == s * s * 3:
+            camera = raw.reshape(s, s, 3)
+          # wrong-resolution cameras degrade to zeros: the frame's lidar
+          # and labels are still good training data, and a reshape error
+          # here would alias into the malformed-frame drop path
     except (UnicodeDecodeError, json.JSONDecodeError, ValueError,
             TypeError, AttributeError):
       return None  # malformed frame: drop, never kill the pipeline
@@ -159,4 +176,6 @@ class WaymoSceneInputGenerator(
         gt_boxes=gt_boxes, gt_classes=gt_classes,
         gt_difficulty=gt_difficulty, gt_num_points=gt_num_points,
         gt_speed=gt_speed)
+    if camera is not None:
+      views.camera = camera
     return views
