@@ -56,12 +56,14 @@ class ExecutorTpu:
       for t in schedule.tasks.values():
         t.FinalizePaths()
     # Serialize the full experiment config for reproducibility
-    # (ref executor.py:233-237 trainer_params.txt).
-    if model_params is not None:
+    # (ref executor.py:233-237 trainer_params.txt). One writer per logdir
+    # under multi-host.
+    if model_params is not None and jax.process_index() == 0:
       with open(os.path.join(logdir, "trainer_params.txt"), "w") as f:
         f.write(model_params.ToText())
     self._schedule = schedule
-    self._WriteModelAnalysis()
+    if jax.process_index() == 0:
+      self._WriteModelAnalysis()
 
     ref_task = (self._task if self._task is not None
                 else next(iter(schedule.tasks.values())))
@@ -158,6 +160,18 @@ class ExecutorTpu:
       return self._schedule.CreateTrainState(key)
     return self._task.CreateTrainState(key)
 
+  def _PlaceState(self, state: NestedMap) -> NestedMap:
+    """Places the (host-local, every-process-identical) initial state onto
+    the train program's mesh shardings. Required under multi-host: the
+    collective orbax save and the spanning jit both need global arrays,
+    not SingleDeviceSharding host copies.
+    """
+    prog = getattr(self._schedule, "train_program", None)
+    if prog is None or prog.p.mesh is None or (
+        prog.p.state_sharding_fn is None):
+      return state
+    return jax.device_put(state, prog.p.state_sharding_fn(state))
+
   def Start(self) -> NestedMap:
     """Runs the main loop until max_steps; returns the final state.
 
@@ -167,7 +181,7 @@ class ExecutorTpu:
     `max_train_retries` consecutive failures; anything else (compile errors,
     OOM, shape bugs) is fatal immediately.
     """
-    state = self._CreateTrainState()
+    state = self._PlaceState(self._CreateTrainState())
     # 'no checkpoint at all' (fresh run) is distinct from 'restored the
     # step-0 checkpoint' — warm start must apply only to the former
     fresh_run = self._checkpointer.LatestStep() is None
@@ -229,7 +243,8 @@ class ExecutorTpu:
         time.sleep(delay)
         # rebuild device state from the last checkpoint (ref: cleanup +
         # rebuild session + resume from checkpoint)
-        state, step = self._checkpointer.Restore(self._CreateTrainState())
+        state, step = self._checkpointer.Restore(
+            self._PlaceState(self._CreateTrainState()))
         continue
       step = int(jax.device_get(state.step))
       state = self._MaybePrune(state, step)
@@ -298,12 +313,15 @@ class ExecutorTpu:
     self._checkpointer.Close()
     # marker for follower jobs (evaler/decoder pollers): training is over —
     # process the final checkpoint and exit instead of idling to timeout
-    with open(os.path.join(self._checkpointer.train_dir, "FINISHED"),
-              "w") as f:
-      f.write(str(step))
+    if jax.process_index() == 0:
+      with open(os.path.join(self._checkpointer.train_dir, "FINISHED"),
+                "w") as f:
+        f.write(str(step))
     return state
 
   def _ExportMetrics(self, step: int, results: dict[str, Any]):
+    if jax.process_index() != 0:
+      return
     path = os.path.join(self._logdir, "metrics.jsonl")
     with open(path, "a") as f:
       f.write(json.dumps({"step": step, **results}, default=float) + "\n")

@@ -95,10 +95,24 @@ class BaseProgram:
     return self._input
 
   def _PutBatch(self, batch: NestedMap) -> NestedMap:
-    """Host batch -> device array(s), honoring the input sharding."""
+    """Host batch -> device array(s), honoring the input sharding.
+
+    Multi-process: the local generator yields this HOST's shard
+    (batch_size rows, ref InfeedContextScope per-host sharding); rows from
+    all processes concatenate along dim 0 into one global array.
+    """
     if self.p.mesh is not None and self.p.input_sharding is not None:
       sharding = jax.sharding.NamedSharding(self.p.mesh,
                                             self.p.input_sharding)
+      if jax.process_count() > 1:
+        nproc = jax.process_count()
+
+        def _Global(x):
+          x = np.asarray(x)
+          return jax.make_array_from_process_local_data(
+              sharding, x, (x.shape[0] * nproc,) + x.shape[1:])
+
+        return batch.Transform(_Global)
       return batch.Transform(
           lambda x: jax.device_put(jnp.asarray(x), sharding))
     return batch.Transform(jnp.asarray)
@@ -132,6 +146,8 @@ class BaseProgram:
     pass
 
   def WriteSummaries(self, step: int, values: dict[str, float]) -> None:
+    if jax.process_index() != 0:
+      return  # one writer per logdir (ref cluster.add_summary job gating)
     path = os.path.join(self._program_dir, "summaries.jsonl")
     with open(path, "a") as f:
       f.write(json.dumps({"step": step, **values}) + "\n")
@@ -279,8 +295,15 @@ class TrainProgram(BaseProgram):
         # shift the per-step batch spec right by one
         spec = jax.sharding.PartitionSpec(None, *self.p.input_sharding)
         sharding = jax.sharding.NamedSharding(self.p.mesh, spec)
-        stacked = stacked.Transform(
-            lambda x: jax.device_put(jnp.asarray(x), sharding))
+        if jax.process_count() > 1:
+          nproc = jax.process_count()
+          stacked = stacked.Transform(
+              lambda x: jax.make_array_from_process_local_data(
+                  sharding, np.asarray(x),
+                  (x.shape[0], x.shape[1] * nproc) + x.shape[2:]))
+        else:
+          stacked = stacked.Transform(
+              lambda x: jax.device_put(jnp.asarray(x), sharding))
       else:
         stacked = stacked.Transform(jnp.asarray)
       fn = self._GetLoopFn(state)
@@ -361,8 +384,9 @@ class EvalProgram(BaseProgram):
     acc = None
     gen = self.input_generator
     max_batches = self._MaxEvalBatches()
-    batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
-               else _TakeN(gen, max_batches))
+    batches = _CoordinateFiniteStream(
+        gen.EpochBatches() if hasattr(gen, "EpochBatches")
+        else _TakeN(gen, max_batches))
     n = 0
     with self._MeshScope(), self._ProfilerScope():
       for batch in batches:
@@ -406,8 +430,9 @@ class DecodeProgram(BaseProgram):
              if self.p.use_ema and "ema_theta" in state else state.theta)
     dec_metrics = self._task.CreateDecoderMetrics()
     gen = self.input_generator
-    batches = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
-               else _TakeN(gen, self.p.steps_per_loop))
+    batches = _CoordinateFiniteStream(
+        gen.EpochBatches() if hasattr(gen, "EpochBatches")
+        else _TakeN(gen, self.p.steps_per_loop))
     n = 0
     # async host postprocess (ref DecodeProgram:1487-1529): the device
     # decodes batch k+1 while ONE worker thread postprocesses batch k.
@@ -420,8 +445,15 @@ class DecodeProgram(BaseProgram):
          ThreadPoolExecutor(max_workers=1) as pool:
       for batch in batches:
         out = fn(theta, self._PutBatch(batch))
+        if jax.process_count() > 1:
+          # batch-sharded outputs are not host-addressable: gather the
+          # global tree so postprocess sees every example (every process
+          # computes identical metrics; only process 0 writes)
+          from jax.experimental import multihost_utils
+          out = multihost_utils.process_allgather(out, tiled=True)
         host_out = jax.tree_util.tree_map(np.asarray, out)
-        if n == 0 and isinstance(host_out, NestedMap):
+        if n == 0 and isinstance(host_out, NestedMap) and (
+            jax.process_index() == 0):
           probs = host_out.Get("atten_probs")
           if probs is not None:
             from lingvo_tpu.core import summary_utils
@@ -492,6 +524,32 @@ def _TakeN(gen, n):
       yield next(it)
     except StopIteration:
       return
+
+
+def _CoordinateFiniteStream(batches):
+  """Multi-host barrier on batch availability: hosts with disjoint finite
+  input shards can yield UNEQUAL batch counts; since every program step is
+  a cross-process collective, a host iterating one batch more than another
+  deadlocks. Stops ALL hosts as soon as ANY host runs dry (the tail
+  examples on longer shards are skipped — the price of collective eval;
+  ref the infeed-until-OutOfRange coordination in program.py:1386)."""
+  if jax.process_count() <= 1:
+    yield from batches
+    return
+  from jax.experimental import multihost_utils
+  it = iter(batches)
+  while True:
+    try:
+      batch = next(it)
+      have = True
+    except StopIteration:
+      batch = None
+      have = False
+    counts = multihost_utils.process_allgather(
+        np.asarray([1 if have else 0]))
+    if not bool(np.all(counts)):
+      return
+    yield batch
 
 
 class SimpleProgramSchedule:

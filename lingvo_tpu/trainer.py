@@ -22,11 +22,47 @@ import sys
 from lingvo_tpu import model_registry
 
 
+def _ShardInputForHost(input_params):
+  """Per-host input sharding (the InfeedContextScope equivalent): file
+  inputs read disjoint shards; synthetic inputs diverge their seed so
+  hosts don't feed duplicate rows. batch_size stays the PER-HOST size
+  (GlobalBatchSize = batch_size * num_hosts)."""
+  import jax
+  if jax.process_count() <= 1 or input_params is None:
+    return input_params
+  try:
+    input_params.num_hosts = jax.process_count()
+    input_params.host_index = jax.process_index()
+  except AttributeError:
+    pass  # non-generator input params
+  try:
+    input_params.seed = input_params.seed + 1000003 * jax.process_index()
+  except (AttributeError, TypeError):
+    pass  # no seed param (file inputs shard by host_index instead)
+  return input_params
+
+
+def _MultiHostMesh(task):
+  """Default multi-host layout: data parallelism over all devices with
+  ZeRO/FSDP state sharding over the same axis (model-parallel multi-host
+  layouts come from experiment-provided ProgramSchedules). Returns
+  (mesh, input_sharding, state_sharding_fn)."""
+  import jax
+  from jax.sharding import PartitionSpec
+  from lingvo_tpu.parallel import mesh as mesh_lib
+  mesh = mesh_lib.MakeMesh({"data": jax.device_count()})
+  return (mesh, PartitionSpec("data"),
+          lambda state: mesh_lib.TrainStateShardings(
+              mesh, task, state, fsdp_axis="data"))
+
+
 def _BuildSchedule(model_params, args):
+  import jax
   from lingvo_tpu.runners import program as program_lib
   task_p = model_params.task
   if task_p.input is None and model_params.input is not None:
     task_p.input = model_params.input
+  task_p.input = _ShardInputForHost(task_p.input)
   cls = model_registry.GetClass(args.model)
   inst = cls()
   # Experiment-provided schedule takes precedence (ref GetProgramSchedule).
@@ -44,6 +80,7 @@ def _BuildSchedule(model_params, args):
       ds_params = inst.GetDatasetParams(ds)
     except bmp.DatasetError:
       continue  # dataset genuinely not defined; real errors propagate
+    ds_params = _ShardInputForHost(ds_params)
     ep = program_lib.EvalProgram.Params().Set(
         task=task_p, logdir=args.logdir, dataset_name=ds,
         name=f"eval_{ds.lower()}")
@@ -63,6 +100,14 @@ def _BuildSchedule(model_params, args):
   # Single task instance shared by all programs.
   task = task_p.Instantiate()
   task.FinalizePaths()
+  if jax.process_count() > 1:
+    # multi-host default: data-parallel mesh over every device, FSDP-style
+    # state shardings, per-host input shards joined into global batches
+    mesh, input_sharding, sharding_fn = _MultiHostMesh(task)
+    for prog_p in [train_p] + eval_programs:
+      prog_p.mesh = mesh
+      prog_p.input_sharding = input_sharding
+      prog_p.state_sharding_fn = sharding_fn
   return sched_cls(ps, task=task, input_generators=input_generators), task
 
 

@@ -201,12 +201,46 @@ def _RunPair(script_path, extra_args, timeout=420):
   return outs
 
 
+_CLI_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+pid, port, logdir = sys.argv[1], sys.argv[2], sys.argv[3]
+from lingvo_tpu import trainer
+rc = trainer.main([
+    "--model=lm.synthetic_packed_input.DenseLmTiny",
+    f"--logdir={logdir}", "--mode=train", "--max_steps=3",
+    f"--coordinator_address=localhost:{port}",
+    "--num_processes=2", f"--process_id={pid}",
+])
+assert rc == 0, rc
+print(f"proc{pid} OK", flush=True)
+"""
+
+
 class TestMultiProcessDistributed:
 
   def test_two_process_psum(self, tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     _RunPair(script, [])
+
+  def test_trainer_cli_two_process_train(self, tmp_path):
+    """The full CLI path under 2 processes (trainer -> executor ->
+    programs): distributed init, per-host input shards joined into global
+    batches over the auto data mesh, collective checkpoint save, and
+    single-writer logdir artifacts."""
+    script = tmp_path / "cli_worker.py"
+    script.write_text(_CLI_WORKER)
+    logdir = tmp_path / "run"
+    _RunPair(script, [str(logdir)])
+    assert (logdir / "train" / "FINISHED").exists()
+    assert (logdir / "trainer_params.txt").exists()
+    assert (logdir / "metrics.jsonl").exists()
+    import orbax.checkpoint as ocp
+    mgr = ocp.CheckpointManager(str(logdir / "train"))
+    assert mgr.latest_step() is not None
+    mgr.close()
 
   def test_train_save_restore_new_topology(self, tmp_path):
     """E2E multi-host hardening (VERDICT r3 next #5): 2-process FSDP
