@@ -16,25 +16,34 @@ from __future__ import annotations
 def Apply(input_params):
   """Possibly updates input_params according to the cluster's input policy.
 
-  On multi-host clusters, stamps this process's (host_index, num_hosts)
-  into the generator params before instantiation — file-based generators
-  shard their file list with them (`FileBasedSequenceInputGenerator`
-  routes them into the native yielder), synthetic ones fold them into
-  their seed. A generator without those params on a multi-host run fails
-  loudly: every host silently reading the full stream corrupts epoch and
-  global-batch accounting.
+  On multi-host runs (an explicit cluster with several infeed hosts, or —
+  absent a cluster context — a multi-process jax runtime), stamps this
+  process's (host_index, num_hosts) into the generator params before
+  instantiation: file-based generators shard their file list with them
+  (`FileBasedSequenceInputGenerator` routes them into the native yielder),
+  and generators with a `seed` param get it diverged per host so synthetic
+  streams don't feed duplicate rows. A generator without those params on a
+  multi-host run fails loudly: every host silently reading the full stream
+  corrupts epoch and global-batch accounting.
   """
   from lingvo_tpu.core import cluster as cluster_lib
   current = cluster_lib.Current()
-  if current is None or current.num_infeed_hosts <= 1:
-    return input_params
-  shard, num_shards = current.InputShardParams()
+  if current is not None and current.num_infeed_hosts > 1:
+    shard, num_shards = current.InputShardParams()
+  else:
+    import jax
+    if jax.process_count() <= 1:
+      return input_params
+    shard, num_shards = jax.process_index(), jax.process_count()
   if "num_hosts" not in input_params or "host_index" not in input_params:
     raise ValueError(
         f"{input_params.cls.__name__} has no num_hosts/host_index params "
         f"but the cluster has {num_shards} infeed hosts; add them (see "
         f"BaseInputGenerator) or run single-host input.")
-  return input_params.Copy().Set(num_hosts=num_shards, host_index=shard)
+  out = input_params.Copy().Set(num_hosts=num_shards, host_index=shard)
+  if "seed" in out and isinstance(out.seed, int):
+    out.seed = out.seed + 1000003 * shard
+  return out
 
 
 def Instantiate(input_params):

@@ -1,0 +1,64 @@
+"""Ulysses sequence parallelism: head-scatter all-to-all attention.
+
+BEYOND-reference capability (SURVEY.md §5 lists it alongside ring
+attention as the SP strategies the reference lacks; DeepSpeed-Ulysses,
+arXiv:2309.14509). The sequence axis is a mesh dim: each device holds a
+T/P slice of Q/K/V with ALL heads. Around attention, one `all_to_all`
+re-shards to the FULL sequence with n/P heads per device, the fused flash
+kernel runs unchanged (exact, causal-capable), and a second `all_to_all`
+restores sequence sharding.
+
+Trade-off vs ring attention: Ulysses moves activations twice (2 x
+all-to-all of q/k/v/out) but runs attention as ONE dense kernel per
+device — better when heads are plentiful and ICI all-to-all is cheap
+(single slice); ring keeps heads whole and rotates KV P times — better
+when n < P or for very long T where the 2x activation traffic dominates.
+Both are exact; pick per topology.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from lingvo_tpu.ops import flash_attention
+from lingvo_tpu.parallel import mesh as mesh_lib
+
+
+def UlyssesAttention(q, k, v, *, mesh: Mesh,
+                     seq_axis: str = mesh_lib.SEQ_AXIS,
+                     causal: bool = True, block_q: int = 1024,
+                     block_k: int = 1024):
+  """q/k/v: [b, T, n, h] GLOBALLY, sharded [b, T/P, n, h] over seq_axis.
+
+  Returns [b, T, n, h] with the same sharding, exactly equal to full
+  (flash) attention, differentiable end to end (the all_to_alls transpose
+  in the backward pass; the kernel carries its own custom VJP). Requires
+  num_heads % mesh.shape[seq_axis] == 0. Scaling by 1/sqrt(h) happens
+  inside the kernel.
+  """
+  num = mesh.shape[seq_axis]
+  n = q.shape[2]
+  if n % num != 0:
+    raise ValueError(
+        f"Ulysses needs num_heads ({n}) divisible by the '{seq_axis}' "
+        f"mesh axis ({num}); use RingAttention for head-poor configs.")
+  interpret = jax.default_backend() != "tpu"
+
+  def _Local(q, k, v):
+    # [b, T/P, n, h] -> [b, T, n/P, h]: scatter heads, gather sequence
+    q, k, v = (jax.lax.all_to_all(x, seq_axis, split_axis=2, concat_axis=1,
+                                  tiled=True) for x in (q, k, v))
+    out = flash_attention.FlashAttention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    # [b, T, n/P, h] -> [b, T/P, n, h]: gather heads, scatter sequence
+    return jax.lax.all_to_all(out, seq_axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+  spec = PartitionSpec(None, seq_axis, None, None)
+  # check_vma off: the pallas flash kernel doesn't declare varying-across-
+  # mesh axes (same setting as ring_attention's shard_maps)
+  return jax.shard_map(_Local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)(q, k, v)

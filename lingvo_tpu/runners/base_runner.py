@@ -38,9 +38,14 @@ class CheckpointPollingRunner:
     self._init_seed = init_seed
     self._last_evaled_step = -1
     # abstract restore template, built ONCE without running initializers
-    # (eval_shape traces CreateTrainState into ShapeDtypeStructs)
-    self._template = jax.eval_shape(
-        self._task.CreateTrainState, jax.random.PRNGKey(self._init_seed))
+    # (eval_shape traces CreateTrainState into ShapeDtypeStructs); under
+    # multi-host the template carries the programs' mesh shardings so the
+    # collective restore produces global arrays
+    from lingvo_tpu.runners import program as program_lib
+    self._template = program_lib.PlaceStateForPrograms(
+        self._programs,
+        jax.eval_shape(self._task.CreateTrainState,
+                       jax.random.PRNGKey(self._init_seed)))
 
   def _FindNewCheckpoint(self) -> int | None:
     """Latest unseen checkpoint step, or None (ref _FindNewCheckpoint:224)."""

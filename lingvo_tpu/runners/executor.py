@@ -86,7 +86,7 @@ class ExecutorTpu:
     self._mlperf = None
     from lingvo_tpu.core import ml_perf_log
     self._mllog = ml_perf_log
-    if mlperf_benchmark:
+    if mlperf_benchmark and jax.process_index() == 0:  # single log writer
       self._mlperf = ml_perf_log.MlPerfLogger(
           os.path.join(logdir, "mlperf_log.txt"),
           benchmark=mlperf_benchmark)
@@ -162,15 +162,14 @@ class ExecutorTpu:
 
   def _PlaceState(self, state: NestedMap) -> NestedMap:
     """Places the (host-local, every-process-identical) initial state onto
-    the train program's mesh shardings. Required under multi-host: the
-    collective orbax save and the spanning jit both need global arrays,
-    not SingleDeviceSharding host copies.
+    the schedule's mesh shardings (any program that declares them).
+    Required under multi-host: the collective orbax save and the spanning
+    jit both need global arrays, not SingleDeviceSharding host copies.
     """
-    prog = getattr(self._schedule, "train_program", None)
-    if prog is None or prog.p.mesh is None or (
-        prog.p.state_sharding_fn is None):
+    if self._schedule is None:
       return state
-    return jax.device_put(state, prog.p.state_sharding_fn(state))
+    from lingvo_tpu.runners import program as program_lib
+    return program_lib.PlaceStateForPrograms(self._schedule.programs, state)
 
   def Start(self) -> NestedMap:
     """Runs the main loop until max_steps; returns the final state.
@@ -295,10 +294,20 @@ class ExecutorTpu:
         # one designated eval program feeds the plateau detector — mixing
         # datasets would compare non-comparable losses
         r = results.get(tp.early_stop_program)
-        if r is not None and tp.early_stop_metric in r:
+        if r is not None and tp.early_stop_metric in r and (
+            jax.process_index() == 0):  # single writer per history file
           self._metric_history.ConditionalAppend(step,
                                                  r[tp.early_stop_metric])
-        if self._early_stop.Stop(step):
+        # process 0 decides (it owns the history file; a read-write race
+        # could diverge the loop and deadlock the collectives), all follow
+        should_stop = (bool(self._early_stop.Stop(step))
+                       if jax.process_index() == 0 else False)
+        if jax.process_count() > 1:
+          import numpy as _np
+          from jax.experimental import multihost_utils
+          should_stop = bool(multihost_utils.broadcast_one_to_all(
+              _np.asarray(should_stop)))
+        if should_stop:
           print(f"[executor] early stop at step {step} "
                 f"(no {tp.early_stop_metric} improvement in "
                 f"{tp.early_stop_window} steps)", flush=True)

@@ -94,27 +94,29 @@ class BaseProgram:
       self._input = input_policy.Instantiate(ip)
     return self._input
 
-  def _PutBatch(self, batch: NestedMap) -> NestedMap:
-    """Host batch -> device array(s), honoring the input sharding.
+  @staticmethod
+  def _PlaceLocalShard(x, sharding, batch_dim: int = 0):
+    """One leaf of a host-local batch -> device array under `sharding`.
 
-    Multi-process: the local generator yields this HOST's shard
-    (batch_size rows, ref InfeedContextScope per-host sharding); rows from
-    all processes concatenate along dim 0 into one global array.
+    Multi-process: this HOST's rows (ref InfeedContextScope per-host
+    sharding) concatenate with the other processes' along `batch_dim`
+    into one global array.
     """
+    if jax.process_count() > 1:
+      x = np.asarray(x)
+      gshape = list(x.shape)
+      gshape[batch_dim] *= jax.process_count()
+      return jax.make_array_from_process_local_data(
+          sharding, x, tuple(gshape))
+    return jax.device_put(jnp.asarray(x), sharding)
+
+  def _PutBatch(self, batch: NestedMap) -> NestedMap:
+    """Host batch -> device array(s), honoring the input sharding."""
     if self.p.mesh is not None and self.p.input_sharding is not None:
       sharding = jax.sharding.NamedSharding(self.p.mesh,
                                             self.p.input_sharding)
-      if jax.process_count() > 1:
-        nproc = jax.process_count()
-
-        def _Global(x):
-          x = np.asarray(x)
-          return jax.make_array_from_process_local_data(
-              sharding, x, (x.shape[0] * nproc,) + x.shape[1:])
-
-        return batch.Transform(_Global)
       return batch.Transform(
-          lambda x: jax.device_put(jnp.asarray(x), sharding))
+          lambda x: self._PlaceLocalShard(x, sharding))
     return batch.Transform(jnp.asarray)
 
   def _MeshScope(self):
@@ -295,15 +297,8 @@ class TrainProgram(BaseProgram):
         # shift the per-step batch spec right by one
         spec = jax.sharding.PartitionSpec(None, *self.p.input_sharding)
         sharding = jax.sharding.NamedSharding(self.p.mesh, spec)
-        if jax.process_count() > 1:
-          nproc = jax.process_count()
-          stacked = stacked.Transform(
-              lambda x: jax.make_array_from_process_local_data(
-                  sharding, np.asarray(x),
-                  (x.shape[0], x.shape[1] * nproc) + x.shape[2:]))
-        else:
-          stacked = stacked.Transform(
-              lambda x: jax.device_put(jnp.asarray(x), sharding))
+        stacked = stacked.Transform(
+            lambda x: self._PlaceLocalShard(x, sharding, batch_dim=1))
       else:
         stacked = stacked.Transform(jnp.asarray)
       fn = self._GetLoopFn(state)
@@ -374,7 +369,9 @@ class EvalProgram(BaseProgram):
     (ref base_model.py eval params; 0 = unlimited for finite datasets)."""
     sps = getattr(self._task.p.eval, "samples_per_summary", 0)
     if sps:
-      bs = max(1, self.input_generator.InfeedBatchSize())
+      # each coordinated step consumes a GLOBAL batch (all hosts' shards)
+      bs = max(1, self.input_generator.InfeedBatchSize()
+               * jax.process_count())
       return max(1, -(-sps // bs))
     return self.p.steps_per_loop
 
@@ -507,6 +504,36 @@ class InputBenchmarkProgram(BaseProgram):
     step = int(jax.device_get(state.step)) if hasattr(state, "step") else 0
     self.WriteSummaries(step, result)
     return state, result
+
+
+def PlaceStateForPrograms(programs, state):
+  """Places (or, for an abstract template, annotates) a train state onto
+  the mesh shardings of whichever program declares them.
+
+  Multi-host REQUIRES this before any collective orbax restore/save or
+  mesh-spanning jit: host-local SingleDeviceSharding state is rejected.
+  Works for any schedule shape — scans the given programs rather than
+  assuming a single train program.
+  """
+  shardings = None
+  for prog in programs:
+    pp = prog.p if hasattr(prog, "p") else prog
+    try:
+      mesh_ = pp.mesh
+      fn = pp.state_sharding_fn
+    except (AttributeError, KeyError):
+      continue  # program stub without mesh params (tests, custom runners)
+    if mesh_ is not None and fn is not None:
+      shardings = fn(state)
+      break
+  if shardings is None:
+    return state
+  leaves = jax.tree_util.tree_leaves(state)
+  if leaves and isinstance(leaves[0], jax.ShapeDtypeStruct):
+    return jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state, shardings)
+  return jax.device_put(state, shardings)
 
 
 def _MaybeResetFiniteStream(gen):

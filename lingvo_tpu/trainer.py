@@ -22,26 +22,6 @@ import sys
 from lingvo_tpu import model_registry
 
 
-def _ShardInputForHost(input_params):
-  """Per-host input sharding (the InfeedContextScope equivalent): file
-  inputs read disjoint shards; synthetic inputs diverge their seed so
-  hosts don't feed duplicate rows. batch_size stays the PER-HOST size
-  (GlobalBatchSize = batch_size * num_hosts)."""
-  import jax
-  if jax.process_count() <= 1 or input_params is None:
-    return input_params
-  try:
-    input_params.num_hosts = jax.process_count()
-    input_params.host_index = jax.process_index()
-  except AttributeError:
-    pass  # non-generator input params
-  try:
-    input_params.seed = input_params.seed + 1000003 * jax.process_index()
-  except (AttributeError, TypeError):
-    pass  # no seed param (file inputs shard by host_index instead)
-  return input_params
-
-
 def _MultiHostMesh(task):
   """Default multi-host layout: data parallelism over all devices with
   ZeRO/FSDP state sharding over the same axis (model-parallel multi-host
@@ -62,7 +42,6 @@ def _BuildSchedule(model_params, args):
   task_p = model_params.task
   if task_p.input is None and model_params.input is not None:
     task_p.input = model_params.input
-  task_p.input = _ShardInputForHost(task_p.input)
   cls = model_registry.GetClass(args.model)
   inst = cls()
   # Experiment-provided schedule takes precedence (ref GetProgramSchedule).
@@ -80,7 +59,6 @@ def _BuildSchedule(model_params, args):
       ds_params = inst.GetDatasetParams(ds)
     except bmp.DatasetError:
       continue  # dataset genuinely not defined; real errors propagate
-    ds_params = _ShardInputForHost(ds_params)
     ep = program_lib.EvalProgram.Params().Set(
         task=task_p, logdir=args.logdir, dataset_name=ds,
         name=f"eval_{ds.lower()}")
@@ -257,8 +235,10 @@ def main(argv=None):
       poller.Run()
       return 0
     import jax
+    from lingvo_tpu.runners import program as program_lib
     ckpt = checkpointer_lib.Checkpointer(os.path.join(args.logdir, "train"))
-    state = task.CreateTrainState(jax.random.PRNGKey(1234))
+    state = program_lib.PlaceStateForPrograms(
+        progs, task.CreateTrainState(jax.random.PRNGKey(1234)))
     state, step = ckpt.Restore(state)
     for prog in progs:
       _, results = prog.Run(state)

@@ -325,6 +325,65 @@ class TestMoEInScan:
     assert np.all(np.isfinite(np.asarray(out2)))
 
 
+class TestUlyssesAttention:
+  """Head-scatter all-to-all SP (SURVEY §5's optional Ulysses, arXiv:
+  2309.14509): exactness + gradients vs plain attention on the mesh."""
+
+  def _Ref(self, q, k, v, causal):
+    import math
+    h = q.shape[-1]
+    s = jnp.einsum("bqnh,bknh->bnqk", q / math.sqrt(h), k)
+    if causal:
+      t = q.shape[1]
+      s = jnp.where(jnp.tril(jnp.ones((t, t), jnp.bool_))[None, None], s,
+                    -jnp.inf)
+    return jnp.einsum("bnqk,bknh->bqnh", jax.nn.softmax(s, -1), v)
+
+  def test_matches_full_attention(self):
+    _RequireDevices(8)
+    from lingvo_tpu.parallel import ulysses
+    mesh = mesh_lib.MakeMesh({"seq": 4, "data": 2})
+    b, t, n, h = 2, 32, 4, 8  # n % seq == 0
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h))
+    for causal in (True, False):
+      out = ulysses.UlyssesAttention(q, k, v, mesh=mesh, causal=causal)
+      np.testing.assert_allclose(
+          np.asarray(out), np.asarray(self._Ref(q, k, v, causal)),
+          atol=2e-5)
+
+  def test_gradients_match_full_attention(self):
+    _RequireDevices(8)
+    from lingvo_tpu.parallel import ulysses
+    mesh = mesh_lib.MakeMesh({"seq": 4, "data": 2})
+    b, t, n, h = 2, 16, 4, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h))
+    w = jax.random.normal(jax.random.PRNGKey(3), (b, t, n, h))
+
+    def sp_loss(q, k, v):
+      out = ulysses.UlyssesAttention(q, k, v, mesh=mesh, causal=True)
+      return jnp.sum(out.astype(jnp.float32) * w)
+
+    def ref_loss(q, k, v):
+      return jnp.sum(self._Ref(q, k, v, True).astype(jnp.float32) * w)
+
+    g_sp = jax.grad(sp_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_sp, g_ref):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=3e-5)
+
+  def test_rejects_indivisible_heads(self):
+    _RequireDevices(8)
+    from lingvo_tpu.parallel import ulysses
+    mesh = mesh_lib.MakeMesh({"seq": 4, "data": 2})
+    q = jnp.zeros((1, 16, 3, 8))  # 3 heads, 4-way seq axis
+    with pytest.raises(ValueError, match="divisible"):
+      ulysses.UlyssesAttention(q, q, q, mesh=mesh)
+
+
 class TestRingAttention:
 
   def test_matches_full_attention_causal(self):
