@@ -51,11 +51,20 @@ class Checkpointer:
     return self._train_dir
 
   def ShouldSave(self, step: int) -> bool:
-    """Save cadence by steps or wallclock (ref checkpointer.py:281-312)."""
+    """Save cadence by steps or wallclock (ref checkpointer.py:281-312).
+
+    Multi-process: the wallclock decision is made on process 0 and
+    broadcast — per-host clocks drift, and a host entering the collective
+    save alone deadlocks it. (Step cadence is naturally consistent.)
+    """
     if step == self._last_save_step:
       return False
     if self._save_interval_seconds is not None:
-      return time.time() - self._last_save_time >= self._save_interval_seconds
+      due = time.time() - self._last_save_time >= self._save_interval_seconds
+      if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        due = bool(multihost_utils.broadcast_one_to_all(np.asarray(due)))
+      return due
     return step % max(1, self._save_interval_steps) == 0
 
   def _SanityCheck(self, state: NestedMap) -> None:

@@ -217,8 +217,9 @@ class ExecutorTpu:
     step = start_step
     consecutive_failures = 0
     while step < self._max_steps:
-      if self._checkpointer.ShouldSave(step):
-        self._checkpointer.Save(step, state)
+      # Save applies the cadence policy itself; checking ShouldSave here
+      # too would run its multi-host broadcast twice per cycle
+      self._checkpointer.Save(step, state)
       if self._mlperf is not None:
         self._mlperf.Print(self._mllog.BLOCK_START,
                            metadata={"step": step})

@@ -105,6 +105,47 @@ class TestLayerGoldens:
     CompareToGoldenSingleFloat(80.987740, jnp.sum(jnp.abs(out)))
 
 
+class TestVariantGoldens:
+  """Attention variants + MoE + conv: the rest of the hot layer zoo."""
+
+  def test_transformer_xl_attention(self):
+    from lingvo_tpu.core import attention_variants
+    layer, theta = _build(attention_variants.TransformerXLAttention.Params(
+    ).Set(name="xl", input_dim=8, hidden_dim=8, num_heads=2))
+    out, _ = layer.FProp(theta, _x((2, 5, 8)))
+    CompareToGoldenSingleFloat(-0.850885, jnp.sum(out))
+
+  def test_performer_attention(self):
+    from lingvo_tpu.core import attention_variants
+    layer, theta = _build(attention_variants.PerformerAttention.Params(
+    ).Set(name="perf", input_dim=8, hidden_dim=8, num_heads=2,
+          num_random_features=16))
+    out, _ = layer.FProp(theta, _x((2, 5, 8)))
+    CompareToGoldenSingleFloat(-0.166709, jnp.sum(out))
+
+  def test_conv2d(self):
+    layer, theta = _build(layers_lib.Conv2DLayer.Params().Set(
+        name="conv", filter_shape=(3, 3, 2, 4), batch_norm=False,
+        has_bias=True, activation="RELU"))
+    out = layer.FProp(theta, _x((2, 6, 6, 2)))
+    CompareToGoldenSingleFloat(79.663170, jnp.sum(out))
+
+  def test_sru_cell(self):
+    cell, theta = _build(rnn_cell.SRUCell.Params().Set(
+        name="sru", num_input_nodes=6, num_output_nodes=6))
+    x = cell.PreProcessInputs(theta, _x((3, 1, 6)))[:, 0]
+    state = cell.FProp(theta, cell.InitState(3), x, preprocessed=True)
+    CompareToGoldenSingleFloat(0.658072, jnp.sum(state.m))
+
+  def test_moe_layer(self):
+    from lingvo_tpu.parallel import gshard
+    layer, theta = _build(gshard.MoEFeedForwardLayer.Params().Set(
+        name="moe", input_dim=8, hidden_dim=16, num_experts=4,
+        num_groups=2))
+    out = layer.FProp(theta, _x((2, 8, 8)))
+    CompareToGoldenSingleFloat(0.669588, jnp.sum(out))
+
+
 class TestGoldenHarness:
 
   def test_updater_rewrites_call_site(self, tmp_path):
