@@ -237,6 +237,92 @@ def HashGating(token_ids: jax.Array,
   return out
 
 
+def ExpertChoiceGating(logits: jax.Array,
+                       paddings: jax.Array | None,
+                       capacity_factor: float = 2.0,
+                       capacity: int | None = None,
+                       build_tensors: bool = True):
+  """Expert-choice routing (Zhou et al. 2022, arXiv:2202.09368; beyond the
+  reference's top2/hash/sinkhorn set): each EXPERT picks its top-C tokens
+  instead of tokens picking experts — perfect per-expert load balance by
+  construction, no aux loss, no dropped-capacity asymmetry; a token may be
+  served by 0..E experts.
+
+  NOT CAUSAL over the token axis: a token's selection depends on the
+  whole group's router scores (per-expert top-k over S), so use it for
+  encoders / teacher-forced non-AR objectives — autoregressive decode
+  routes differently than training (the leak Zhou et al. §4 call out).
+
+  Output matches the other gating fns (indices/positions/gates use K=E
+  rows: row k describes the token's slot in expert k, gate 0 when expert
+  k did not choose it) and additionally carries the native expert-major
+  form (`ec_top_i`/`ec_top_v` [G,E,C]) that `EcIndexedDispatch` consumes
+  directly. Everything is O(G*E*C) / O(G*S*E); the quadratic one-hot is
+  built only under build_tensors (the einsum dispatch path).
+  """
+  g, s, e = logits.shape
+  c = _DeriveCapacity(s, e, capacity_factor, capacity)
+  c = min(c, s)
+  scores = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [G,S,E]
+  if paddings is not None:
+    scores = scores * (1.0 - paddings)[..., None]
+  col = scores.transpose(0, 2, 1)                                # [G,E,S]
+  top_v, top_i = jax.lax.top_k(col, c)                           # [G,E,C]
+  valid = top_v > 0.0  # padded/zero-score picks (short groups) are unreal
+  top_v = top_v * valid
+
+  # scatter the chosen (slot, gate) back to token-major [G,E,S]; invalid
+  # picks scatter out of bounds -> dropped
+  gi = jnp.broadcast_to(jnp.arange(g)[:, None, None], (g, e, c))
+  ei = jnp.broadcast_to(jnp.arange(e)[None, :, None], (g, e, c))
+  idx = jnp.where(valid, top_i, s)
+  selected = jnp.zeros((g, e, s), jnp.float32).at[gi, ei, idx].set(
+      1.0, mode="drop")
+  slot = jnp.zeros((g, e, s), jnp.float32).at[gi, ei, idx].set(
+      jnp.broadcast_to(jnp.arange(c, dtype=jnp.float32), (g, e, c)),
+      mode="drop")
+  gates_es = col * selected                                      # [G,E,S]
+  out = NestedMap(
+      aux_loss=jnp.zeros((), jnp.float32),
+      capacity=c,
+      ec_top_i=top_i.astype(jnp.int32),
+      ec_top_v=top_v,
+      # K=E: entry k is expert k's view of each token
+      indices=jnp.broadcast_to(
+          jnp.arange(e, dtype=jnp.int32)[:, None, None], (e, g, s)),
+      positions=slot.transpose(1, 0, 2).astype(jnp.int32),       # [E,G,S]
+      gates=gates_es.transpose(1, 0, 2))                         # [E,G,S]
+  if build_tensors:
+    onehot_s = jax.nn.one_hot(top_i, s, dtype=jnp.float32) * valid[
+        ..., None]                                               # [G,E,C,S]
+    out.combine_tensor = jnp.einsum("GECS,GEC->GSEC", onehot_s, top_v)
+    out.dispatch_tensor = out.combine_tensor > 0.0
+  return out
+
+
+def EcIndexedDispatch(xg: jax.Array, gating: NestedMap) -> jax.Array:
+  """[G,S,D] tokens -> [E,G,C,D] expert inputs: ONE gather at the
+  expert-choice indices (top_i IS the gather index), vs the generic K=E
+  indexed path's E passes over [G,S]."""
+  top_i = gating.ec_top_i                                        # [G,E,C]
+  expert_in = jnp.take_along_axis(
+      xg[:, None], top_i[..., None], axis=2)                     # [G,E,C,D]
+  return expert_in.transpose(1, 0, 2, 3)
+
+
+def EcIndexedCombine(expert_out: jax.Array, gating: NestedMap,
+                     s: int) -> jax.Array:
+  """[E,G,C,D] expert outputs -> [G,S,D]: gate-weighted scatter-add back
+  to the chosen token rows."""
+  e, g, c, d = expert_out.shape
+  weighted = expert_out.transpose(1, 0, 2, 3) * gating.ec_top_v[
+      ..., None].astype(expert_out.dtype)                        # [G,E,C,D]
+  gi = jnp.broadcast_to(jnp.arange(g)[:, None, None], (g, e, c))
+  idx = jnp.where(gating.ec_top_v > 0.0, gating.ec_top_i, s)
+  out = jnp.zeros((g, s, d), expert_out.dtype)
+  return out.at[gi, idx].add(weighted, mode="drop")
+
+
 def TokenShufflePerm(shape, prng_key):
   """Random within-group token shuffle (ref `gshard_layers.py:2496`:
   capacity truncation by cumsum position biases early tokens; shuffling
@@ -331,9 +417,13 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     p.Define("activation", "RELU", "Expert FFN activation.")
     p.Define("gating_policy", "top2",
              "'top2' (learned router), 'hash' (id-hash top-1, ref "
-             "HashGatingOnLogits:2367; requires token_ids at FProp), or "
+             "HashGatingOnLogits:2367; requires token_ids at FProp), "
              "'sinkhorn' (optimal-transport balanced top-1, ref "
-             "gshard_layers.py:2736; no aux loss).")
+             "gshard_layers.py:2736; no aux loss), or 'expert_choice' "
+             "(experts pick their top-C tokens, arXiv:2202.09368; "
+             "perfectly balanced, no aux loss — NOT causal over tokens: "
+             "selection sees the whole group, so prefer it for encoders/"
+             "non-AR objectives).")
     p.Define("sinkhorn_num_iters", 10, "Sinkhorn iterations ('sinkhorn').")
     p.Define("sinkhorn_temperature", 1.0,
              "Sinkhorn temperature ('sinkhorn').")
@@ -464,6 +554,13 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
           temperature=p.sinkhorn_temperature,
           capacity=p.expert_capacity or None,
           build_tensors=build_tensors)
+    elif p.gating_policy == "expert_choice":
+      logits = jnp.einsum("GSD,DE->GSE", xg_gate,
+                          th.gating.astype(xg.dtype))
+      gating = ExpertChoiceGating(
+          logits, pg_gate, p.capacity_factor,
+          capacity=p.expert_capacity or None,
+          build_tensors=build_tensors)
     else:
       logits = jnp.einsum("GSD,DE->GSE", xg_gate,
                           th.gating.astype(xg.dtype))
@@ -487,12 +584,22 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
       for key in ("indices", "positions", "gates"):
         gating[key] = jnp.stack(
             [_TakeAlongS(a, inv_perm) for a in gating[key]])
+      # the EC native form indexes shuffled token order; fall back to the
+      # generic K-row path rather than remap (shuffle is pointless for EC
+      # anyway — top-k has no cumsum truncation bias to debias)
+      gating.pop("ec_top_i", None)
+      gating.pop("ec_top_v", None)
       if build_tensors:
         gating.dispatch_tensor = _TakeAlongS(gating.dispatch_tensor, inv_perm)
         gating.combine_tensor = _TakeAlongS(gating.combine_tensor, inv_perm)
 
     if use_shard_map:
       out = self._DispatchShardMap(th, xg, gating)
+    elif method == "indexed" and "ec_top_i" in gating:
+      # expert-choice native form: one gather in, one scatter-add out
+      expert_in = EcIndexedDispatch(xg, gating)                  # [E,G,C,D]
+      expert_out = self._ExpertFfn(th, expert_in)
+      out = EcIndexedCombine(expert_out, gating, xg.shape[1])
     elif method == "indexed":
       expert_in = IndexedDispatch(xg, gating, p.num_experts)     # [E,G,C,D]
       expert_out = self._ExpertFfn(th, expert_in)

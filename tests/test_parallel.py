@@ -166,6 +166,32 @@ class TestMoE:
     routed_to_0 = np.asarray(out.dispatch_tensor[:, :, 0, :].sum())
     assert routed_to_0 <= c  # capacity respected
 
+  def test_expert_choice_gating_properties(self):
+    """Expert-choice (arXiv:2202.09368): every expert exactly fills its
+    capacity with real tokens, no aux loss, combine weights = scores."""
+    g, s, e = 2, 16, 4
+    logits = jax.random.normal(KEY, (g, s, e))
+    out = gshard.ExpertChoiceGating(logits, None, capacity_factor=2.0)
+    c = out.capacity
+    # perfect balance: each expert serves exactly C tokens
+    per_expert = np.asarray(out.dispatch_tensor.sum(axis=(1, 3)))  # [G,E]
+    np.testing.assert_array_equal(per_expert, c)
+    assert float(out.aux_loss) == 0.0
+    # combine weights are the router scores of the chosen pairs
+    scores = np.asarray(jax.nn.softmax(logits, -1))
+    comb = np.asarray(out.combine_tensor.sum(-1))                 # [G,S,E]
+    chosen = comb > 0
+    np.testing.assert_allclose(comb[chosen], scores[chosen], atol=1e-6)
+
+  def test_expert_choice_respects_paddings(self):
+    g, s, e = 1, 8, 2
+    logits = jax.random.normal(KEY, (g, s, e))
+    paddings = jnp.zeros((g, s)).at[:, 4:].set(1.0)
+    out = gshard.ExpertChoiceGating(logits, paddings, capacity_factor=1.0)
+    # padded tokens are never selected
+    np.testing.assert_allclose(
+        np.asarray(out.combine_tensor[:, 4:]).sum(), 0.0, atol=1e-6)
+
   def test_top2_gating_respects_paddings(self):
     g, s, e = 1, 8, 2
     logits = jax.random.normal(KEY, (g, s, e))
@@ -207,7 +233,7 @@ class TestMoE:
     # The gather/scatter dispatch is the same routing as the one-hot
     # einsums; outputs must match bit-for-bit-ish for every gating policy
     # (incl. with drops: capacity_factor=1.0 forces over-capacity tokens).
-    for policy in ("top2", "sinkhorn", "hash"):
+    for policy in ("top2", "sinkhorn", "hash", "expert_choice"):
       p0 = gshard.MoEFeedForwardLayer.Params().Set(
           name="moe", input_dim=16, hidden_dim=32, num_experts=4,
           num_groups=2, capacity_factor=1.0, gating_policy=policy)

@@ -178,7 +178,14 @@ def _Time(jax, jnp, mp, peak):
       int(np.prod(np.shape(v))) for k, v in holder[0].theta.FlattenItems()
       if ".moe." in f".{k}." and k.rsplit(".", 1)[-1] in ("wi", "wo"))
   gating = getattr(mp.task, "moe_gating_policy", "top2")
-  top_k = 1.0 if gating in ("sinkhorn", "hash") else 2.0
+  # active experts/token: 1 for top-1 routers; 2 for top2; expert_choice
+  # averages capacity_factor experts per token by construction
+  if gating in ("sinkhorn", "hash"):
+    top_k = 1.0
+  elif gating == "expert_choice":
+    top_k = float(getattr(mp.task, "moe_capacity_factor", 2.0))
+  else:
+    top_k = 2.0
   active = (n_params - expert_params) + expert_params * top_k / 64
   if mp.task.num_experts == 0:
     active = n_params
@@ -199,6 +206,7 @@ VARIANTS = {
     "moe_b32": dict(batch_size=32),
     "sinkhorn": dict(moe_gating_policy="sinkhorn"),
     "hash": dict(moe_gating_policy="hash"),
+    "expert_choice": dict(moe_gating_policy="expert_choice"),
     "groups16": dict(moe_num_groups=16),
     "groups32": dict(moe_num_groups=32),
     "cap125": dict(moe_capacity_factor=1.25),
