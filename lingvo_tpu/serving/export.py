@@ -36,15 +36,59 @@ def _SpecManifest(tree) -> Any:
                  "dtype": str(np.asarray(x).dtype)}, tree)
 
 
+# weight leaves eligible for int8 deployment: the hot matmul operands
+_INT8_WEIGHT_NAMES = frozenset(
+    ("w", "wm", "w_proj", "wi", "wo", "w_query", "w_key", "w_value",
+     "w_post", "emb", "pw_in", "pw_out"))
+
+
+def QuantizeThetaInt8(theta: NestedMap):
+  """theta -> (frozen_theta, int8_tree).
+
+  frozen_theta: matmul weights replaced by their dequantized per-channel
+  int8 values — the exported graph then computes exactly what an int8
+  deployment reproduces (the serving-side counterpart of the QAT
+  simulation; ref inference_graph_exporter's dtype-override rewrites).
+  int8_tree: {path: {"w_int8", "scale"}} — the actual low-bit artifact for
+  integer-math consumers (pairs with quant_utils.Int8Einsum).
+  """
+  from lingvo_tpu.core import quant_utils
+  frozen = theta.DeepCopy()
+  int8_tree = {}
+  for path, leaf in theta.FlattenItems():
+    name = path.rsplit(".", 1)[-1]
+    arr = np.asarray(leaf)
+    # jnp.issubdtype: np's returns False for bfloat16 (ml_dtypes), which
+    # would silently skip every bf16-trained weight
+    if name not in _INT8_WEIGHT_NAMES or arr.ndim < 2 or (
+        not jnp.issubdtype(arr.dtype, jnp.floating)):
+      continue
+    w_int8, scale = quant_utils.Int8QuantizeWeight(
+        jnp.asarray(arr, jnp.float32), per_channel=True)
+    int8_tree[path] = {"w_int8": np.asarray(w_int8),
+                       "scale": np.asarray(scale)}
+    frozen.Set(path, (w_int8.astype(jnp.float32) * scale).astype(leaf.dtype))
+  return frozen, int8_tree
+
+
 class InferenceGraphExporter:
   """Exports a task's inference subgraphs + theta to `export_dir`."""
 
   @staticmethod
   def Export(task, theta: NestedMap, export_dir: str,
-             bfloat16_activations: bool = False) -> dict:
+             bfloat16_activations: bool = False,
+             quantize_int8: bool = False) -> dict:
     os.makedirs(export_dir, exist_ok=True)
+    int8_tree = None
+    if quantize_int8:
+      theta, int8_tree = QuantizeThetaInt8(theta)
+      if not int8_tree:
+        raise ValueError(
+            "quantize_int8 requested but no theta leaf qualified "
+            f"(eligible weight names: {sorted(_INT8_WEIGHT_NAMES)}) — "
+            "the export would silently serve float weights")
     subgraphs = task.Inference()
-    manifest = {"subgraphs": {}}
+    manifest = {"subgraphs": {}, "quantize_int8": bool(quantize_int8)}
     from jax import export as jax_export
     for name, (fn, example_inputs) in subgraphs.items():
       if bfloat16_activations:
@@ -74,6 +118,12 @@ class InferenceGraphExporter:
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(export_dir, "theta"), dict(theta=theta))
     ckptr.wait_until_finished()
+    if int8_tree:
+      ckptr.save(os.path.join(export_dir, "theta_int8"),
+                 dict(int8=int8_tree))
+      ckptr.wait_until_finished()
+      manifest["int8_artifact"] = "theta_int8"
+      manifest["int8_weights"] = sorted(int8_tree)
     with open(os.path.join(export_dir, "inference_graph.json"), "w") as f:
       json.dump(manifest, f, indent=2)
     return manifest
@@ -104,3 +154,16 @@ class Predictor:
     """Runs a subgraph on `inputs` (same structure as export-time example)."""
     exported = self._fns[subgraph_name]
     return exported.call(self._theta, inputs)
+
+  def Int8Weights(self) -> dict | None:
+    """The int8 deployment artifact ({path: {w_int8, scale}}), or None for
+    a float export. Pairs with quant_utils.Int8Einsum on integer-math
+    serving stacks; the exported graph itself already computes on the
+    dequantized grid (QuantizeThetaInt8 froze it)."""
+    if not self._manifest.get("int8_artifact"):
+      return None
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(
+        os.path.join(self._dir, self._manifest["int8_artifact"]))
+    return restored["int8"]

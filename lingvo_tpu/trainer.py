@@ -107,6 +107,10 @@ def main(argv=None):
                       help="let 'export' serialize randomly initialized "
                       "weights when the logdir has no checkpoint "
                       "(default: hard error).")
+  parser.add_argument("--export_int8", action="store_true",
+                      help="'export' freezes matmul weights to the "
+                      "per-channel int8 grid and bundles the int8+scale "
+                      "artifact (theta_int8) for integer-math serving.")
   parser.add_argument("--job", default="executor_tpu",
                       help="executor_tpu (train), or evaler/decoder "
                            "(checkpoint-polling follower jobs).")
@@ -190,7 +194,8 @@ def main(argv=None):
       out_dir = args.export_dir or os.path.join(args.logdir, "export")
       # serve what eval/decode blessed: EMA weights when the task keeps them
       theta = state.ema_theta if "ema_theta" in state else state.theta
-      export_lib.InferenceGraphExporter.Export(task, theta, out_dir)
+      export_lib.InferenceGraphExporter.Export(
+          task, theta, out_dir, quantize_int8=args.export_int8)
       which = "ema_theta" if "ema_theta" in state else "theta"
       print(f"exported inference bundle ({which}, ckpt step {step}) -> "
             f"{out_dir}")

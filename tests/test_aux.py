@@ -56,6 +56,31 @@ class TestServingExport:
     expected = task.ComputePredictions(theta, x)
     np.testing.assert_allclose(np.asarray(out["out"]), np.asarray(expected),
                                rtol=1e-5)
+    assert predictor.Int8Weights() is None  # float export
+
+    # int8 deployment export: weights frozen to the dequantized int8 grid
+    # + the true low-bit artifact in the bundle
+    int8_dir = str(tmp_path / "export_int8")
+    manifest8 = export_lib.InferenceGraphExporter.Export(
+        task, theta, int8_dir, quantize_int8=True)
+    assert manifest8["quantize_int8"]
+    assert "proj.w" in manifest8["int8_weights"]
+    p8 = export_lib.Predictor(int8_dir)
+    out8 = p8.Run("default", x)
+    # close to float serving (8-bit per-channel error only)
+    np.testing.assert_allclose(np.asarray(out8["out"]),
+                               np.asarray(expected), atol=0.05)
+    art = p8.Int8Weights()
+    w8 = art["proj.w"]["w_int8"]
+    scale = art["proj.w"]["scale"]
+    assert np.asarray(w8).dtype == np.int8
+    # the artifact dequantizes to exactly what the graph serves
+    from lingvo_tpu.core import quant_utils
+    y_int8 = quant_utils.Int8Einsum(
+        jnp.asarray(x.x), jnp.asarray(w8), jnp.asarray(scale))
+    np.testing.assert_allclose(np.asarray(y_int8) +
+                               np.asarray(p8._theta.proj.b),
+                               np.asarray(out8["out"]), atol=0.05)
 
 
 class TestEarlyStop:
