@@ -206,13 +206,15 @@ import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 pid, port, logdir = sys.argv[1], sys.argv[2], sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "train"
 from lingvo_tpu import trainer
-rc = trainer.main([
+args = [
     "--model=lm.synthetic_packed_input.DenseLmTiny",
-    f"--logdir={logdir}", "--mode=train", "--max_steps=3",
+    f"--logdir={logdir}", f"--mode={mode}", "--max_steps=3",
     f"--coordinator_address=localhost:{port}",
     "--num_processes=2", f"--process_id={pid}",
-])
+]
+rc = trainer.main(args)  # default --job takes the inline path for eval
 assert rc == 0, rc
 print(f"proc{pid} OK", flush=True)
 """
@@ -241,6 +243,11 @@ class TestMultiProcessDistributed:
     mgr = ocp.CheckpointManager(str(logdir / "train"))
     assert mgr.latest_step() is not None
     mgr.close()
+    # 2-process --mode=eval against the trained logdir: restored state is
+    # placed onto the mesh, finite eval streams coordinate across hosts
+    _RunPair(script, [str(logdir), "eval"])
+    # eval actually ran and its single writer produced the artifact
+    assert (logdir / "eval_test" / "summaries.jsonl").exists()
 
   def test_train_save_restore_new_topology(self, tmp_path):
     """E2E multi-host hardening (VERDICT r3 next #5): 2-process FSDP
