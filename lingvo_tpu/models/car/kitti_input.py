@@ -160,7 +160,8 @@ class KittiSceneInputGenerator(
       cam_to_velo = None
       if scene.get("calib"):
         cam_to_velo = CameraToVeloTransformation(scene["calib"])
-    except (UnicodeDecodeError, json.JSONDecodeError, ValueError, TypeError):
+    except (UnicodeDecodeError, json.JSONDecodeError, ValueError, TypeError,
+            KeyError):
       return None  # malformed record/geometry: drop, never kill the pipeline
     boxes, classes, difficulties = [], [], []
     for obj in labels:

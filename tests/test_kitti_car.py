@@ -223,6 +223,44 @@ class TestKittiE2E:
     assert all(0.0 <= v <= 1.0 for v in vals.values())
 
 
+class TestKittiConverter:
+
+  def test_raw_tree_to_jsonl_feeds_input(self, tmp_path):
+    """Raw KITTI layout -> JSONL -> KittiSceneInputGenerator batches."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "kitti_to_jsonl",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                     "tools", "kitti_to_jsonl.py"))
+    conv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(conv)
+
+    root = tmp_path / "training"
+    for sub in ("velodyne", "label_2", "calib"):
+      (root / sub).mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for i in range(3):
+      pts = rng.uniform(0, 15, (50, 4)).astype(np.float32)
+      pts.tofile(root / "velodyne" / f"{i:06d}.bin")
+      (root / "label_2" / f"{i:06d}.txt").write_text(
+          "Car 0.00 0 1.57 0 0 50 50 1.5 1.6 4.0 5.0 1.0 10.0 -1.57\n")
+      (root / "calib" / f"{i:06d}.txt").write_text(
+          "R0_rect: 1 0 0 0 1 0 0 0 1\n"
+          "Tr_velo_to_cam: 0 -1 0 0 0 0 -1 0 1 0 0 0\n")
+    out = tmp_path / "scenes.jsonl"
+    n = conv.Convert(str(root), str(out))
+    assert n == 3
+
+    p = kitti_input.KittiSceneInputGenerator.Params().Set(
+        batch_size=2, file_pattern=f"text:{out}", num_classes=3,
+        max_points=64, max_objects=4, grid_size=8,
+        grid_range_x=(0.0, 16.0), grid_range_y=(-8.0, 8.0))
+    gen = p.Instantiate()
+    b = gen.GetPreprocessedInputBatch()
+    assert b.lasers.shape == (2, 64, 4)
+    assert (np.asarray(b.gt_classes) == 1).any()  # the Car survived
+
+
 class TestCalibration:
 
   def test_curve_and_ece(self):

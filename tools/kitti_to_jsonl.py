@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Convert a raw KITTI object-detection tree to JSONL scene records.
+
+The file-format bridge for `models/car/kitti_input.KittiSceneInputGenerator`
+(ref `lingvo/tasks/car/tools/kitti_exporter.py`, which writes TFRecords of
+TF Examples — here the target is the framework's JSON-line scene format,
+one object per line:
+  {"points": [[x, y, z, reflectance], ...],
+   "labels": ["Car 0.00 0 ...", ...],
+   "calib": {"R0_rect": [...9], "Tr_velo_to_cam": [...12]}}).
+
+Expected input layout (the standard KITTI training split):
+  <root>/velodyne/XXXXXX.bin   float32 [N, 4] point clouds
+  <root>/label_2/XXXXXX.txt    label lines (absent for test splits)
+  <root>/calib/XXXXXX.txt      "KEY: v v v ..." calibration lines
+
+Usage:
+  kitti_to_jsonl.py --root=/data/kitti/training --output=train.jsonl \
+      [--max_points=120000] [--shards=8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def ReadVelodyne(path: str, max_points: int = 0) -> np.ndarray:
+  pts = np.fromfile(path, dtype=np.float32).reshape(-1, 4)
+  if max_points and len(pts) > max_points:
+    idx = np.random.RandomState(0).choice(len(pts), max_points,
+                                          replace=False)
+    pts = pts[np.sort(idx)]
+  return pts
+
+
+def ReadCalib(path: str) -> dict:
+  """KITTI calib file -> the two matrices the scene format carries."""
+  out = {}
+  with open(path) as f:
+    for line in f:
+      if ":" not in line:
+        continue
+      key, vals = line.split(":", 1)
+      key = key.strip()
+      if key in ("R0_rect", "Tr_velo_to_cam"):
+        out[key] = [float(v) for v in vals.split()]
+  return out
+
+
+def SceneRecord(velo_path: str, label_path: str | None,
+                calib_path: str | None, max_points: int) -> dict:
+  # float64 before round: float32 values re-expand to ~17-digit doubles
+  # in JSON, tripling the output size the rounding was meant to shrink
+  rec = {"points": ReadVelodyne(
+      velo_path, max_points).astype(np.float64).round(4).tolist()}
+  if label_path and os.path.exists(label_path):
+    with open(label_path) as f:
+      rec["labels"] = [ln.strip() for ln in f if ln.strip()]
+  if calib_path and os.path.exists(calib_path):
+    calib = ReadCalib(calib_path)
+    # both matrices or none: a partial calib would crash the consumer's
+    # camera->velo transform instead of falling back to the nominal one
+    if set(calib) == {"R0_rect", "Tr_velo_to_cam"}:
+      rec["calib"] = calib
+  return rec
+
+
+def Convert(root: str, output: str, max_points: int = 0,
+            shards: int = 1) -> int:
+  velos = sorted(glob.glob(os.path.join(root, "velodyne", "*.bin")))
+  if not velos:
+    raise FileNotFoundError(f"no velodyne/*.bin under {root}")
+  outs = []
+  if shards <= 1:
+    outs = [open(output, "w")]
+  else:
+    outs = [open(f"{output}-{i:05d}-of-{shards:05d}", "w")
+            for i in range(shards)]
+  n = 0
+  try:
+    for velo in velos:
+      stem = os.path.splitext(os.path.basename(velo))[0]
+      rec = SceneRecord(
+          velo,
+          os.path.join(root, "label_2", f"{stem}.txt"),
+          os.path.join(root, "calib", f"{stem}.txt"),
+          max_points)
+      outs[n % len(outs)].write(json.dumps(rec) + "\n")
+      n += 1
+  finally:
+    for f in outs:
+      f.close()
+  return n
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument("--root", required=True,
+                  help="KITTI split dir containing velodyne/ label_2/ calib/")
+  ap.add_argument("--output", required=True,
+                  help="Output JSONL path (sharded suffixes when --shards>1).")
+  ap.add_argument("--max_points", type=int, default=0,
+                  help="Subsample clouds beyond this many points (0 = keep).")
+  ap.add_argument("--shards", type=int, default=1)
+  args = ap.parse_args(argv)
+  n = Convert(args.root, args.output, args.max_points, args.shards)
+  print(f"wrote {n} scenes")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
