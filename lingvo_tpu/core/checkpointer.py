@@ -42,6 +42,7 @@ class Checkpointer:
     self._sanity_checks = sanity_checks
     self._last_save_time = time.time()
     self._last_save_step = -1
+    self._last_probe_step = -(self._SECONDS_CHECK_STRIDE + 1)
     options = ocp.CheckpointManagerOptions(
         max_to_keep=max_to_keep,
         keep_period=keep_every_n_steps,
@@ -66,9 +67,19 @@ class Checkpointer:
       if jax.process_count() > 1:
         # the broadcast is a blocking cross-host barrier: probe the clock
         # on a coarse step stride (a save lands at most stride steps late)
-        # instead of taxing every step
-        if step % self._SECONDS_CHECK_STRIDE != 0:
+        # instead of taxing every step. Stride by steps-since-last-probe,
+        # not step % stride: the executor advances step by
+        # tpu_steps_per_loop per Save call, and for loop sizes coprime
+        # with the stride a modulus probe fires in as few as 1 in stride
+        # calls, widening the data-loss window stride-fold.
+        if self._last_probe_step > step:
+          # step rolled backwards (crash-retry Restore replays from an
+          # older checkpoint): a stale high-water probe step would suppress
+          # probing for the whole replayed span
+          self._last_probe_step = -(self._SECONDS_CHECK_STRIDE + 1)
+        if step - self._last_probe_step < self._SECONDS_CHECK_STRIDE:
           return False
+        self._last_probe_step = step
         due = (time.time() - self._last_save_time
                >= self._save_interval_seconds)
         from jax.experimental import multihost_utils

@@ -18,6 +18,21 @@ def CalibrationCurve(scores: np.ndarray, hits: np.ndarray,
   zero scores land in bin 1)."""
   scores = np.asarray(scores, np.float64)
   hits = np.asarray(hits, np.float64)
+  bad = ~np.isfinite(scores)
+  if bad.any():
+    import warnings
+    warnings.warn(
+        f"{int(bad.sum())} non-finite calibration scores dropped — "
+        "pass probabilities")
+    scores, hits = scores[~bad], hits[~bad]
+  if scores.size and (scores.min() < 0.0 or scores.max() > 1.0):
+    # unsigmoided logits fed as 'scores' would silently fall outside every
+    # bin and shrink the ECE; clip (and warn) so every detection is counted
+    import warnings
+    warnings.warn(
+        f"calibration scores outside [0, 1] (min={scores.min():.3g}, "
+        f"max={scores.max():.3g}); clipping — pass probabilities")
+    scores = np.clip(scores, 0.0, 1.0)
   edges = np.linspace(0.0, 1.0, num_bins + 1)
   bin_indices = np.digitize(scores, edges, right=True)
   bin_indices = np.where(scores == 0.0, 1, bin_indices)

@@ -445,9 +445,22 @@ class DecodeProgram(BaseProgram):
         if jax.process_count() > 1:
           # batch-sharded outputs are not host-addressable: gather the
           # global tree so postprocess sees every example (every process
-          # computes identical metrics; only process 0 writes)
+          # computes identical metrics; only process 0 writes). Global
+          # fully-replicated leaves (scalar counters, reduced statistics a
+          # task adds to its Decode output) skip the collective — every
+          # process already holds the value; everything else (global
+          # batch-sharded arrays, host-local or numpy leaves that differ
+          # per process) goes through process_allgather as before.
           from jax.experimental import multihost_utils
-          out = multihost_utils.process_allgather(out, tiled=True)
+
+          def _GatherLeaf(leaf):
+            if (isinstance(leaf, jax.Array)
+                and not leaf.is_fully_addressable
+                and leaf.is_fully_replicated):
+              return np.asarray(leaf.addressable_shards[0].data)
+            return multihost_utils.process_allgather(leaf, tiled=True)
+
+          out = jax.tree_util.tree_map(_GatherLeaf, out)
         host_out = jax.tree_util.tree_map(np.asarray, out)
         if n == 0 and isinstance(host_out, NestedMap) and (
             jax.process_index() == 0):
