@@ -527,7 +527,27 @@ def main():
       "vs_baseline": round(mfu / 0.45, 4),
       "detail": detail,
   }
-  print(json.dumps(result))
+  print(json.dumps(result), flush=True)
+
+  # The moment a TPU probe finally succeeds, run the MoE design-space sweep
+  # unattended and write it into BASELINE.md — the tunnel windows are short
+  # and there is no human in the loop (VERDICT r4 Next #1b). The primary
+  # JSON line is already out, so a sweep crash can't cost the bench result.
+  if on_tpu and os.environ.get("BENCH_SWEEP", "1") != "0":
+    try:
+      repo = os.path.dirname(os.path.abspath(__file__))
+      sys.path.insert(0, os.path.join(repo, "tools"))
+      import moe_sweep
+      gc.collect()
+      sweep = moe_sweep.RunSweep(
+          budget_s=float(os.environ.get("BENCH_SWEEP_BUDGET_S", "1500")),
+          out_path=os.path.join(repo, "MOE_SWEEP.jsonl"))
+      moe_sweep.WriteBaselineSection(sweep, os.path.join(repo, "BASELINE.md"))
+      print(f"bench: auto-sweep recorded {len(sweep)} variants to "
+            "MOE_SWEEP.jsonl + BASELINE.md", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+      print(f"bench: auto-sweep failed: {e}", file=sys.stderr)
+
   if not on_tpu and not os.environ.get("BENCH_FORCE_CPU"):
     sys.exit(3)
 

@@ -338,9 +338,16 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
     body_fn = _Body
     if p.per_layer_checkpoint and p.remat_policy != "none":
       if p.remat_policy == "dots":
-        body_fn = jax.checkpoint(
-            _Body,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        # also pin the MoE dispatch/combine all-to-all outputs (tagged via
+        # checkpoint_name in gshard._DispatchShardMap): without this the
+        # backward pass replays both forward all-to-alls per MoE layer —
+        # pure ICI traffic for activations 'dots' would have saved anyway
+        # had the dispatch been a matmul
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "moe_dispatched", "moe_combined"))
+        body_fn = jax.checkpoint(_Body, policy=policy)
       else:
         body_fn = jax.checkpoint(_Body)
     out, aux_per_layer = jax.lax.scan(body_fn, inputs,

@@ -1,0 +1,597 @@
+"""3D detection data augmentation: host-side numpy scene transforms.
+
+Re-designs the capability of the reference's augmentation preprocessors
+(`lingvo/tasks/car/input_preprocessors.py`: RandomWorldRotationAboutZAxis
+:1754, WorldScaling:2088, RandomDropLaserPoints:2156, RandomFlipY:2204,
+GlobalTranslateNoise:2278, RandomBBoxTransform:2361, GroundTruthAugmentor
+:2708, FrustumDropout:3093, RandomApplyPreprocessor:3298,
+RandomChoicePreprocessor:3445, Sequence:3527) for the TPU-native input
+design: the reference runs these as TF graph ops inside the input pipeline;
+here scenes are plain numpy on the host (points [N,F] with xyz in columns
+0:3, boxes [M,7] (x,y,z,dx,dy,dz,phi), classes [M]) transformed BEFORE the
+fixed-shape view assembly, so the device program never sees dynamic shapes.
+
+Composable `Augmentor` objects with `Apply(scene, rng) -> scene`; build a
+pipeline from Params via `BuildPipeline`, hook it on the KITTI/Waymo
+generators with `p.augmentors`. All randomness flows through one
+numpy Generator seeded per record for reproducibility.
+
+Scene contract: NestedMap(points [N,F>=3] f32, boxes [M,7] f32,
+classes [M] i32); augmentors must keep dtypes and the [*,7] box layout.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from lingvo_tpu.core import hyperparams
+from lingvo_tpu.core.nested_map import NestedMap
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers (numpy; device-side twins live in detection_3d.py)
+# ---------------------------------------------------------------------------
+
+
+def RotZ(phi: float) -> np.ndarray:
+  c, s = math.cos(phi), math.sin(phi)
+  return np.array([[c, -s, 0.0], [s, c, 0.0], [0.0, 0.0, 1.0]], np.float32)
+
+
+def PointsInBoxes(points: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+  """points [N,>=3], boxes [M,7] -> bool [N,M] membership.
+
+  A point is in a box when its box-frame coordinates fall inside the
+  half-dimensions (ref geometry.IsWithinBBox3D semantics).
+  """
+  n = points.shape[0]
+  m = boxes.shape[0]
+  if n == 0 or m == 0:
+    return np.zeros((n, m), bool)
+  xyz = points[:, None, :3] - boxes[None, :, :3]              # [N,M,3]
+  c = np.cos(-boxes[:, 6])
+  s = np.sin(-boxes[:, 6])
+  x = xyz[..., 0] * c[None] - xyz[..., 1] * s[None]
+  y = xyz[..., 0] * s[None] + xyz[..., 1] * c[None]
+  z = xyz[..., 2]
+  half = boxes[:, 3:6] / 2.0
+  return ((np.abs(x) <= half[None, :, 0]) &
+          (np.abs(y) <= half[None, :, 1]) &
+          (np.abs(z) <= half[None, :, 2]))
+
+
+def _BevCorners(boxes: np.ndarray) -> np.ndarray:
+  """[M,7] -> [M,4,2] rotated BEV rectangle corners."""
+  m = boxes.shape[0]
+  dx, dy = boxes[:, 3] / 2.0, boxes[:, 4] / 2.0
+  base = np.stack([np.stack([dx, dy], -1), np.stack([-dx, dy], -1),
+                   np.stack([-dx, -dy], -1), np.stack([dx, -dy], -1)],
+                  axis=1)                                      # [M,4,2]
+  c, s = np.cos(boxes[:, 6]), np.sin(boxes[:, 6])
+  rot = np.stack([np.stack([c, -s], -1), np.stack([s, c], -1)], axis=1)
+  return np.einsum("mij,mkj->mki", rot, base) + boxes[:, None, :2]
+
+
+def BevBoxOverlap(boxes_a: np.ndarray, boxes_b: np.ndarray) -> np.ndarray:
+  """Conservative BEV overlap test [A,B] via separating-axis on the two
+  rectangles' axes — exact for rectangles (used for collision REJECTION, so
+  exactness beats IoU magnitude; ref GroundTruthAugmentor filters samples
+  by bboxes3d overlap)."""
+  a, b = boxes_a.shape[0], boxes_b.shape[0]
+  if a == 0 or b == 0:
+    return np.zeros((a, b), bool)
+  ca = _BevCorners(boxes_a)                                    # [A,4,2]
+  cb = _BevCorners(boxes_b)                                    # [B,4,2]
+  overlap = np.ones((a, b), bool)
+  for boxes, from_a in ((boxes_a, True), (boxes_b, False)):
+    phis = boxes[:, 6]
+    axes = np.stack(
+        [np.stack([np.cos(phis), np.sin(phis)], -1),
+         np.stack([-np.sin(phis), np.cos(phis)], -1)], axis=1)  # [M,2,2]
+    pa = np.einsum("akd,mjd->amjk", ca, axes)  # [A,M,2 axes,4 corners]
+    pb = np.einsum("bkd,mjd->bmjk", cb, axes)
+    if from_a:
+      ia = np.arange(a)
+      a_lo, a_hi = pa[ia, ia].min(-1), pa[ia, ia].max(-1)       # [A,2]
+      b_lo, b_hi = pb.min(-1), pb.max(-1)                       # [B,A,2]
+      sep = (a_hi[None] < b_lo) | (b_hi < a_lo[None])           # [B,A,2]
+      overlap &= ~sep.any(-1).T
+    else:
+      ib = np.arange(b)
+      b_lo, b_hi = pb[ib, ib].min(-1), pb[ib, ib].max(-1)       # [B,2]
+      a_lo, a_hi = pa.min(-1), pa.max(-1)                       # [A,B,2]
+      sep = (b_hi[None] < a_lo) | (a_hi < b_lo[None])           # [A,B,2]
+      overlap &= ~sep.any(-1)
+  return overlap
+
+
+# ---------------------------------------------------------------------------
+# augmentor base + pipeline
+# ---------------------------------------------------------------------------
+
+
+def _With(scene: NestedMap, **updates) -> NestedMap:
+  out = scene.Copy() if hasattr(scene, "Copy") else NestedMap(dict(scene))
+  for k, v in updates.items():
+    out[k] = v
+  return out
+
+
+def _KeepBoxes(scene: NestedMap, keep: np.ndarray) -> NestedMap:
+  """Applies a per-box keep mask to boxes/classes (+difficulty and any
+  `box_extras` per-box arrays if carried)."""
+  updates = dict(boxes=scene.boxes[keep], classes=scene.classes[keep])
+  if scene.Get("difficulty") is not None:
+    updates["difficulty"] = scene.difficulty[keep]
+  if scene.Get("box_extras") is not None:
+    updates["box_extras"] = {k: v[keep]
+                             for k, v in scene.box_extras.items()}
+  return _With(scene, **updates)
+
+
+class Augmentor:
+  """One scene transform. Subclasses override _Apply."""
+
+  @classmethod
+  def Params(cls):
+    p = hyperparams.InstantiableParams(cls)
+    p.Define("name", cls.__name__, "Augmentor name.")
+    return p
+
+  def __init__(self, params):
+    self.p = params.Copy()
+    self.p.Freeze()
+
+  def Apply(self, scene: NestedMap, rng: np.random.Generator) -> NestedMap:
+    out = self._Apply(scene, rng)
+    assert out.points.dtype == np.float32 and out.boxes.dtype == np.float32
+    return out
+
+  def _Apply(self, scene, rng):
+    raise NotImplementedError
+
+
+def BuildPipeline(augmentor_params: list) -> list:
+  return [p.Instantiate() for p in augmentor_params]
+
+
+def ApplyPipeline(augmentors: list, scene: NestedMap, seed: int) -> NestedMap:
+  rng = np.random.default_rng(seed)
+  for a in augmentors:
+    scene = a.Apply(scene, rng)
+  return scene
+
+
+def MakeScene(points, boxes, classes) -> NestedMap:
+  return NestedMap(
+      points=np.asarray(points, np.float32).reshape(-1, 4)
+      if np.asarray(points).ndim != 2 else np.asarray(points, np.float32),
+      boxes=np.asarray(boxes, np.float32).reshape(-1, 7),
+      classes=np.asarray(classes, np.int32).reshape(-1))
+
+
+# ---------------------------------------------------------------------------
+# world-level transforms
+# ---------------------------------------------------------------------------
+
+
+class RandomWorldRotationAboutZAxis(Augmentor):
+  """Rotate the whole scene about +z by U(-max, +max) (ref :1754)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("max_rotation", math.pi / 4.0,
+             "Rotation sampled from U(-max_rotation, max_rotation).")
+    return p
+
+  def _Apply(self, scene, rng):
+    phi = float(rng.uniform(-self.p.max_rotation, self.p.max_rotation))
+    rot = RotZ(phi)
+    pts = scene.points.copy()
+    pts[:, :3] = pts[:, :3] @ rot.T
+    boxes = scene.boxes.copy()
+    if boxes.size:
+      boxes[:, :3] = boxes[:, :3] @ rot.T
+      boxes[:, 6] = boxes[:, 6] + phi
+    return _With(scene, points=pts, boxes=boxes)
+
+
+class RandomFlipY(Augmentor):
+  """Mirror the scene across the x axis (y -> -y) with probability
+  flip_probability (ref :2204; phi -> -phi under the mirror)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("flip_probability", 0.5, "P(flip).")
+    return p
+
+  def _Apply(self, scene, rng):
+    if rng.uniform() >= self.p.flip_probability:
+      return scene
+    pts = scene.points.copy()
+    pts[:, 1] = -pts[:, 1]
+    boxes = scene.boxes.copy()
+    if boxes.size:
+      boxes[:, 1] = -boxes[:, 1]
+      boxes[:, 6] = -boxes[:, 6]
+    return _With(scene, points=pts, boxes=boxes)
+
+
+class WorldScaling(Augmentor):
+  """Scale the world uniformly by U(min, max) (ref :2088). Dimensions and
+  positions scale; angles don't."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("scaling", (0.95, 1.05), "(min, max) uniform scale range.")
+    return p
+
+  def _Apply(self, scene, rng):
+    lo, hi = self.p.scaling
+    s = float(rng.uniform(lo, hi))
+    pts = scene.points.copy()
+    pts[:, :3] *= s
+    boxes = scene.boxes.copy()
+    if boxes.size:
+      boxes[:, :6] *= s
+    return _With(scene, points=pts, boxes=boxes)
+
+
+class GlobalTranslateNoise(Augmentor):
+  """Translate the whole scene by N(0, std) per axis (ref :2278)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("noise_std", (0.2, 0.2, 0.2), "(x, y, z) translation stds.")
+    return p
+
+  def _Apply(self, scene, rng):
+    t = rng.normal(0.0, self.p.noise_std).astype(np.float32)
+    pts = scene.points.copy()
+    pts[:, :3] += t
+    boxes = scene.boxes.copy()
+    if boxes.size:
+      boxes[:, :3] += t
+    return _With(scene, points=pts, boxes=boxes)
+
+
+# ---------------------------------------------------------------------------
+# point-level transforms
+# ---------------------------------------------------------------------------
+
+
+class RandomDropLaserPoints(Augmentor):
+  """Keep each laser point with probability keep_prob (ref :2156)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("keep_prob", 0.95, "Per-point keep probability.")
+    return p
+
+  def _Apply(self, scene, rng):
+    keep = rng.uniform(size=scene.points.shape[0]) < self.p.keep_prob
+    return _With(scene, points=scene.points[keep])
+
+
+class FrustumDropout(Augmentor):
+  """Drop (or noise) points inside a random view frustum (ref :3093).
+
+  Picks a random KEPT point, converts points to (theta, phi) spherical
+  angles from the sensor origin, and drops points whose angles fall within
+  (theta_width, phi_width) of the picked point's — with `keep_prob` giving
+  each in-frustum point a survival chance, and distance-gating via
+  `drop_type`: 'union' drops all in-frustum points, 'far' only those
+  farther than the picked point.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("theta_width", 0.03, "Azimuth half... full width (radians).")
+    p.Define("phi_width", 0.0, "Elevation width (radians); 0 = all.")
+    p.Define("keep_prob", 0.0, "In-frustum survival probability.")
+    p.Define("drop_type", "union", "'union' | 'far'.")
+    return p
+
+  def _Apply(self, scene, rng):
+    pts = scene.points
+    n = pts.shape[0]
+    if n == 0:
+      return scene
+    xyz = pts[:, :3]
+    r_xy = np.hypot(xyz[:, 0], xyz[:, 1])
+    theta = np.arctan2(xyz[:, 1], xyz[:, 0])
+    phi = np.arctan2(xyz[:, 2], np.maximum(r_xy, 1e-6))
+    i = int(rng.integers(n))
+    d_theta = np.abs(np.angle(np.exp(1j * (theta - theta[i]))))
+    in_frustum = d_theta <= self.p.theta_width / 2.0
+    if self.p.phi_width > 0:
+      in_frustum &= np.abs(phi - phi[i]) <= self.p.phi_width / 2.0
+    if self.p.drop_type == "far":
+      dist = np.linalg.norm(xyz, axis=-1)
+      in_frustum &= dist >= dist[i]
+    survive = rng.uniform(size=n) < self.p.keep_prob
+    keep = ~in_frustum | survive
+    return _With(scene, points=scene.points[keep])
+
+
+# ---------------------------------------------------------------------------
+# box-level transforms
+# ---------------------------------------------------------------------------
+
+
+class RandomBBoxTransform(Augmentor):
+  """Independently jitter each gt box (rotation about its center +
+  translation noise), carrying the points inside it along and rejecting
+  moves that collide with another box (ref :2361).
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("max_rotation", math.pi / 10.0, "Per-box yaw jitter bound.")
+    p.Define("noise_std", (0.5, 0.5, 0.0), "Per-box translation stds.")
+    return p
+
+  def _Apply(self, scene, rng):
+    boxes = scene.boxes.copy()
+    pts = scene.points.copy()
+    m = boxes.shape[0]
+    if m == 0:
+      return scene
+    membership = PointsInBoxes(pts, boxes)                     # [N,M]
+    for j in range(m):
+      phi = float(rng.uniform(-self.p.max_rotation, self.p.max_rotation))
+      t = rng.normal(0.0, self.p.noise_std).astype(np.float32)
+      cand = boxes[j].copy()
+      cand[:3] += t
+      cand[6] += phi
+      others = np.delete(boxes, j, axis=0)
+      if others.size and BevBoxOverlap(cand[None], others).any():
+        continue  # collision: keep the original placement
+      inside = membership[:, j]
+      if inside.any():
+        rel = pts[inside, :3] - boxes[j, :3]
+        pts[inside, :3] = rel @ RotZ(phi).T + boxes[j, :3] + t
+      boxes[j] = cand
+    return _With(scene, points=pts, boxes=boxes)
+
+
+class GroundTruthAugmentor(Augmentor):
+  """Paste ground-truth objects sampled from a database into the scene
+  (ref :2708): each db entry is a (box, class, points-in-box) triple
+  harvested from other scenes; sampled entries are added unless they
+  overlap an existing (or already-pasted) box in BEV.
+
+  db: list of dicts {"box": [7], "class": int, "points": [K,F]} — build one
+  with `BuildGroundTruthDb` over the training scenes.
+  """
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("db", [], "Ground-truth database (list of entry dicts).")
+    p.Define("num_to_add", 4, "Target objects pasted per scene.")
+    p.Define("filter_min_points", 1,
+             "Skip db entries with fewer interior points.")
+    p.Define("allowed_classes", (), "If set, only paste these class ids.")
+    return p
+
+  def _Apply(self, scene, rng):
+    p = self.p
+    db = [e for e in p.db
+          if len(e["points"]) >= p.filter_min_points
+          and (not p.allowed_classes or e["class"] in p.allowed_classes)]
+    if not db:
+      return scene
+    pts = scene.points
+    boxes = scene.boxes
+    classes = scene.classes
+    order = rng.permutation(len(db))
+    added = 0
+    for idx in order:
+      if added >= p.num_to_add:
+        break
+      entry = db[int(idx)]
+      cand = np.asarray(entry["box"], np.float32)
+      if boxes.size and BevBoxOverlap(cand[None], boxes).any():
+        continue
+      new_pts = np.asarray(entry["points"], np.float32)
+      if new_pts.shape[1] < pts.shape[1]:   # pad missing features with 0
+        pad = np.zeros((new_pts.shape[0], pts.shape[1] - new_pts.shape[1]),
+                       np.float32)
+        new_pts = np.concatenate([new_pts, pad], axis=1)
+      new_pts = new_pts[:, :pts.shape[1]]
+      # carve out any scene points inside the pasted box (the real object
+      # occludes whatever background was there)
+      if pts.size:
+        inside = PointsInBoxes(pts, cand[None])[:, 0]
+        pts = pts[~inside]
+      pts = np.concatenate([pts, new_pts], axis=0)
+      boxes = np.concatenate([boxes, cand[None]], axis=0)
+      classes = np.concatenate(
+          [classes, np.asarray([entry["class"]], np.int32)])
+      if scene.Get("difficulty") is not None:
+        scene = _With(scene, difficulty=np.concatenate(
+            [scene.difficulty,
+             np.asarray([entry.get("difficulty", -1)], np.int32)]))
+      if scene.Get("box_extras") is not None:
+        # pasted entries have no per-box extras: pad with zeros
+        scene = _With(scene, box_extras={
+            k: np.concatenate([v, np.zeros((1,) + v.shape[1:], v.dtype)])
+            for k, v in scene.box_extras.items()})
+      added += 1
+    return _With(scene, points=pts.astype(np.float32),
+                 boxes=boxes.astype(np.float32), classes=classes)
+
+
+def BuildGroundTruthDb(scenes, min_points: int = 1) -> list:
+  """Harvest (box, class, interior points) entries from scene dicts/NestedMaps
+  (the GroundTruthAugmentor's database builder; the reference ships a
+  separate tool — `create_kitti_crop_dataset` — that writes the same thing
+  to disk)."""
+  db = []
+  for sc in scenes:
+    pts = np.asarray(sc["points"] if isinstance(sc, dict) else sc.points,
+                     np.float32)
+    boxes = np.asarray(sc["boxes"] if isinstance(sc, dict) else sc.boxes,
+                       np.float32).reshape(-1, 7)
+    classes = np.asarray(
+        sc["classes"] if isinstance(sc, dict) else sc.classes, np.int32)
+    if not boxes.size:
+      continue
+    member = PointsInBoxes(pts, boxes)
+    for j in range(boxes.shape[0]):
+      interior = pts[member[:, j]]
+      if interior.shape[0] >= min_points:
+        db.append({"box": boxes[j].tolist(), "class": int(classes[j]),
+                   "points": interior})
+  return db
+
+
+# ---------------------------------------------------------------------------
+# filters
+# ---------------------------------------------------------------------------
+
+
+class DropPointsOutOfRange(Augmentor):
+  """Keep only points inside an axis-aligned world-range box (ref
+  DropLaserPointsOutOfRange:1615)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("keep_x_range", (-np.inf, np.inf), "(min, max) x kept.")
+    p.Define("keep_y_range", (-np.inf, np.inf), "(min, max) y kept.")
+    p.Define("keep_z_range", (-np.inf, np.inf), "(min, max) z kept.")
+    return p
+
+  def _Apply(self, scene, rng):
+    del rng
+    p = self.p
+    xyz = scene.points[:, :3]
+    keep = np.ones(xyz.shape[0], bool)
+    for dim, (lo, hi) in enumerate(
+        (p.keep_x_range, p.keep_y_range, p.keep_z_range)):
+      keep &= (xyz[:, dim] >= lo) & (xyz[:, dim] <= hi)
+    return _With(scene, points=scene.points[keep])
+
+
+class DropBoxesOutOfRange(Augmentor):
+  """Drop gt boxes whose centers leave the world range (ref :1956) — after
+  world rotations/translations some boxes have left the detection range."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("keep_x_range", (-np.inf, np.inf), "(min, max) x kept.")
+    p.Define("keep_y_range", (-np.inf, np.inf), "(min, max) y kept.")
+    return p
+
+  def _Apply(self, scene, rng):
+    del rng
+    p = self.p
+    if not scene.boxes.size:
+      return scene
+    c = scene.boxes[:, :2]
+    keep = ((c[:, 0] >= p.keep_x_range[0]) & (c[:, 0] <= p.keep_x_range[1]) &
+            (c[:, 1] >= p.keep_y_range[0]) & (c[:, 1] <= p.keep_y_range[1]))
+    return _KeepBoxes(scene, keep)
+
+
+class FilterGroundTruthByNumPoints(Augmentor):
+  """Drop gt boxes containing fewer than min_num_points lasers (ref :352) —
+  a box with no evidence in the point cloud only teaches the detector to
+  hallucinate."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("min_num_points", 1, "Boxes with fewer interior points drop.")
+    return p
+
+  def _Apply(self, scene, rng):
+    del rng
+    if not scene.boxes.size:
+      return scene
+    counts = PointsInBoxes(scene.points, scene.boxes).sum(0)
+    keep = counts >= self.p.min_num_points
+    return _KeepBoxes(scene, keep)
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+
+
+class RandomApply(Augmentor):
+  """Apply the child with probability prob (ref RandomApplyPreprocessor)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("prob", 0.5, "P(apply child).")
+    p.Define("subprocessor", None, "Child augmentor Params.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._child = self.p.subprocessor.Instantiate()
+
+  def _Apply(self, scene, rng):
+    if rng.uniform() < self.p.prob:
+      return self._child.Apply(scene, rng)
+    return scene
+
+
+class RandomChoice(Augmentor):
+  """Apply exactly one child, picked by weight (ref
+  RandomChoicePreprocessor)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("subprocessors", [], "Child augmentor Params list.")
+    p.Define("weights", None, "Selection weights (None = uniform).")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._children = [sp.Instantiate() for sp in self.p.subprocessors]
+
+  def _Apply(self, scene, rng):
+    if not self._children:
+      return scene
+    w = self.p.weights
+    probs = None
+    if w is not None:
+      w = np.asarray(w, np.float64)
+      probs = w / w.sum()
+    i = int(rng.choice(len(self._children), p=probs))
+    return self._children[i].Apply(scene, rng)
+
+
+class Sequence(Augmentor):
+  """Apply children in order (ref Sequence:3527)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("subprocessors", [], "Child augmentor Params list.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    self._children = [sp.Instantiate() for sp in self.p.subprocessors]
+
+  def _Apply(self, scene, rng):
+    for c in self._children:
+      scene = c.Apply(scene, rng)
+    return scene

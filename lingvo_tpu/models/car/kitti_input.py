@@ -135,6 +135,11 @@ class KittiSceneInputGenerator(
     p.Define("num_classes", 3,
              "Foreground classes kept, in CLASS_IDS order (2 drops "
              "Cyclist, 1 keeps only Car).")
+    p.Define("augmentors", [],
+             "List of augmentation.Augmentor Params applied per scene "
+             "(points + gt boxes) before view assembly. Configure on the "
+             "Train() dataset only (ref input_preprocessors.py train-time "
+             "preprocessor lists).")
     p.bucket_upper_bound = [1]
     return p
 
@@ -146,6 +151,8 @@ class KittiSceneInputGenerator(
     params.bucket_batch_limit = [params.batch_size or 2]
     super().__init__(params)
     self._record_counter = 0
+    from lingvo_tpu.models.car import augmentation
+    self._augmentors = augmentation.BuildPipeline(self.p.augmentors)
 
   def ProcessRecord(self, record: bytes):
     p = self.p
@@ -174,6 +181,18 @@ class KittiSceneInputGenerator(
       boxes.append(bbox)
       classes.append(cls_id)
       difficulties.append(KittiDifficulty(obj))
+
+    if self._augmentors:
+      from lingvo_tpu.models.car import augmentation
+      scene_nm = augmentation.MakeScene(pts, np.asarray(
+          boxes, np.float32).reshape(-1, 7), classes)
+      scene_nm.difficulty = np.asarray(difficulties, np.int32)
+      scene_nm = augmentation.ApplyPipeline(
+          self._augmentors, scene_nm, seed=self._record_counter)
+      pts = scene_nm.points
+      boxes = list(scene_nm.boxes)
+      classes = list(scene_nm.classes)
+      difficulties = list(scene_nm.difficulty)
 
     # lasers: subsample-or-pad to max_points, varying the subsample per
     # record read so repeated epochs see different points

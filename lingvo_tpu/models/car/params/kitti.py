@@ -117,3 +117,17 @@ class StarNetCarTiny(StarNetCar):
     p.train.max_steps = 60
     p.train.tpu_steps_per_loop = 20
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class AnchorFreePillarsCar(PointPillarsCar):
+  """Anchor-free (CenterNet-style) pillars detector (ref
+  `pillars_anchor_free.py` ModelV2 recipe on the pillars backbone)."""
+
+  def Task(self):
+    base = super().Task()
+    p = pillars.AnchorFreePillarsModel.Params()
+    for name in ("featurizer", "backbone", "train"):
+      p.Set(**{name: base.Get(name)})
+    p.name = "car_pillars_anchor_free"
+    return p

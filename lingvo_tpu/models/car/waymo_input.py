@@ -100,6 +100,11 @@ class WaymoSceneInputGenerator(
              "without one — or with a different resolution — get zeros) "
              "— the DeepFusion input (ref deep_fusion.py "
              "MultiModalFeaturizer camera_names).")
+    p.Define("augmentors", [],
+             "List of augmentation.Augmentor Params applied per frame "
+             "(points + gt boxes) before view assembly. Configure on the "
+             "Train() dataset only (ref input_preprocessors.py train-time "
+             "preprocessor lists).")
     p.bucket_upper_bound = [1]
     return p
 
@@ -109,6 +114,8 @@ class WaymoSceneInputGenerator(
     params.bucket_batch_limit = [params.batch_size or 2]
     super().__init__(params)
     self._record_counter = 0
+    from lingvo_tpu.models.car import augmentation
+    self._augmentors = augmentation.BuildPipeline(self.p.augmentors)
 
   def ProcessRecord(self, record: bytes):
     p = self.p
@@ -142,6 +149,27 @@ class WaymoSceneInputGenerator(
             TypeError, AttributeError):
       return None  # malformed frame: drop, never kill the pipeline
     labels = [l for l in labels if l is not None]
+
+    if self._augmentors:
+      from lingvo_tpu.models.car import augmentation
+      scene_nm = augmentation.MakeScene(
+          pts, np.asarray([l[0] for l in labels],
+                          np.float32).reshape(-1, 7),
+          [l[1] for l in labels])
+      scene_nm.difficulty = np.asarray([l[3] for l in labels], np.int32)
+      scene_nm.box_extras = {
+          "num_points": np.asarray([l[2] for l in labels], np.int32),
+          "speed": np.asarray([l[4] for l in labels],
+                              np.float32).reshape(-1, 2),
+      }
+      scene_nm = augmentation.ApplyPipeline(
+          self._augmentors, scene_nm, seed=self._record_counter)
+      pts = scene_nm.points
+      labels = [
+          (scene_nm.boxes[i], int(scene_nm.classes[i]),
+           int(scene_nm.box_extras["num_points"][i]),
+           int(scene_nm.difficulty[i]), scene_nm.box_extras["speed"][i])
+          for i in range(scene_nm.boxes.shape[0])]
 
     from lingvo_tpu.models.car import detection_3d
     (lasers,), lpad = detection_3d.RandomPadOrTrimTo(

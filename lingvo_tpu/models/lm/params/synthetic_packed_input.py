@@ -138,8 +138,16 @@ class MoELm64E(DenseLmTemplate):
   def Task(self):
     p = super().Task()
     p.num_experts = self.NUM_EXPERTS
-    p.moe_num_groups = self.BATCH_SIZE
+    # auto groups = data_axis * expert_axis: groups shard over both axes so
+    # the explicit shard_map all-to-all dispatch engages and no data slice
+    # recomputes another's experts; the GSPMD einsum fallback at
+    # non-divisible group counts costs ~2x the collective-permutes (see
+    # tools/collective_attribution.py, round-5 analysis)
+    p.moe_num_groups = 0
     p.moe_second_expert_policy = "random"
+    # save matmul + dispatched-activation outputs instead of replaying the
+    # whole block (incl. both all-to-alls) in the backward pass
+    p.remat_policy = "dots"
     return p
 
 

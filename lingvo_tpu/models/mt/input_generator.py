@@ -239,3 +239,53 @@ class MassFileInput(base_input_generator.FileBasedSequenceInputGenerator):
         tgt=NestedMap(ids=ex.tgt.ids, labels=ex.tgt.labels,
                       paddings=(1.0 - ex.tgt.weights).astype(np.float32)),
         bucket_key=n)
+
+
+class IdsMtInput(base_input_generator.FileBasedSequenceInputGenerator):
+  """Pre-tokenized MT input: JSONL lines {"src": [ids...], "tgt": [ids...]}
+  with eos-terminated sequences (the t2t translate-shard convention;
+  `tools/t2t_to_jsonl.py` produces this from the reference's real WMT'14
+  wordpiece shards). Target rows follow the teacher-forcing layout: ids
+  sos-prefixed, labels eos-suffixed (ref `tasks/mt/input_generator.py`
+  NmtInput target_id/target_label)."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("source_max_length", 64, "Max source tokens (incl eos).")
+    p.Define("target_max_length", 64, "Max target tokens (incl sos/eos).")
+    p.Define("sos_id", 0, "Teacher-forcing start id (t2t uses pad=0).")
+    p.Define("drop_overlong", True,
+             "Drop examples over the max lengths (False: truncate+eos).")
+    p.bucket_upper_bound = [16, 32, 64]
+    p.bucket_batch_limit = [32, 16, 8]
+    return p
+
+  def ProcessRecord(self, record: bytes):
+    import json as _json
+    p = self.p
+    try:
+      row = _json.loads(record.decode("utf-8"))
+      src = [int(i) for i in row["src"]]
+      tgt = [int(i) for i in row["tgt"]]
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+      return None
+    if not src or not tgt:
+      return None
+    if len(src) > p.source_max_length or len(tgt) + 1 > p.target_max_length:
+      if p.drop_overlong:
+        return None
+      eos = src[-1]
+      src = src[:p.source_max_length - 1] + [eos]
+      tgt = tgt[:p.target_max_length - 2] + [tgt[-1]]
+    src_ids = np.asarray(src, np.int32)
+    tgt_labels = np.asarray(tgt, np.int32)
+    tgt_ids = np.asarray([p.sos_id] + tgt[:-1], np.int32)
+    n_tgt = len(tgt)
+    return NestedMap(
+        src=NestedMap(ids=src_ids,
+                      paddings=np.zeros(len(src), np.float32)),
+        tgt=NestedMap(ids=tgt_ids, labels=tgt_labels,
+                      paddings=np.zeros(n_tgt, np.float32),
+                      weights=np.ones(n_tgt, np.float32)),
+        bucket_key=max(len(src), n_tgt))

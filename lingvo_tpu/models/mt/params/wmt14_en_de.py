@@ -273,3 +273,78 @@ class WmtEnDeXEnDecTiny(WmtEnDeTransformerTiny):
     # gradient; the paper's 1.0 default stays on the full-size config
     p.loss_mix_weight = 0.5
     return p
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtEnDeRealShardSmall(base_model_params.SingleTaskModelParams):
+  """REAL-corpus WMT'14 en-de convergence config (CPU-feasible).
+
+  Trains on the 8,941 professionally-translated sentence pairs in the
+  reference's shipped t2t wordpiece shard
+  (`lingvo/tasks/mt/testdata/translate_ende_wmt32k-train-00511-of-00512`),
+  converted once with `tools/t2t_to_jsonl.py` into
+  `$LINGVO_TPU_DATA_DIR/wmt14_real/{train,dev}.jsonl` (dev = held-out tail;
+  `tools/wmt_convergence.py` does the prep + split + measured run). This is
+  the framework's non-synthetic MT quality trajectory: real text, real
+  wordpiece distribution, token-level corpus BLEU on held-out data.
+
+  Downsized transformer (d=256, 2+2 layers) so the trajectory is measurable
+  on CPU; the full-size recipe is WmtEnDeTransformerBase.
+  """
+
+  VOCAB = 33792  # t2t wmt32k vocab (max observed id 33701), padded to 8x
+  MODEL_DIM = 256
+  NUM_LAYERS = 2
+  NUM_HEADS = 4
+  HIDDEN_DIM = 1024
+  MAX_LEN = 56   # covers p90 of the shard; overlong pairs are dropped
+  BATCH_SIZE = 32
+
+  def _Input(self, name: str, seed: int):
+    import os
+    data_dir = os.environ.get("LINGVO_TPU_DATA_DIR", "/tmp/lingvo_tpu_data")
+    return input_generator.IdsMtInput.Params().Set(
+        file_pattern=f"text:{data_dir}/wmt14_real/{name}",
+        source_max_length=self.MAX_LEN,
+        target_max_length=self.MAX_LEN,
+        bucket_upper_bound=[16, 24, 32, 56],
+        bucket_batch_limit=[3 * self.BATCH_SIZE, 2 * self.BATCH_SIZE,
+                            3 * self.BATCH_SIZE // 2, self.BATCH_SIZE],
+        seed=seed)
+
+  def Train(self):
+    return self._Input("train.jsonl", seed=301)
+
+  def Dev(self):
+    return self._Input("dev.jsonl", seed=7).Set(
+        shuffle=False, max_epochs=1, require_sequential_order=True)
+
+  def Test(self):
+    return self.Dev()
+
+  def Task(self):
+    p = mt_model.TransformerModel.Params()
+    p.name = "wmt14_en_de_real_small"
+    for enc_dec in (p.encoder, p.decoder):
+      enc_dec.vocab_size = self.VOCAB
+      enc_dec.model_dim = self.MODEL_DIM
+      enc_dec.num_layers = self.NUM_LAYERS
+      enc_dec.num_heads = self.NUM_HEADS
+      enc_dec.hidden_dim = self.HIDDEN_DIM
+      enc_dec.residual_dropout_prob = 0.1
+      enc_dec.input_dropout_prob = 0.1
+    p.decoder.label_smoothing = 0.1
+    # t2t convention: no reserved SOS (pad=0 starts decode), eos=1
+    p.decoder.beam_search.target_sos_id = 0
+    p.decoder.beam_search.target_eos_id = 1
+    p.decoder.beam_search.num_hyps_per_beam = 4
+    p.decoder.beam_search.target_seq_len = self.MAX_LEN
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1.0,
+        optimizer=opt_lib.Adam.Params().Set(beta2=0.98),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=500, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=0.0)
+    p.train.max_steps = 4000
+    p.train.tpu_steps_per_loop = 50
+    return p

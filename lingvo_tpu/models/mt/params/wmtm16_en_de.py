@@ -1,0 +1,94 @@
+"""WMT'16 Multi30k En->De caption translation configs (ref
+`lingvo/tasks/mt/params/wmtm16_en_de.py:26` WmtCaptionEnDeTransformer — the
+reference's only published end-task quality baseline: ">30 BLEU in <10k
+steps on a single GPU", `tasks/mt/README.md:84-86`).
+
+Same model recipe as the reference: 2k wordpiece vocab, model_dim 256,
+2+2 layers, 2 heads, ffn 512, dropout 0.2, transformer LR schedule with
+warmup 1000, 12k max steps, 29k-sample training set. Data layout: set
+LINGVO_TPU_DATA_DIR to a root containing `wmtm16/train.en-de.tsv*` (+ BPE
+`wmtm16/bpe.codes`/`bpe.vocab`) prepared from the Multi30k corpus; the
+dataset itself is not redistributable here, so the registered config is the
+measuring instrument for the reference's BLEU bar once the corpus is
+mounted.
+
+`WmtEnDeRealShardSmall` (wmt14_en_de.py) is the companion config that IS
+runnable in this sandbox on real data — see its docstring.
+"""
+
+from __future__ import annotations
+
+import os
+
+from lingvo_tpu import model_registry
+from lingvo_tpu.core import base_model_params
+from lingvo_tpu.core import learner as learner_lib
+from lingvo_tpu.core import optimizer as opt_lib
+from lingvo_tpu.core import schedule as sched_lib
+from lingvo_tpu.models.mt import input_generator
+from lingvo_tpu.models.mt import model as mt_model
+
+
+@model_registry.RegisterSingleTaskModel
+class WmtCaptionEnDeTransformer(base_model_params.SingleTaskModelParams):
+  """Multi30k caption transformer, reference shapes (wmtm16_en_de.py:26)."""
+
+  VOCAB = 2000
+  MODEL_DIM = 256
+  HIDDEN_DIM = 512
+  NUM_HEADS = 2
+  NUM_LAYERS = 2
+  SRC_LEN = 70   # ref train bucket_upper_bound[-1]=75; eval 98
+  TGT_LEN = 70
+  NUM_SAMPLES = 29000
+
+  def _Input(self, pattern: str, seed: int):
+    from lingvo_tpu.core import tokenizers
+    data_dir = os.environ.get("LINGVO_TPU_DATA_DIR", "/tmp/lingvo_tpu_data")
+    return input_generator.TextMtInput.Params().Set(
+        file_pattern=f"text:{data_dir}/wmtm16/{pattern}",
+        tokenizer=tokenizers.BpeTokenizer.Params().Set(
+            codes_filepath=f"{data_dir}/wmtm16/bpe.codes",
+            vocab_filepath=f"{data_dir}/wmtm16/bpe.vocab",
+            vocab_size=self.VOCAB),
+        source_max_length=self.SRC_LEN,
+        target_max_length=self.TGT_LEN,
+        # ref train buckets [14,17,20,24,29,35,45,75] — captions are short
+        bucket_upper_bound=[14, 20, 29, 45, 70],
+        bucket_batch_limit=[128, 96, 64, 48, 32],
+        seed=seed)
+
+  def Train(self):
+    return self._Input("train.en-de.tsv*", seed=0)
+
+  def Dev(self):
+    p = self._Input("val.en-de.tsv", seed=27182818)
+    return p.Set(shuffle=False, max_epochs=1, require_sequential_order=True)
+
+  def Test(self):
+    p = self._Input("test.en-de.tsv", seed=7)
+    return p.Set(shuffle=False, max_epochs=1, require_sequential_order=True)
+
+  def Task(self):
+    p = mt_model.TransformerModel.Params()
+    p.name = "wmtm16_en_de_caption"
+    for enc_dec in (p.encoder, p.decoder):
+      enc_dec.vocab_size = self.VOCAB
+      enc_dec.model_dim = self.MODEL_DIM
+      enc_dec.num_layers = self.NUM_LAYERS
+      enc_dec.num_heads = self.NUM_HEADS
+      enc_dec.hidden_dim = self.HIDDEN_DIM
+      enc_dec.residual_dropout_prob = 0.2
+      enc_dec.input_dropout_prob = 0.2
+    p.decoder.label_smoothing = 0.1
+    p.decoder.beam_search.num_hyps_per_beam = 4
+    p.decoder.beam_search.target_seq_len = self.TGT_LEN
+    p.train.learner = learner_lib.Learner.Params().Set(
+        learning_rate=1.0,
+        optimizer=opt_lib.Adam.Params().Set(beta2=0.98),
+        lr_schedule=sched_lib.TransformerSchedule.Params().Set(
+            warmup_steps=1000, model_dim=self.MODEL_DIM),
+        clip_gradient_norm_to_value=0.0)
+    p.train.max_steps = 12000
+    p.train.tpu_steps_per_loop = 100
+    return p

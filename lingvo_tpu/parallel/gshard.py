@@ -472,7 +472,8 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     self.CreateChild("dropout", layers_lib.DeterministicDropoutLayer.Params())
 
   def _NumGroups(self, b: int, t: int) -> int:
-    """p.num_groups, or auto = the mesh's 'expert' (else 'data') axis size,
+    """p.num_groups, or auto = data_axis * expert_axis (groups shard over
+    BOTH: each data slice routes only its own tokens — see _GroupAxes),
     clamped to a divisor of the token count. An explicit num_groups that
     does not divide the tokens fails loudly (silently changing G would
     change per-group capacity semantics)."""
@@ -482,12 +483,30 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
       assert (b * t) % g == 0, (
           f"num_groups={g} must divide batch*time={b * t}")
       return g
-    g = (mesh_lib.CurrentMeshAxisSize("expert")
-         or mesh_lib.CurrentMeshAxisSize("data") or min(b, 8))
+    g = ((mesh_lib.CurrentMeshAxisSize("expert") or 1)
+         * (mesh_lib.CurrentMeshAxisSize("data") or 1))
+    if g == 1:
+      g = min(b, 8)
     g = min(g, b * t)
     while (b * t) % g != 0:  # largest divisor of b*t not above the target
       g -= 1
     return max(g, 1)
+
+  @staticmethod
+  def _GroupAxes() -> tuple:
+    """Mesh axes the group (G) dim shards over: ('data', 'expert') when both
+    exist. Sharding G over 'expert' ALONE (the pre-round-5 layout) replicates
+    every group onto each data slice, so the expert FFN — whose weights are
+    replicated over 'data' like any weight — computes every token
+    data_axis-many times. Jointly sharding G keeps each data slice routing
+    only its own tokens; the dispatch all-to-all rides the 'expert' axis
+    within the slice."""
+    axes = []
+    if mesh_lib.CurrentMeshAxisSize("data"):
+      axes.append("data")
+    if mesh_lib.CurrentMeshAxisSize("expert"):
+      axes.append("expert")
+    return tuple(axes)
 
   def FProp(self, theta, inputs, paddings=None, token_ids=None):
     """inputs [B, T, D] -> [B, T, D]; aux loss emitted via AddAuxLoss.
@@ -502,6 +521,19 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     s = b * t // g
     xg = x.reshape(g, s, d)
     pg = (paddings.reshape(g, s) if paddings is not None else None)
+    # Localize the gating math: pin the grouped tokens to the joint
+    # ('data', 'expert') group sharding up front (when it divides) so the
+    # router softmax / top-k / cumsum ops run local per group shard instead
+    # of GSPMD picking a layout mid-gating and resharding (the
+    # collective-permute storm in the round-5 attribution analysis).
+    gaxes = self._GroupAxes()
+    n_gs = 1
+    for ax in gaxes:
+      n_gs *= mesh_lib.CurrentMeshAxisSize(ax) or 1
+    if gaxes and g % n_gs == 0:
+      xg = mesh_lib.WithShardingConstraint(xg, (gaxes, None, None))
+      if pg is not None:
+        pg = mesh_lib.WithShardingConstraint(pg, (gaxes, None))
 
     # Optional within-group token shuffle before capacity truncation so the
     # cumsum-position drops don't bias early positions (train-time only).
@@ -521,15 +553,17 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     # the indexed (gather/scatter) path avoids the one-hot einsums entirely;
     # 'einsum' remains for the GSPMD-inferred collective path.
     n_exp_axis = mesh_lib.CurrentMeshAxisSize("expert") or 0
+    n_data_axis = mesh_lib.CurrentMeshAxisSize("data") or 1
     use_shard_map = p.dispatch_via_shard_map
     if use_shard_map is None:
       # an explicit dispatch_method='einsum' opts into the GSPMD-inferred
       # collective path, so auto must not steer it into shard_map
       use_shard_map = (p.dispatch_method != "einsum" and bool(n_exp_axis)
-                       and g % max(n_exp_axis, 1) == 0
+                       and g % max(n_exp_axis * n_data_axis, 1) == 0
                        and p.num_experts % max(n_exp_axis, 1) == 0)
     else:
-      use_shard_map = bool(use_shard_map) and bool(n_exp_axis)
+      use_shard_map = (bool(use_shard_map) and bool(n_exp_axis)
+                       and g % max(n_exp_axis * n_data_axis, 1) == 0)
     method = p.dispatch_method
     if method == "auto":
       method = "einsum" if (n_exp_axis and not use_shard_map) else "indexed"
@@ -607,27 +641,31 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     else:
       dispatch = gating.dispatch_tensor.astype(xg.dtype)  # [G,S,E,C]
       combine = gating.combine_tensor.astype(xg.dtype)
-      # GShard layout: token GROUPS shard over the same devices as experts
-      # (G over 'expert' axis). The dispatch einsum output is constrained
-      # expert-major, so GSPMD must move tokens G-sharded -> E-sharded:
-      # that resharding IS the all-to-all (asserted by
+      # GShard layout: token GROUPS shard jointly over ('data', 'expert')
+      # (each data slice routes its own tokens; see _GroupAxes) while the
+      # dispatch einsum output is constrained expert-major-within-slice, so
+      # GSPMD must move tokens G-sharded -> E-sharded: that resharding IS
+      # the all-to-all over 'expert' (asserted by
       # test_compiled_hlo_contains_all_to_all — without the group-major
       # constraints below GSPMD falls back to all-gathers).
-      xg = mesh_lib.WithShardingConstraint(xg, ("expert", None, None))
+      gspec = self._GroupAxes() or ("expert",)
+      data_ax = "data" if "data" in gspec else None
+      xg = mesh_lib.WithShardingConstraint(xg, (gspec, None, None))
       dispatch = mesh_lib.WithShardingConstraint(
-          dispatch, ("expert", None, None, None))
+          dispatch, (gspec, None, None, None))
       combine = mesh_lib.WithShardingConstraint(
-          combine, ("expert", None, None, None))
-      # group-major -> expert-major (XLA inserts all-to-all over 'expert')
+          combine, (gspec, None, None, None))
+      # group-major -> expert-major within each data slice (XLA inserts the
+      # all-to-all over 'expert'; G stays data-sharded)
       expert_in = jnp.einsum("GSEC,GSD->EGCD", dispatch, xg)
       expert_in = mesh_lib.WithShardingConstraint(
-          expert_in, ("expert", None, None, None))
+          expert_in, ("expert", data_ax, None, None))
       h = self._ExpertFfn(th, expert_in)
       expert_out = mesh_lib.WithShardingConstraint(
-          h, ("expert", None, None, None))
+          h, ("expert", data_ax, None, None))
       # expert-major -> group-major combine (second all-to-all)
       out = jnp.einsum("GSEC,EGCD->GSD", combine, expert_out)
-      out = mesh_lib.WithShardingConstraint(out, ("expert", None, None))
+      out = mesh_lib.WithShardingConstraint(out, (gspec, None, None))
     out = out.reshape(b, t, d)
     if p.residual_dropout_prob > 0:
       out = self.dropout.FProp(
@@ -647,34 +685,47 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
     return jnp.einsum("EGCH,EHD->EGCD", h, th.wo)
 
   def _DispatchShardMap(self, th, xg, gating):
-    """Explicit all-to-all dispatch via shard_map over the 'expert' axis.
+    """Explicit all-to-all dispatch via shard_map; groups shard over
+    ('data', 'expert') jointly, the all-to-all rides the 'expert' axis.
 
     The einsum formulation relies on GSPMD noticing that `expert_in` flips
     from group-major to expert-major sharding and inserting an all-to-all;
     when it mis-infers (an all-gather instead), this path states the
     collective outright (ref FeedForwardNetworksApplyGating:2992 — same
-    math, the collective made explicit). Local dispatch/combine use the
+    math, the collective made explicit). Groups shard over BOTH the data
+    and expert axes (see _GroupAxes: expert-only sharding replicates the
+    expert FFN compute onto every data slice); each data slice exchanges
+    tokens with its own expert shards only. Local dispatch/combine use the
     indexed (scatter/gather) formulation, not one-hot einsums:
 
       per device: gather local groups' tokens into slots -> [E, g_loc, C, D]
-      all_to_all over 'expert': split E, concat g -> [e_loc, G, C, D]
+      all_to_all over 'expert': split E, concat g -> [e_loc, G/data, C, D]
       local expert FFN (each device owns its experts' weights)
       all_to_all back: split g, concat E -> [E, g_loc, C, D]
       local combine (gather + gate-weighted sum)
+
+    The all_to_all inputs/outputs are tagged with jax.ad_checkpoint
+    checkpoint_name so remat policies can pin them (saving the dispatched
+    activations stops the backward pass replaying the forward all-to-alls).
     """
     try:
       from jax import shard_map  # jax >= 0.8
     except ImportError:
       from jax.experimental.shard_map import shard_map
+    from jax.ad_checkpoint import checkpoint_name
     from jax.sharding import PartitionSpec as P
     mesh = jax.sharding.get_abstract_mesh()
     n_exp = mesh_lib.CurrentMeshAxisSize("expert")
+    gspec = self._GroupAxes() or ("expert",)
+    n_group_shards = 1
+    for ax in gspec:
+      n_group_shards *= mesh_lib.CurrentMeshAxisSize(ax) or 1
     g, s, d = xg.shape
     e = self.p.num_experts
     c = gating.capacity
-    assert g % n_exp == 0, (
-        f"shard_map dispatch needs groups ({g}) divisible by the expert "
-        f"axis ({n_exp})")
+    assert g % n_group_shards == 0, (
+        f"shard_map dispatch needs groups ({g}) divisible by the group "
+        f"shards ({n_group_shards} = x{gspec})")
     assert e % n_exp == 0, (e, n_exp)
 
     # Respect the weights' declared tensor-parallel sharding: wi is
@@ -688,24 +739,26 @@ class MoEFeedForwardLayer(base_layer.BaseLayer):
       gating_l = NestedMap(indices=idx_l, positions=pos_l, gates=gate_l,
                            capacity=c)
       expert_in = IndexedDispatch(xg_l, gating_l, e)   # [E, g_loc, C, D]
-      # split E over devices, gather all group shards: [e_loc, G, C, D]
+      # split E over devices, gather the slice's group shards:
+      # [e_loc, G/data, C, D]
       expert_in = jax.lax.all_to_all(
           expert_in, "expert", split_axis=0, concat_axis=1, tiled=True)
+      expert_in = checkpoint_name(expert_in, "moe_dispatched")
       h = self._ExpertFfn(NestedMap(wi=wi_l, wo=wo_l), expert_in)
       if has_model_tp:
         h = jax.lax.psum(h, "model")  # complete the H contraction
       # back: split G, concat E -> [E, g_loc, C, D]
       h = jax.lax.all_to_all(
           h, "expert", split_axis=1, concat_axis=0, tiled=True)
+      h = checkpoint_name(h, "moe_combined")
       return IndexedCombine(h, gating_l)
 
     model_ax = "model" if has_model_tp else None
     return shard_map(
         _Local, mesh=mesh,
-        in_specs=(P("expert"), P(None, "expert"), P(None, "expert"),
-                  P(None, "expert"),
+        in_specs=(P(gspec), P(None, gspec), P(None, gspec), P(None, gspec),
                   P("expert", None, model_ax), P("expert", model_ax, None)),
-        out_specs=P("expert"))(
+        out_specs=P(gspec))(
             xg, gating.indices, gating.positions, gating.gates,
             th.wi, th.wo)
 

@@ -1,0 +1,128 @@
+"""Compile-level assertions on the round-5 MoE dispatch program: joint
+('data','expert') group sharding, shard_map all-to-all engagement, no
+collective-permute resharding storm, named remat boundaries
+(docs/moe_collectives.md)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.parallel import gshard, mesh as mesh_lib
+
+
+def _CollectiveDefs(hlo: str):
+  """Defining-instruction opcode counts (the attribution parser's rule)."""
+  counts = {}
+  inst = re.compile(
+      r"[}\])]\s+(all-to-all|all-gather|all-reduce|reduce-scatter|"
+      r"collective-permute)(-start|-done)?\(")
+  for line in hlo.splitlines():
+    if not re.match(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=", line):
+      continue
+    m = inst.search(line)
+    if m and m.group(2) != "-done":
+      counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+  return counts
+
+
+def _MoeLayer(num_experts=4, num_groups=0, **kw):
+  p = gshard.MoEFeedForwardLayer.Params().Set(
+      name="moe", input_dim=16, hidden_dim=32, num_experts=num_experts,
+      num_groups=num_groups, **kw)
+  layer = p.Instantiate()
+  theta = layer.InstantiateVariables(jax.random.PRNGKey(0))
+  return layer, theta
+
+
+class TestJointGroupSharding:
+
+  def setup_method(self, _):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs the 8-device CPU mesh")
+
+  def _Lower(self, mesh_axes, num_groups=0, batch=8, **kw):
+    mesh = mesh_lib.MakeMesh(mesh_axes, devices=jax.devices()[:8])
+    layer, theta = _MoeLayer(num_groups=num_groups, **kw)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 8, 16))
+    with mesh_lib.MeshContext(mesh):
+      theta = jax.device_put(theta,
+                             mesh_lib.ThetaShardings(mesh, layer, theta))
+      x = jax.device_put(
+          x, jax.sharding.NamedSharding(
+              mesh, jax.sharding.PartitionSpec(
+                  "data" if "data" in mesh_axes else None)))
+
+      def loss(th, x):
+        return jnp.mean(jnp.square(layer.FProp(th, x)))
+
+      fn = jax.jit(jax.value_and_grad(loss))
+      hlo = fn.lower(theta, x).compile().as_text()
+      val, grad = fn(theta, x)
+    return hlo, float(val), grad
+
+  def test_auto_groups_is_data_times_expert(self):
+    mesh = mesh_lib.MakeMesh({"data": 2, "expert": 2, "model": 2},
+                             devices=jax.devices()[:8])
+    layer, _ = _MoeLayer()
+    with mesh_lib.MeshContext(mesh):
+      assert layer._NumGroups(8, 8) == 4
+      assert layer._GroupAxes() == ("data", "expert")
+
+  def test_dispatch_all_to_all_no_permute_storm(self):
+    hlo, val, grad = self._Lower({"data": 2, "expert": 2, "model": 2})
+    counts = _CollectiveDefs(hlo)
+    assert counts.get("all-to-all", 0) >= 2, counts  # dispatch + combine
+    # the round-4 einsum fallback produced ~49 collective-permutes; the
+    # explicit path needs none (a handful from unrelated CPU lowering
+    # details are tolerated)
+    assert counts.get("collective-permute", 0) <= 4, counts
+    assert np.isfinite(val)
+    assert all(np.isfinite(l).all() for l in jax.tree_util.tree_leaves(grad))
+
+  def test_matches_single_device(self):
+    # the sharded program computes the same loss as one device
+    layer, theta = _MoeLayer(num_groups=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 16))
+    ref = float(jnp.mean(jnp.square(layer.FProp(theta, x))))
+    hlo, val, _ = self._Lower({"data": 2, "expert": 2, "model": 2},
+                              num_groups=4)
+    np.testing.assert_allclose(val, ref, rtol=1e-5)
+
+  def test_expert_only_mesh_still_works(self):
+    hlo, val, _ = self._Lower({"expert": 8})
+    assert _CollectiveDefs(hlo).get("all-to-all", 0) >= 2
+    assert np.isfinite(val)
+
+  def test_named_remat_boundaries_present(self):
+    # the checkpoint_name tags must survive tracing so the 'dots' remat
+    # policy can pin them (transformer.RepeatedTransformerLayer)
+    mesh = mesh_lib.MakeMesh({"data": 2, "expert": 2, "model": 2},
+                             devices=jax.devices()[:8])
+    layer, theta = _MoeLayer()
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 16))
+    with mesh_lib.MeshContext(mesh):
+      jaxpr = jax.make_jaxpr(lambda th, x: layer.FProp(th, x))(theta, x)
+    names = re.findall(r"name=(\w+)", str(jaxpr))
+    assert "moe_dispatched" in names, names
+    assert "moe_combined" in names, names
+
+
+class TestNonDivisibleFallback:
+
+  def test_odd_groups_fall_back_to_einsum(self):
+    # groups=3 divides neither data*expert nor expert: the einsum path must
+    # still produce correct values (and not assert)
+    if len(jax.devices()) < 8:
+      pytest.skip("needs the 8-device CPU mesh")
+    mesh = mesh_lib.MakeMesh({"expert": 8}, devices=jax.devices()[:8])
+    layer, theta = _MoeLayer(num_groups=3, num_experts=8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 8, 16))
+    ref = layer.FProp(theta, x)  # no mesh: plain indexed path
+    with mesh_lib.MeshContext(mesh):
+      out = jax.jit(layer.FProp)(theta, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

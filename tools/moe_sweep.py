@@ -222,6 +222,91 @@ VARIANTS = {
 }
 
 
+# Priority order for the unattended post-bench sweep (bench.py runs this
+# the moment a TPU probe succeeds — tunnel windows are short, so the most
+# decision-relevant variants go first; each result lands on disk
+# immediately).
+AUTO_SWEEP = ("moe_b8", "dense_twin", "moe_b16", "groups16", "groups32",
+              "cap125", "expert_choice", "hash", "einsum", "micro",
+              "phases:moe_b8", "moe_b32", "sinkhorn", "noflash")
+
+
+def RunSweep(names=AUTO_SWEEP, budget_s: float = 1500.0,
+             out_path: str | None = None, log=None):
+  """Runs sweep variants under a wall-clock budget; appends one JSON line
+  per variant to out_path (jsonl) and returns the result list. Assumes the
+  jax backend is already initialized (call from bench.py post-bench)."""
+  import gc
+  import time as _time
+  import jax
+  import jax.numpy as jnp
+  from lingvo_tpu import model_registry
+  import lingvo_tpu.models.all_params  # noqa: F401
+  log = log or (lambda msg: print(msg, file=sys.stderr))
+  peak = bench._PeakFlops(jax.devices()[0])
+  t0 = _time.time()
+  results = []
+  for name in names:
+    if _time.time() - t0 > budget_s:
+      log(f"moe_sweep: budget exhausted after {len(results)} variants")
+      break
+    try:
+      if name == "micro":
+        res = _Micro(jax, jnp)
+      elif name.startswith("phases:"):
+        res = _Phases(jax, jnp,
+                      _Build(jax, jnp, model_registry,
+                             **VARIANTS[name.split(":", 1)[1]]))
+      else:
+        res = _Time(jax, jnp, _Build(jax, jnp, model_registry,
+                                     **VARIANTS[name]), peak)
+    except Exception as e:  # noqa: BLE001
+      res = {"error": f"{type(e).__name__}: {e}"[:200]}
+    row = {"variant": name, **res}
+    results.append(row)
+    log(f"moe_sweep: {json.dumps(row)}")
+    if out_path:
+      with open(out_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    gc.collect()
+  return results
+
+
+def WriteBaselineSection(results, baseline_path: str) -> None:
+  """Rewrites the auto-sweep block in BASELINE.md (between the MOE_SWEEP
+  markers; appends the block if absent) with the latest TPU sweep."""
+  import time as _time
+  begin = "<!-- MOE_SWEEP_AUTO_BEGIN -->"
+  end = "<!-- MOE_SWEEP_AUTO_END -->"
+  lines = [begin,
+           f"### MoE sweep (auto-run on TPU probe success, "
+           f"{_time.strftime('%Y-%m-%d %H:%M UTC', _time.gmtime())})", "",
+           "| Variant | step ms | tok/s | MFU |", "|---|---|---|---|"]
+  for r in results:
+    if "error" in r:
+      lines.append(f"| {r['variant']} | error: {r['error'][:60]} | | |")
+    elif "mfu" in r:
+      lines.append(f"| {r['variant']} | {r.get('step_ms', '')} | "
+                   f"{r.get('tok_s', '')} | {r['mfu']} |")
+    else:  # micro / phases rows
+      detail = {k: v for k, v in r.items() if k != "variant"}
+      lines.append(f"| {r['variant']} | {json.dumps(detail)[:90]} | | |")
+  lines.append(end)
+  block = "\n".join(lines)
+  try:
+    text = open(baseline_path).read()
+  except FileNotFoundError:
+    text = ""
+  if begin in text and end in text:
+    pre = text.split(begin)[0]
+    post = text.split(end, 1)[1]
+    text = pre + block + post
+  else:
+    text = text.rstrip() + "\n\n" + block + "\n"
+  with open(baseline_path, "w") as f:
+    f.write(text)
+
+
 def main():
   bench._EnsureBackend()
   import gc

@@ -125,10 +125,16 @@ def Run(name: str) -> dict:
     t_compile = time.time() - t0
 
   hlo = compiled.as_text()
-  colls = collections.Counter(
-      m.group(1) for m in re.finditer(
-          r"\b(all-to-all|all-gather|all-reduce|reduce-scatter|"
-          r"collective-permute)\b", hlo))
+  dump = os.environ.get("SCALE_HLO_DUMP")
+  if dump:
+    with open(dump, "w") as f:
+      f.write(hlo)
+  # Proper instruction-level counting via the attribution parser — a raw
+  # text regex counts each defining line twice plus every operand use
+  # (the r04 reports said "204 all-to-alls" for a program with 6).
+  import collective_attribution
+  attr = collective_attribution.Analyze(hlo)
+  colls = collections.Counter(attr["instructions"])
   mem = compiled.memory_analysis()
   per_dev = {
       "output_bytes_gb": round(mem.output_size_in_bytes / 1e9, 2),
@@ -146,6 +152,9 @@ def Run(name: str) -> dict:
       "devices": n,
       "params_b": round(n_params / 1e9, 2),
       "collectives": dict(colls),
+      "collectives_executed_per_step": attr["executed_per_step"],
+      "collective_mb_per_step": {
+          k: round(v / 1e6, 1) for k, v in attr["bytes_per_step"].items()},
       "per_device": per_dev,
       "per_device_peak_gb": round(peak / 1e9, 2),
       "target_chip": cfg["chip"],

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Converts tensor2tensor translate-style TFRecord shards (tf.Example with
+int64 'inputs'/'targets' wordpiece-id lists) to the framework's JSONL MT
+format, without a TensorFlow dependency.
+
+The reference ships real WMT'14 en-de wordpiece data this tool consumes
+(`/root/reference/lingvo/tasks/mt/testdata/translate_ende_wmt32k-train-*`,
+`wmt14_ende_wpm_32k_test.tfrecord`, + the 32k `.vocab`): TFRecord framing is
+[u64 length][u32 crc][payload][u32 crc]; the payload is a tf.Example proto
+parsed here with a minimal varint walker (wire format only — no generated
+code).
+
+Usage:
+  python tools/t2t_to_jsonl.py IN.tfrecord OUT.jsonl [--vocab=V --text]
+Each output line: {"src": [ids...], "tgt": [ids...]} (+"src_text"/"tgt_text"
+detokenized via the wordpiece vocab when --vocab is given).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import sys
+
+
+def ReadTfRecords(path: str):
+  """Yields raw record payloads from a TFRecord file (crc not verified)."""
+  with open(path, "rb") as f:
+    while True:
+      header = f.read(12)
+      if len(header) < 12:
+        return
+      (length,) = struct.unpack("<Q", header[:8])
+      payload = f.read(length)
+      if len(payload) < length:
+        return
+      f.read(4)  # payload crc
+      yield payload
+
+
+def _ReadVarint(buf: bytes, pos: int):
+  result = 0
+  shift = 0
+  while True:
+    b = buf[pos]
+    pos += 1
+    result |= (b & 0x7F) << shift
+    if not b & 0x80:
+      return result, pos
+    shift += 7
+
+
+def _WalkFields(buf: bytes):
+  """Yields (field_number, wire_type, value) over a proto message buffer.
+  value: int for varint/fixed, bytes for length-delimited."""
+  pos = 0
+  n = len(buf)
+  while pos < n:
+    tag, pos = _ReadVarint(buf, pos)
+    field, wire = tag >> 3, tag & 7
+    if wire == 0:
+      val, pos = _ReadVarint(buf, pos)
+    elif wire == 2:
+      ln, pos = _ReadVarint(buf, pos)
+      val = buf[pos:pos + ln]
+      pos += ln
+    elif wire == 5:
+      val = struct.unpack("<I", buf[pos:pos + 4])[0]
+      pos += 4
+    elif wire == 1:
+      val = struct.unpack("<Q", buf[pos:pos + 8])[0]
+      pos += 8
+    else:
+      raise ValueError(f"unsupported wire type {wire}")
+    yield field, wire, val
+
+
+def _Int64List(buf: bytes):
+  """Int64List message -> list of ints (field 1, packed or repeated)."""
+  out = []
+  for field, wire, val in _WalkFields(buf):
+    if field != 1:
+      continue
+    if wire == 2:  # packed
+      pos = 0
+      while pos < len(val):
+        v, pos = _ReadVarint(val, pos)
+        out.append(v)
+    else:
+      out.append(val)
+  return out
+
+
+def ParseExample(payload: bytes) -> dict:
+  """tf.Example -> {feature_name: [int64...]} (int64 features only)."""
+  features = {}
+  for field, _, val in _WalkFields(payload):        # Example
+    if field != 1:
+      continue
+    for f2, _, entry in _WalkFields(val):           # Features.feature map
+      if f2 != 1:
+        continue
+      key, ints = None, None
+      for f3, _, v3 in _WalkFields(entry):          # map entry
+        if f3 == 1:
+          key = v3.decode("utf-8")
+        elif f3 == 2:
+          for f4, _, v4 in _WalkFields(v3):         # Feature
+            if f4 == 3:                             # int64_list
+              ints = _Int64List(v4)
+      if key is not None and ints is not None:
+        features[key] = ints
+  return features
+
+
+def LoadWordpieceVocab(path: str):
+  """'piece<TAB>score' lines -> id->piece list (line order = id)."""
+  pieces = []
+  with open(path, encoding="utf-8") as f:
+    for line in f:
+      pieces.append(line.rstrip("\n").split("\t")[0])
+  return pieces
+
+
+def IdsToText(ids, pieces) -> str:
+  """Wordpiece detokenization: '▁' marks a word start (space)."""
+  toks = []
+  for i in ids:
+    if 0 <= i < len(pieces):
+      p = pieces[i]
+      if p in ("<s>", "</s>", "<unk>", "<pad>"):
+        continue
+      toks.append(p)
+  return "".join(toks).replace("▁", " ").strip()
+
+
+def main():
+  args = [a for a in sys.argv[1:] if not a.startswith("--")]
+  opts = dict(a[2:].split("=", 1) if "=" in a else (a[2:], "1")
+              for a in sys.argv[1:] if a.startswith("--"))
+  in_path, out_path = args
+  pieces = LoadWordpieceVocab(opts["vocab"]) if "vocab" in opts else None
+  n = 0
+  with open(out_path, "w") as out:
+    for payload in ReadTfRecords(in_path):
+      ex = ParseExample(payload)
+      # t2t naming ('inputs'/'targets') or lingvo NmtInput naming
+      # ('source_id'/'target_label', ref input_generator.NmtInput)
+      src = ex.get("inputs", ex.get("source_id"))
+      tgt = ex.get("targets", ex.get("target_label"))
+      if src is None or tgt is None:
+        continue
+      row = {"src": src, "tgt": tgt}
+      if pieces and opts.get("text"):
+        row["src_text"] = IdsToText(ex["inputs"], pieces)
+        row["tgt_text"] = IdsToText(ex["targets"], pieces)
+      out.write(json.dumps(row) + "\n")
+      n += 1
+  print(f"wrote {n} examples to {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Real-corpus MT convergence run (VERDICT r4 Next #3): trains
+`mt.wmt14_en_de.WmtEnDeRealShardSmall` on the reference's shipped real
+WMT'14 wordpiece shard and records the loss + held-out token-BLEU
+trajectory into BASELINE.md.
+
+Steps:
+  1. prep: tools/t2t_to_jsonl.py on the reference shard -> train/dev split
+     under $LINGVO_TPU_DATA_DIR/wmt14_real/ (8,441 train / 500 dev pairs)
+  2. train with the production TrainStep, logging loss every --log_every
+  3. every --eval_every steps: greedy-decode dev batches, corpus token BLEU
+  4. append the trajectory (JSONL + BASELINE.md block)
+
+Usage: python tools/wmt_convergence.py [--steps=3000] [--eval_every=500]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REF_SHARD = ("/root/reference/lingvo/tasks/mt/testdata/"
+             "translate_ende_wmt32k-train-00511-of-00512")
+DEV_N = 500
+
+
+def PrepareData(data_dir: str) -> None:
+  out_dir = os.path.join(data_dir, "wmt14_real")
+  train, dev = (os.path.join(out_dir, f) for f in
+                ("train.jsonl", "dev.jsonl"))
+  if os.path.exists(train) and os.path.exists(dev):
+    return
+  os.makedirs(out_dir, exist_ok=True)
+  allf = os.path.join(out_dir, "all.jsonl")
+  tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "t2t_to_jsonl.py")
+  subprocess.run([sys.executable, tool, REF_SHARD, allf], check=True)
+  lines = open(allf).read().splitlines()
+  # deterministic split: last DEV_N lines held out
+  with open(train, "w") as f:
+    f.write("\n".join(lines[:-DEV_N]) + "\n")
+  with open(dev, "w") as f:
+    f.write("\n".join(lines[-DEV_N:]) + "\n")
+  os.remove(allf)
+  print(f"prepared {len(lines) - DEV_N} train / {DEV_N} dev pairs",
+        file=sys.stderr)
+
+
+def Main():
+  opts = dict(a[2:].split("=", 1) if "=" in a else (a[2:], "1")
+              for a in sys.argv[1:] if a.startswith("--"))
+  steps = int(opts.get("steps", 3000))
+  log_every = int(opts.get("log_every", 25))
+  eval_every = int(opts.get("eval_every", 500))
+  data_dir = os.environ.setdefault("LINGVO_TPU_DATA_DIR",
+                                   "/tmp/lingvo_tpu_data")
+  PrepareData(data_dir)
+
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  from lingvo_tpu import model_registry
+  from lingvo_tpu.core import input_policy, metrics as metrics_lib
+  import lingvo_tpu.models.all_params  # noqa: F401
+
+  mp = model_registry.GetParams("mt.wmt14_en_de.WmtEnDeRealShardSmall",
+                                "Train")
+  mp.task.input = mp.input
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  state = task.CreateTrainState(jax.random.PRNGKey(0))
+  gen = input_policy.Instantiate(mp.input)
+  step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
+
+  dev_p = model_registry.GetParams("mt.wmt14_en_de.WmtEnDeRealShardSmall",
+                                   "Dev")
+
+  def DevBleu(theta, max_batches=6):
+    dgen = input_policy.Instantiate(dev_p.input)
+    metric = metrics_lib.CorpusBleuMetric()
+    decode = jax.jit(task.Decode)
+    n = 0
+    for batch in (dgen.EpochBatches() if hasattr(dgen, "EpochBatches")
+                  else iter(lambda: dgen.GetPreprocessedInputBatch(), None)):
+      out = task.PostProcessDecodeOut(
+          jax.tree_util.tree_map(np.asarray,
+                                 decode(theta, batch.Transform(jnp.asarray))),
+          {"corpus_bleu": metric, "num_samples_in_batch":
+           metrics_lib.AverageMetric()})
+      del out
+      n += 1
+      if n >= max_batches:
+        break
+    return float(metric.value)
+
+  log_path = os.path.join(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))) if "repo" not in os.getcwd()
+      else os.getcwd(), "WMT_CONVERGENCE.jsonl")
+  log_path = os.path.abspath(os.path.join(
+      os.path.dirname(os.path.abspath(__file__)), "..",
+      "WMT_CONVERGENCE.jsonl"))
+  t0 = time.time()
+  traj = []
+  with open(log_path, "a") as logf:
+    for step in range(1, steps + 1):
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      state, out = step_fn(state, batch)
+      if step % log_every == 0 or step == 1:
+        loss = float(out.metrics.loss[0])
+        row = {"step": step, "loss": round(loss, 4),
+               "wall_s": round(time.time() - t0, 1)}
+        if step % eval_every == 0 or step == steps:
+          row["dev_token_bleu"] = round(DevBleu(state.theta), 4)
+        traj.append(row)
+        logf.write(json.dumps(row) + "\n")
+        logf.flush()
+        print(json.dumps(row), file=sys.stderr)
+  print(json.dumps({"trajectory": traj[-8:]}))
+
+
+if __name__ == "__main__":
+  Main()
