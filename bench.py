@@ -192,6 +192,132 @@ def _BenchFlashAttention(jax, jnp, on_tpu):
       "naive_ms": round(naive_t * 1e3, 3),
       "flash_speedup": round(naive_t / flash_t, 3),
       "shape_btnh": [b, t, n, h],
+      # which lowering the shape heuristic picked (small off-TPU shapes
+      # fall back to plain XLA instead of Pallas interpret mode)
+      "lowering": flash_attention.SelectedLowering(t, n, h),
+  }
+
+
+def _BenchDecode(jax, jnp, model_registry, on_tpu):
+  """Decode fast path: chunked prefill + length-aware paged flash decode.
+
+  Measures the serving hot loop on a tiny LM: (a) prompt prefill via the
+  legacy per-token ExtendStep scan vs one chunked Prefill pass, (b)
+  steady-state decode step latency with the dense full-cache read vs the
+  paged read (`decode_page_size`), at max_len >= 4 * prompt_len where the
+  early decode steps touch only ~1/4 of the cache.
+  """
+  from lingvo_tpu.core import attention as attention_lib
+  p_len, t_max = (64, 192) if not on_tpu else (256, 768)
+  page = 64 if not on_tpu else 128
+  total = p_len + t_max
+  b = 4
+
+  def _MakeTask(page_size):
+    mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                  "Train")
+    mp.task.input = mp.input
+    if on_tpu:
+      # DenseLmTiny's dim_per_head (64/4 = 16) can't tile the Pallas decode
+      # kernel (SupportedOnTpu needs a 128-lane-aligned head dim), so the
+      # paged path would silently fall back to dense and the TPU decode
+      # budget would time two identical samplers
+      mp.task.model_dim = 512
+      mp.task.num_heads = 4
+      mp.task.hidden_dim = 1024
+    mp.task.atten_tpl = attention_lib.MultiHeadedAttention.Params().Set(
+        decode_page_size=page_size)
+    task = mp.task.Instantiate()
+    task.FinalizePaths()
+    return task
+
+  task_dense = _MakeTask(0)
+  task_paged = _MakeTask(page)
+  # identical architectures -> one theta serves both
+  theta = task_dense.InstantiateVariables(jax.random.PRNGKey(0))
+  prompts = jax.random.randint(jax.random.PRNGKey(1), (b, p_len), 1,
+                               task_dense.p.vocab_size)
+
+  @jax.jit
+  def prime_legacy(theta, prompts):
+    states = task_dense.InitDecodeState(theta, b, total)
+
+    def _Prime(carry, ids_t):
+      states = carry
+      logits, states = task_dense.ExtendStep(theta, ids_t[:, None], states)
+      return states, logits
+
+    states, logits = jax.lax.scan(_Prime, states, prompts.swapaxes(0, 1))
+    return logits[-1]
+
+  @jax.jit
+  def prefill_chunked(theta, prompts):
+    states = task_dense.InitDecodeState(theta, b, total)
+    logits, states = task_dense.Prefill(theta, prompts, states,
+                                        live_len=p_len)
+    return logits[:, -1, :]
+
+  def _MakeSampler(task):
+    @jax.jit
+    def run(theta, prompts):
+      states = task.InitDecodeState(theta, b, total)
+      logits, states = task.Prefill(theta, prompts, states, live_len=p_len)
+
+      def _Sample(carry, _):
+        states, logits = carry
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        new_logits, states = task.ExtendStep(theta, nxt[:, None], states)
+        return (states, new_logits), nxt
+
+      (_, _), out = jax.lax.scan(_Sample, (states, logits[:, -1, :]),
+                                 None, length=t_max)
+      return out
+
+    return run
+
+  sample_dense = _MakeSampler(task_dense)
+  sample_paged = _MakeSampler(task_paged)
+
+  # ask the real eligibility gate whether sample_paged takes the paged read
+  # or silently fell back to dense (in which case decode_speedup ~1.0 means
+  # "never ran", not "regressed")
+  stack = task_paged.stack
+  atten = (getattr(stack, "body", None) or stack.x_layers[0]).self_atten.atten
+  paged_active = bool(atten.PagedDecodeEligible(total))
+  paged_path = ("pallas" if on_tpu else "xla") if paged_active else "dense"
+
+  # the dense-vs-paged step delta is a fraction of a ms; (1,3) reps put CPU
+  # timer noise at the same scale as the signal, so spend a few extra
+  # seconds here for a stable decode_speedup
+  reps = (2, 6) if on_tpu else (2, 10)
+  fetch = lambda out: float(jnp.sum(out))
+  t_prime = _MarginalStepTime(lambda _: prime_legacy(theta, prompts), fetch,
+                              *reps)
+  t_prefill = _MarginalStepTime(lambda _: prefill_chunked(theta, prompts),
+                                fetch, *reps)
+  t_dense = _MarginalStepTime(lambda _: sample_dense(theta, prompts), fetch,
+                              *reps)
+  t_paged = _MarginalStepTime(lambda _: sample_paged(theta, prompts), fetch,
+                              *reps)
+  # the samplers share the chunked-prefill cost; difference is decode steps.
+  # clamp at 0: t_prefill comes from a separately-jitted program, so timer
+  # noise on low rep counts could otherwise report negative step latency
+  step_dense = max(t_dense - t_prefill, 0.0) / t_max
+  step_paged = max(t_paged - t_prefill, 0.0) / t_max
+  return {
+      "batch": b, "prompt_len": p_len, "decode_steps": t_max,
+      "max_len": total, "page_size": page, "paged_path": paged_path,
+      "prefill_legacy_scan_ms": round(t_prime * 1e3, 2),
+      "prefill_chunked_ms": round(t_prefill * 1e3, 2),
+      "prefill_speedup": round(t_prime / t_prefill, 2),
+      "prefill_sequential_atten_calls": {"legacy": p_len, "chunked": 1},
+      "decode_step_dense_ms": round(step_dense * 1e3, 3),
+      "decode_step_paged_ms": round(step_paged * 1e3, 3),
+      "decode_tokens_per_sec_dense": round(b * t_max / max(
+          t_dense - t_prefill, 1e-9), 1),
+      "decode_tokens_per_sec_paged": round(b * t_max / max(
+          t_paged - t_prefill, 1e-9), 1),
+      "decode_speedup": round(step_dense / max(step_paged, 1e-9), 3),
   }
 
 
@@ -498,6 +624,11 @@ def main():
     detail["flash_attention"] = _BenchFlashAttention(jax, jnp, on_tpu)
   except Exception as e:  # noqa: BLE001
     detail["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+  gc.collect()
+  try:
+    detail["decode"] = _BenchDecode(jax, jnp, model_registry, on_tpu)
+  except Exception as e:  # noqa: BLE001
+    detail["decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
   gc.collect()
   try:
     detail["moe"] = _BenchMoE(jax, jnp, model_registry, on_tpu, peak)

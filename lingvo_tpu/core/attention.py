@@ -103,6 +103,15 @@ class MultiHeadedAttention(base_layer.BaseLayer):
         "Use the fused Pallas flash kernel when eligible (self-attention, "
         "causal-or-full, no paddings/segments/rel-bias/dropout/logit-cap); "
         "falls back to the einsum path otherwise.")
+    p.Define(
+        "decode_page_size", 0,
+        "If >0, ExtendStep reads the KV cache through the length-aware "
+        "paged flash-decode kernel (ops/flash_decode.py) in pages of this "
+        "many slots, touching only pages up to time_step instead of the "
+        "whole max_len cache. 0 = legacy dense path (exact legacy "
+        "numerics). Requires max_len % decode_page_size == 0 and no "
+        "rel-pos bias / logit cap / prob quantization; ineligible configs "
+        "fall back to the dense path.")
     p.Define("rel_pos_emb_dim", 0,
              "If >0, learned relative position bias buckets (T5-style).")
     p.Define("rel_pos_max_distance", 128, "Relative bucket clip distance.")
@@ -382,6 +391,19 @@ class MultiHeadedAttention(base_layer.BaseLayer):
         value=jnp.zeros((batch_size, max_len, n, h), dtype),
         time_step=jnp.zeros((), jnp.int32))
 
+  def PagedDecodeEligible(self, max_len: int) -> bool:
+    """The paged flash-decode kernel handles plain masked softmax attention
+    only; rel-pos bias, logit caps, attention dropout, and prob quantization
+    stay dense — as do shapes the Pallas kernel can't tile on real TPU."""
+    p = self.p
+    from lingvo_tpu.ops import flash_decode
+    if jax.default_backend() == "tpu" and not flash_decode.SupportedOnTpu(
+        p.decode_page_size, self._dim_per_head):
+      return False
+    return (flash_decode.SupportedShape(max_len, p.decode_page_size)
+            and p.rel_pos_emb_dim == 0 and p.atten_logit_cap == 0
+            and p.atten_dropout_prob == 0.0 and p.qdomain_softmax is None)
+
   def ExtendStep(self, theta, query_vec, cached_states: NestedMap,
                  paddings=None):
     """query_vec: [B, 1, D]; returns ([B, 1, D], updated states)."""
@@ -401,14 +423,73 @@ class MultiHeadedAttention(base_layer.BaseLayer):
         cached_states.value, v_new.astype(cached_states.value.dtype), t,
         axis=1)
     max_len = key_cache.shape[1]
-    # mask out future (and unwritten) positions
-    pos_ids = jnp.arange(max_len)[None, None, None, :]
-    mask = jnp.where(pos_ids <= t, 0.0, _NEG_INF)
-    if paddings is not None:
-      mask = mask + PaddingsToMask(paddings)
-    ctx, _ = self._Atten(theta, q, key_cache, value_cache, mask)
+    if self.PagedDecodeEligible(max_len):
+      # length-aware paged read: only cache pages up to time_step are
+      # touched (O(t) per step instead of O(max_len)); q carries the
+      # learned scale already, the kernel applies none.
+      from lingvo_tpu.ops import flash_decode
+      ctx = flash_decode.FlashDecode(
+          q, key_cache, value_cache, t,
+          page_size=self.p.decode_page_size, cache_paddings=paddings)
+    else:
+      # mask out future (and unwritten) positions
+      pos_ids = jnp.arange(max_len)[None, None, None, :]
+      mask = jnp.where(pos_ids <= t, 0.0, _NEG_INF)
+      if paddings is not None:
+        mask = mask + PaddingsToMask(paddings)
+      ctx, _ = self._Atten(theta, q, key_cache, value_cache, mask)
     new_states = NestedMap(
         key=key_cache, value=value_cache, time_step=t + 1)
+    return self._PostProj(theta, ctx), new_states
+
+  def Prefill(self, theta, query_vec, cached_states: NestedMap,
+              paddings=None, live_len: int | None = None):
+    """Chunked prefill: one full-attention pass over a whole prompt chunk.
+
+    query_vec: [B, C, D] occupying cache slots [time_step, time_step + C);
+    K/V for all C positions land in the cache in ONE dynamic_update_slice
+    (vs C sequential ExtendStep calls). Returns ([B, C, D], states). The
+    written cache is bit-identical to the per-token path (projections and
+    rotary are elementwise-per-position); outputs match to float tolerance
+    (the [C, S] context matmul blocks differently than C matvecs).
+
+    live_len: optional STATIC bound with time_step + C <= live_len; the
+    attention read touches only cache slots [0, live_len) instead of the
+    whole max_len cache (the decode tail is unwritten and masked anyway —
+    skipping it only removes exact-zero softmax contributions). Callers
+    with static chunk offsets (gshard_decode) pass start + C.
+    """
+    assert self.p.rel_pos_emb_dim <= 0, (
+        "Prefill computes chunk-local query indices; the T5 relative bias "
+        "would use wrong buckets (needs a time_step offset)")
+    t = cached_states.time_step
+    c = query_vec.shape[1]
+    q = self._HeadsProj(theta, "query", query_vec)
+    k_new = self._HeadsProj(theta, "key", query_vec)
+    v_new = self._HeadsProj(theta, "value", query_vec)
+    if self.p.use_rotary_position_emb:
+      rt = self.ChildTheta(theta, "rotary")
+      pos = (t + jnp.arange(c, dtype=jnp.int32)).astype(jnp.float32)[None, :]
+      q = self.rotary.FProp(rt, q, position=pos)
+      k_new = self.rotary.FProp(rt, k_new, position=pos)
+    q = self._ScaleQuery(theta, q)
+    key_cache = jax.lax.dynamic_update_slice_in_dim(
+        cached_states.key, k_new.astype(cached_states.key.dtype), t, axis=1)
+    value_cache = jax.lax.dynamic_update_slice_in_dim(
+        cached_states.value, v_new.astype(cached_states.value.dtype), t,
+        axis=1)
+    live = key_cache.shape[1] if live_len is None else live_len
+    # query i (global slot t+i) sees slot s iff s <= t+i (causal within the
+    # chunk + everything already cached); unwritten tail slots masked.
+    slot = jnp.arange(live)[None, None, None, :]
+    qpos = t + jnp.arange(c)[None, None, :, None]
+    mask = jnp.where(slot <= qpos, 0.0, _NEG_INF)
+    if paddings is not None:
+      mask = mask + PaddingsToMask(paddings[:, :live])
+    ctx, _ = self._Atten(theta, q, key_cache[:, :live], value_cache[:, :live],
+                         mask)
+    new_states = NestedMap(
+        key=key_cache, value=value_cache, time_step=t + c)
     return self._PostProj(theta, ctx), new_states
 
 

@@ -27,7 +27,15 @@ NEG_INF = -1.0e9
 
 
 def _GatherBeams(tree, parent_idx, batch_size, num_hyps):
-  """Reorders [B*K, ...] state leaves by parent beam: new[b,k] = old[b,parent[b,k]]."""
+  """Reorders [B*K, ...] state leaves by parent beam: new[b,k] = old[b,parent[b,k]].
+
+  Leaf-shape agnostic past the leading B*K axis, so it covers dense
+  [B*K, S, N, H] KV caches and any paged [B*K, S/page, page, N, H] view of
+  them identically — the paged flash-decode path (docs/decode_fast_path.md)
+  keeps the cache in the dense layout, pages being a read-side blocking of
+  the time axis, so beam reordering needs no paged-specific handling
+  (asserted in test_mt_beam_search.py).
+  """
 
   def _One(x):
     if not hasattr(x, "ndim") or x.ndim == 0:

@@ -244,6 +244,21 @@ def SequenceMask(paddings: jax.Array, dtype=jnp.float32) -> jax.Array:
   return (1.0 - paddings).astype(dtype)
 
 
+def RoundUpToBucket(n: int, buckets) -> int:
+  """Smallest bucket >= n; n itself when it exceeds every bucket.
+
+  Serving-shape bucketing: jitted decode programs recompile per distinct
+  static length, so callers round prompt/decode lengths up to a small
+  fixed set and hit the jit cache on repeat traffic.
+  """
+  if n < 0:
+    raise ValueError(f"RoundUpToBucket needs n >= 0, got {n}")
+  for b in sorted(buckets):
+    if n <= b:
+      return int(b)
+  return int(n)
+
+
 # ---------------------------------------------------------------------------
 # Numeric hygiene.
 # ---------------------------------------------------------------------------

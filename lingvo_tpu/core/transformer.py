@@ -142,9 +142,20 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
     return self.atten.InitStates(theta.atten, batch_size, max_len)
 
   def ExtendStep(self, theta, query_vec, cached_states, cache_paddings=None):
+    return self._Step("ExtendStep", theta, query_vec, cached_states,
+                      cache_paddings)
+
+  def Prefill(self, theta, query_vec, cached_states, cache_paddings=None,
+              live_len=None):
+    """Whole-chunk cache priming: query_vec [B, C, D] -> ([B, C, D], states)."""
+    return self._Step("Prefill", theta, query_vec, cached_states,
+                      cache_paddings, live_len=live_len)
+
+  def _Step(self, method, theta, query_vec, cached_states, cache_paddings,
+            **kw):
     x = self.ln.FProp(theta.ln, query_vec)
-    out, new_states = self.atten.ExtendStep(theta.atten, x, cached_states,
-                                            paddings=cache_paddings)
+    out, new_states = getattr(self.atten, method)(
+        theta.atten, x, cached_states, paddings=cache_paddings, **kw)
     return query_vec + out, new_states
 
 
@@ -208,9 +219,19 @@ class TransformerLayer(base_layer.BaseLayer):
 
   def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
                  aux_paddings=None, cache_paddings=None):
-    x, new_sa = self.self_atten.ExtendStep(theta.self_atten, inputs,
-                                           cached_states.self_atten,
-                                           cache_paddings=cache_paddings)
+    return self._Step("ExtendStep", theta, inputs, cached_states, aux_vecs,
+                      aux_paddings, cache_paddings)
+
+  def Prefill(self, theta, inputs, cached_states, aux_vecs=None,
+              aux_paddings=None, cache_paddings=None, live_len=None):
+    return self._Step("Prefill", theta, inputs, cached_states, aux_vecs,
+                      aux_paddings, cache_paddings, live_len=live_len)
+
+  def _Step(self, method, theta, inputs, cached_states, aux_vecs,
+            aux_paddings, cache_paddings, **kw):
+    x, new_sa = getattr(self.self_atten, method)(
+        theta.self_atten, inputs, cached_states.self_atten,
+        cache_paddings=cache_paddings, **kw)
     if self.p.has_aux_atten:
       x, _ = self.aux_atten.FProp(
           theta.aux_atten, x, source_vecs=aux_vecs, paddings=aux_paddings)
@@ -263,12 +284,23 @@ class StackedTransformerLayers(base_layer.BaseLayer):
 
   def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
                  aux_paddings=None, cache_paddings=None):
+    return self._Step("ExtendStep", theta, inputs, cached_states, aux_vecs,
+                      aux_paddings, cache_paddings)
+
+  def Prefill(self, theta, inputs, cached_states, aux_vecs=None,
+              aux_paddings=None, cache_paddings=None, live_len=None):
+    return self._Step("Prefill", theta, inputs, cached_states, aux_vecs,
+                      aux_paddings, cache_paddings, live_len=live_len)
+
+  def _Step(self, method, theta, inputs, cached_states, aux_vecs,
+            aux_paddings, cache_paddings, **kw):
     x = inputs
     new_states = NestedMap(x_layers=[])
     for i, layer in enumerate(self.x_layers):
-      x, ns = layer.ExtendStep(theta.x_layers[i], x,
-                               cached_states.x_layers[i], aux_vecs,
-                               aux_paddings, cache_paddings=cache_paddings)
+      x, ns = getattr(layer, method)(theta.x_layers[i], x,
+                                     cached_states.x_layers[i], aux_vecs,
+                                     aux_paddings,
+                                     cache_paddings=cache_paddings, **kw)
       new_states.x_layers.append(ns)
     if self.p.final_ln:
       x = self.final_ln.FProp(theta.final_ln, x)
@@ -364,11 +396,21 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
 
   def ExtendStep(self, theta, inputs, cached_states, aux_vecs=None,
                  aux_paddings=None, cache_paddings=None):
+    return self._Step("ExtendStep", theta, inputs, cached_states, aux_vecs,
+                      aux_paddings, cache_paddings)
+
+  def Prefill(self, theta, inputs, cached_states, aux_vecs=None,
+              aux_paddings=None, cache_paddings=None, live_len=None):
+    return self._Step("Prefill", theta, inputs, cached_states, aux_vecs,
+                      aux_paddings, cache_paddings, live_len=live_len)
+
+  def _Step(self, method, theta, inputs, cached_states, aux_vecs,
+            aux_paddings, cache_paddings, **kw):
     def _Body(carry, per_layer):
       theta_i, states_i = per_layer
-      x, new_states = self.body.ExtendStep(theta_i, carry, states_i, aux_vecs,
-                                           aux_paddings,
-                                           cache_paddings=cache_paddings)
+      x, new_states = getattr(self.body, method)(
+          theta_i, carry, states_i, aux_vecs, aux_paddings,
+          cache_paddings=cache_paddings, **kw)
       return x, new_states
 
     out, new_states = jax.lax.scan(_Body, inputs,

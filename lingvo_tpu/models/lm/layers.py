@@ -245,6 +245,35 @@ class TransformerLm(base_model.BaseTask):
       logits = self.emb.Logits(theta.emb, x)
     return logits[:, 0, :], new_states
 
+  def Prefill(self, theta, ids, states, cache_paddings=None, live_len=None):
+    """Chunked prefill: ids [b, c] -> (logits [b, c, vocab], new states).
+
+    live_len: optional static bound (>= time_step + c) on how many cache
+    slots the attention read touches — see MultiHeadedAttention.Prefill.
+
+    Primes cache slots [time_step, time_step + c) with ONE batched
+    attention pass per layer instead of c sequential ExtendStep calls —
+    the prompt phase goes from O(prompt_len) full-cache attention calls to
+    O(prompt_len / chunk). Written K/V is bit-identical to the per-token
+    path; logits match it to float tolerance. Mirrors ExtendStep's
+    position handling (rotary positions are the global slot indices;
+    like ExtendStep — and unlike training FProp — NO absolute pos_emb is
+    added for use_rotary=False models, whose decode has always been
+    position-blind: absolute positions are ill-defined under the
+    right-aligned ragged-prompt serving layout. Serve rotary models.)
+    """
+    x = self.emb.EmbLookup(theta.emb, ids)
+    x, new_states = self.stack.Prefill(theta.stack, x, states,
+                                       cache_paddings=cache_paddings,
+                                       live_len=live_len)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    if self.p.softmax_num_sampled > 0:
+      logits = self.sampled_softmax.Logits(
+          self.ChildTheta(theta, "sampled_softmax"), x)
+    else:
+      logits = self.emb.Logits(theta.emb, x)
+    return logits, new_states
+
 
 class BertLm(TransformerLm):
   """Masked-LM pretraining task (ref `tasks/lm/params/wiki_bert.py` +

@@ -4,8 +4,27 @@ Re-designs `lingvo/gshard_decode.py` (`GShardDecode:100`): a standalone job
 that watches a trainer's checkpoint directory and, for every new checkpoint,
 runs prompt continuations through the LM and streams results to JSONL. The
 reference's infinite-infeed/outfeed-thread machinery collapses into a jitted
-sampler (`lax.scan` over ExtendStep with a KV cache) plus the shared
-checkpoint-polling loop.
+sampler plus the shared checkpoint-polling loop.
+
+Decode fast path (docs/decode_fast_path.md):
+- **Chunked prefill** — the prompt primes the KV cache through
+  `task.Prefill` (one full-attention pass per chunk, K/V for the whole
+  chunk written in one dynamic_update_slice) instead of an O(prompt_len)
+  `lax.scan` of single-token ExtendSteps. `prefill_chunk_size=0` takes the
+  whole prompt in one pass; `use_legacy_prime=True` keeps the old scan
+  (A/B harness for tests and bench).
+- **Donated decode state** — the KV cache is built by a jitted init
+  program and donated into the decode program, so the multi-megabyte
+  cache buffers update in place instead of being copied at the jit
+  boundary.
+- **Shape bucketing** — decode programs are specialized on the static
+  `(prompt_len, t_max)` pair; rounding `prompt_len` up to `len_buckets`
+  makes repeat traffic with ragged prompt widths hit the jit cache instead
+  of recompiling (`t_max` is a constructor constant and needs no
+  bucketing). Left-pad slots added by bucketing are masked through
+  `cache_paddings` exactly like ragged-prompt padding, and rotary
+  attention depends only on relative position, so bucketed numerics match
+  unbucketed.
 """
 
 from __future__ import annotations
@@ -18,9 +37,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lingvo_tpu.core import beam_search as beam_search_lib
 from lingvo_tpu.core import checkpointer as checkpointer_lib
+from lingvo_tpu.core import py_utils
 from lingvo_tpu.core.nested_map import NestedMap
+
+# Decode-program shape buckets (slots, ascending). Lengths beyond the last
+# bucket run at their exact size (a compile per distinct length).
+DEFAULT_LEN_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 
 
 class GShardDecode:
@@ -30,8 +53,17 @@ class GShardDecode:
                max_decode_steps: int = 32, temperature: float = 0.0,
                poll_interval_secs: float = 10.0,
                timeout_secs: float = 3600.0,
-               init_seed: int = 1234):
-    """task: a TransformerLm-style task exposing InitDecodeState/ExtendStep."""
+               init_seed: int = 1234,
+               prefill_chunk_size: int = 0,
+               use_legacy_prime: bool = False,
+               len_buckets=DEFAULT_LEN_BUCKETS):
+    """task: a TransformerLm-style task exposing InitDecodeState/ExtendStep.
+
+    prefill_chunk_size: prompt tokens per prefill attention pass (0 = the
+    whole prompt in one pass). use_legacy_prime: prime the cache with the
+    per-token ExtendStep scan instead of chunked prefill (slow; kept as
+    the A/B reference). len_buckets: prompt-width buckets.
+    """
     self._task = task
     self._train_dir = train_dir
     self._output_path = output_path
@@ -41,18 +73,29 @@ class GShardDecode:
     self._poll_interval = poll_interval_secs
     self._timeout = timeout_secs
     self._last_step = -1
+    self._prefill_chunk = prefill_chunk_size
+    self._use_legacy_prime = use_legacy_prime
+    self._len_buckets = tuple(len_buckets)
     self._template = jax.eval_shape(
         self._task.CreateTrainState, jax.random.PRNGKey(init_seed))
-    self._decode_fn = None
+    # jitted (init_fn, decode_fn) per bucketed static (p_len, t_max)
+    self._decode_fns = {}
 
-  def _GetDecodeFn(self):
-    if self._decode_fn is not None:
-      return self._decode_fn
+  def _GetDecodeFn(self, p_len: int, t_max: int):
+    """Builds (init_fn, decode_fn) for a static (p_len, t_max) pair."""
+    cache_key = (p_len, t_max)
+    if cache_key in self._decode_fns:
+      return self._decode_fns[cache_key]
     task = self._task
-    t_max = self._max_steps
     temp = self._temperature
+    total = p_len + t_max
+    chunk = self._prefill_chunk if self._prefill_chunk > 0 else p_len
+    legacy_prime = self._use_legacy_prime
 
-    def _Decode(theta, prompts, prompt_lens, key):
+    def _Init(theta, batch_size):
+      return task.InitDecodeState(theta, batch_size, total)
+
+    def _Decode(theta, prompts, prompt_lens, key, states):
       """prompts [B, P] RIGHT-ALIGNED (left-padded) -> continuations
       [B, t_max].
 
@@ -63,24 +106,35 @@ class GShardDecode:
       Rotary attention depends only on relative positions, so global slot
       indices give the same numerics as an unpadded per-length batch.
       """
-      b, p_len = prompts.shape
-      total = p_len + t_max
-      states = task.InitDecodeState(theta, b, total)
       # slot s is pad for row i iff s < P - len_i
       slot = jnp.arange(total)[None, :]
       cache_paddings = (slot < (p_len - prompt_lens)[:, None]).astype(
           jnp.float32)                                     # [B, total]
 
-      # teacher-force the (right-aligned) prompt through the KV cache
-      def _Prime(carry, ids_t):
-        states = carry
-        logits, states = task.ExtendStep(theta, ids_t[:, None], states,
-                                         cache_paddings=cache_paddings)
-        return states, logits
+      if legacy_prime:
+        # teacher-force the prompt one token at a time (O(p_len) sequential
+        # full-cache attention calls; the pre-fast-path behavior)
+        def _Prime(carry, ids_t):
+          states = carry
+          logits, states = task.ExtendStep(theta, ids_t[:, None], states,
+                                           cache_paddings=cache_paddings)
+          return states, logits
 
-      states, logits = jax.lax.scan(_Prime, states,
-                                    prompts.swapaxes(0, 1))
-      last_logits = logits[-1]                             # [B, V]
+        states, logits = jax.lax.scan(_Prime, states,
+                                      prompts.swapaxes(0, 1))
+        last_logits = logits[-1]                           # [B, V]
+      else:
+        # chunked prefill: ceil(p_len / chunk) attention passes write the
+        # whole prompt's K/V and produce the last-position logits; each
+        # pass reads only the written cache prefix (live_len), not the
+        # max_len decode tail
+        chunk_logits = None
+        for start in range(0, p_len, chunk):
+          ids_c = prompts[:, start:start + chunk]
+          chunk_logits, states = task.Prefill(
+              theta, ids_c, states, cache_paddings=cache_paddings,
+              live_len=start + ids_c.shape[1])
+        last_logits = chunk_logits[:, -1, :]               # [B, V]
 
       def _Sample(carry, key_t):
         states, logits = carry
@@ -97,15 +151,28 @@ class GShardDecode:
       _, out_ids = jax.lax.scan(_Sample, (states, last_logits), keys)
       return out_ids.swapaxes(0, 1)                        # [B, t_max]
 
-    self._decode_fn = jax.jit(_Decode)
-    return self._decode_fn
+    # the KV cache is donated: the decode program reuses the init program's
+    # buffers in place instead of copying them through the jit boundary
+    # (XLA:CPU can't alias these buffers and warns, so donate off-cpu only)
+    donate = (4,) if jax.default_backend() != "cpu" else ()
+    fns = (jax.jit(_Init, static_argnums=(1,)),
+           jax.jit(_Decode, donate_argnums=donate))
+    self._decode_fns[cache_key] = fns
+    return fns
 
   @staticmethod
-  def _RightAlign(prompts: np.ndarray, prompt_lens: np.ndarray) -> np.ndarray:
-    """Shifts each row's first len_i tokens to the row's END (left-pad)."""
+  def _RightAlign(prompts: np.ndarray, prompt_lens: np.ndarray,
+                  width: int | None = None) -> np.ndarray:
+    """Shifts each row's first len_i tokens to the row's END (left-pad).
+
+    width: output row width (>= prompts.shape[1]; defaults to it) — the
+    bucketed prompt width, with bucketing pad folded into the left-pad.
+    """
     prompts = np.asarray(prompts)
-    out = np.zeros_like(prompts)
     p = prompts.shape[1]
+    w = p if width is None else int(width)
+    assert w >= p, (w, p)
+    out = np.zeros((prompts.shape[0], w), prompts.dtype)
     lens = np.asarray(prompt_lens)
     if lens.shape[0] != prompts.shape[0] or (lens < 0).any() or (
         lens > p).any():
@@ -115,16 +182,24 @@ class GShardDecode:
           f"[0, {p}]; got shape {lens.shape}, values in {rng}")
     for i, ln in enumerate(lens):
       ln = int(ln)
-      out[i, p - ln:] = prompts[i, :ln]
+      out[i, w - ln:] = prompts[i, :ln]
     return out
 
   def DecodeOnce(self, step: int, prompts: np.ndarray,
                  prompt_lens: np.ndarray) -> list:
     state, restored = self._checkpointer.Restore(self._template, step=step)
-    fn = self._GetDecodeFn()
-    aligned = self._RightAlign(prompts, prompt_lens)
-    out = fn(state.theta, jnp.asarray(aligned), jnp.asarray(prompt_lens),
-             jax.random.PRNGKey(restored))
+    if prompts.shape[1] == 0:
+      raise ValueError("prompts must have width >= 1 (got [B, 0]); the "
+                       "prefill loop needs at least one chunk")
+    # only p_len varies across calls; max_steps is a constructor constant,
+    # so bucketing it would just run extra discarded decode steps
+    p_len = py_utils.RoundUpToBucket(prompts.shape[1], self._len_buckets)
+    init_fn, decode_fn = self._GetDecodeFn(p_len, self._max_steps)
+    aligned = self._RightAlign(prompts, prompt_lens, width=p_len)
+    states = init_fn(state.theta, prompts.shape[0])
+    out = decode_fn(state.theta, jnp.asarray(aligned),
+                    jnp.asarray(prompt_lens), jax.random.PRNGKey(restored),
+                    states)
     self._last_step = restored
     results = []
     with open(self._output_path, "a") as f:

@@ -153,6 +153,54 @@ class TestFlashAttention:
     assert np.all(np.isfinite(np.asarray(out, np.float32)))
 
 
+class TestShapeHeuristic:
+  """Small off-TPU shapes auto-fall back to plain XLA (interpret-mode grid
+  overhead dwarfs the compute); explicit interpret=True keeps the kernel."""
+
+  def test_selected_lowering(self):
+    # CPU backend here: small shape -> xla, big -> pallas-interpret
+    assert flash_attention.SelectedLowering(256, 2, 32) == "xla"
+    assert flash_attention.SelectedLowering(4096, 16, 128) == (
+        "pallas-interpret")
+    assert flash_attention.SelectedLowering(
+        256, 2, 32, interpret=True) == "pallas-interpret"
+    assert flash_attention.SelectedLowering(
+        256, 2, 32, interpret=False) == "pallas"
+
+  def test_auto_fallback_matches_reference(self):
+    b, t, n, h = 1, 64, 2, 16
+    q = jax.random.normal(KEY, (b, t, n, h))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, n, h))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, n, h))
+    out = flash_attention.FlashAttention(q, k, v, causal=True)  # auto: xla
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(q, k, v, True)), atol=2e-5)
+
+  def test_auto_fallback_grads_match_reference(self):
+    b, t, n, h = 1, 32, 1, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+
+    def loss_auto(q):
+      return jnp.sum(flash_attention.FlashAttention(q, q, q) ** 2)
+
+    def loss_ref(q):
+      return jnp.sum(_ref(q, q, q, True) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_auto)(q)),
+        np.asarray(jax.grad(loss_ref)(q)), atol=1e-4)
+
+  def test_auto_fallback_segment_ids(self):
+    b, t, n, h = 1, 32, 1, 8
+    q = jax.random.normal(KEY, (b, t, n, h))
+    seg = jnp.concatenate(
+        [jnp.full((16,), 1), jnp.full((16,), 2)])[None, :].astype(jnp.int32)
+    out = flash_attention.FlashAttention(q, q, q, causal=True,
+                                         segment_ids=seg)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref_seg(q, q, q, seg, True)), atol=2e-5)
+
+
 def _ref_seg(q, k, v, seg, causal):
   b, t, n, h = q.shape
   s = jnp.einsum("bqnh,bknh->bnqk", q, k) / math.sqrt(h)

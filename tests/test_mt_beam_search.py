@@ -112,6 +112,20 @@ class TestBeamSearch:
     out = helper.Search(2, NestedMap(acc=jnp.zeros(6, jnp.int32)), step_fn)
     assert out.topk_ids.shape == (2, 3, 4)
 
+  def test_gather_beams_paged_cache_matches_dense(self):
+    """Beam reorder of a paged KV-cache view == paged view of the dense
+    reorder: the paged flash-decode path stores the cache in the same
+    dense [B*K, S, N, H] layout (pages are a read-side blocking of the
+    time axis), so _GatherBeams needs no paged-specific handling."""
+    b, k, s, n, h, ps = 2, 3, 16, 2, 4, 4
+    cache = jax.random.normal(KEY, (b * k, s, n, h))
+    parent = jnp.asarray([[2, 0, 1], [1, 1, 0]], jnp.int32)
+    dense = bs_lib._GatherBeams(NestedMap(key=cache), parent, b, k).key
+    paged_view = cache.reshape(b * k, s // ps, ps, n, h)
+    paged = bs_lib._GatherBeams(NestedMap(key=paged_view), parent, b, k).key
+    np.testing.assert_array_equal(
+        np.asarray(paged), np.asarray(dense.reshape(b * k, s // ps, ps, n, h)))
+
   def test_sampler_temperature_zero_is_greedy(self):
     trans = self._Chain()
     sp = bs_lib.TargetSequenceSampler.Params().Set(
