@@ -132,6 +132,59 @@ def _EnsureBackend():
   _ForceCpu()
 
 
+def _MemSnapshot(dev=None):
+  """Point-in-time memory stats: device allocator stats on TPU
+  (`memory_stats()`), /proc/self/status VmRSS/VmHWM on CPU. Values in
+  bytes; missing sources simply omit their keys."""
+  out = {}
+  if dev is not None and getattr(dev, "platform", "cpu") != "cpu":
+    try:
+      st = dev.memory_stats() or {}
+      out["device_bytes_in_use"] = st.get("bytes_in_use")
+      out["device_peak_bytes"] = st.get("peak_bytes_in_use")
+    except Exception:  # noqa: BLE001
+      pass
+  try:
+    with open("/proc/self/status") as f:
+      for line in f:
+        if line.startswith("VmRSS:"):
+          out["rss_bytes"] = int(line.split()[1]) * 1024
+        elif line.startswith("VmHWM:"):
+          out["rss_peak_bytes"] = int(line.split()[1]) * 1024
+  except OSError:
+    pass
+  return out
+
+
+def _MemDelta(before, after):
+  """Per-section memory figure for the BENCH json: deltas for in-use
+  counters; high-water marks as a RAISED-BY delta (the absolute HWM is
+  process-lifetime and would just echo the biggest earlier section) plus
+  the running absolute under an explicitly-cumulative name. Gives every
+  section (and future memory optimisations) a trajectory to compare
+  against."""
+  out = {}
+  for key in ("device_bytes_in_use", "rss_bytes"):
+    if before.get(key) is not None and after.get(key) is not None:
+      out[f"{key}_delta_mb"] = round(
+          (after[key] - before[key]) / 1e6, 1)
+  for key in ("device_peak_bytes", "rss_peak_bytes"):
+    if after.get(key) is not None:
+      name = key.replace("_bytes", "")
+      out[f"{name}_so_far_mb"] = round(after[key] / 1e6, 1)
+      if before.get(key) is not None:
+        out[f"{name}_raised_mb"] = round(
+            max(after[key] - before[key], 0) / 1e6, 1)
+  return out
+
+
+def _DonateState(on_tpu):
+  """donate_argnums for train-state args: donation only buys the in-place
+  update on accelerators; the CPU backend warns 'Some donated buffers were
+  not usable' for every non-aliasable leaf (runners/program.py gating)."""
+  return (0,) if on_tpu else ()
+
+
 def _MarginalStepTime(dispatch_fn, fetch_fn, reps_lo, reps_hi):
   """Per-step wall time via two-point marginal measurement.
 
@@ -321,6 +374,95 @@ def _BenchDecode(jax, jnp, model_registry, on_tpu):
   }
 
 
+def _BenchFusedXent(jax, jnp, model_registry, on_tpu):
+  """Dense vs fused blockwise LM-head xent (ops/fused_xent.py): full
+  train-step time and peak memory at vocab 32k / 128k.
+
+  The dense path's [B, T, V] logits (plus their f32 log-softmax copy) are
+  the peak train-step activation at these vocabs and the one activation
+  remat can't save; the fused path streams the vocab in
+  `xent_block_size` chunks in both directions. Memory is read off the
+  compiled executable (`memory_analysis().temp_size_in_bytes` — XLA's
+  static temp-buffer plan, deterministic on CPU and TPU alike).
+  """
+  vocabs = (32768, 131072)
+  block = 512 if on_tpu else 8192  # TPU: VMEM-sized Pallas blocks
+  out = {
+      "xent_block_size": block,
+      # The fused bwd recomputes each block's logits (the flash-attention
+      # time-for-memory trade): +1/3 head-gemm flops. On CPU f32 the head
+      # gemm is compute-bound and the tiny trunk can't dilute it, so
+      # step_time_ratio sits above 1 here; on TPU bf16 the dense head is
+      # [B,T,V]-traffic-bound (bf16 logits + f32 cast + f32 log_probs
+      # residuals) and the ratio is expected at or below 1.
+      "note": "cpu step_time_ratio includes inherent bwd recompute",
+  }
+  for vocab in vocabs:
+    per = {}
+    for mode in ("dense", "fused"):
+      mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                    "Train")
+      mp.task.input = mp.input
+      if on_tpu:
+        mp.task.model_dim = 2048
+        mp.task.num_layers = 4
+        mp.task.num_heads = 16
+        mp.task.hidden_dim = 8192
+        mp.task.input.seq_len = 1024
+        mp.task.input.batch_size = 8
+        mp.task.remat_policy = "dots"
+        mp.task.fprop_dtype = jnp.bfloat16
+        from lingvo_tpu.core import attention as attention_lib
+        mp.task.atten_tpl = attention_lib.MultiHeadedAttention.Params().Set(
+            use_flash_attention=True)
+      else:
+        mp.task.model_dim = 128
+        mp.task.num_heads = 2
+        mp.task.hidden_dim = 256
+        mp.task.input.seq_len = 32
+        mp.task.input.batch_size = 4
+      mp.task.vocab_size = vocab
+      mp.task.input.vocab_size = vocab
+      mp.task.xent_block_size = block if mode == "fused" else 0
+      task = mp.task.Instantiate()
+      task.FinalizePaths()
+      state = task.CreateTrainState(jax.random.PRNGKey(0))
+      from lingvo_tpu.core import input_policy
+      gen = input_policy.Instantiate(mp.input)
+      batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+      step_fn = jax.jit(task.TrainStep, donate_argnums=_DonateState(on_tpu))
+      temp_mb = None
+      try:
+        # AOT-compile once and DISPATCH THROUGH THE EXECUTABLE: the jit
+        # tracing cache doesn't see .lower().compile(), so calling
+        # step_fn() afterwards would compile each config a second time.
+        step_fn = step_fn.lower(state, batch).compile()
+        temp_mb = round(
+            step_fn.memory_analysis().temp_size_in_bytes / 1e6, 1)
+      except Exception as e:  # noqa: BLE001
+        print(f"bench: fused_xent memory_analysis unavailable: {e}",
+              file=sys.stderr)
+
+      def _Dispatch(_):
+        nonlocal state
+        state, step_out = step_fn(state, batch)
+        return step_out
+
+      t = _MarginalStepTime(
+          _Dispatch, lambda o: float(o.metrics.loss[0]),
+          *((3, 13) if on_tpu else (1, 3)))
+      per[mode] = {"step_ms": round(t * 1e3, 2), "xla_temp_mb": temp_mb}
+      del state, step_fn, batch
+    entry = dict(per)
+    entry["step_time_ratio"] = round(
+        per["fused"]["step_ms"] / max(per["dense"]["step_ms"], 1e-9), 3)
+    if per["dense"]["xla_temp_mb"] and per["fused"]["xla_temp_mb"]:
+      entry["temp_mem_ratio"] = round(
+          per["fused"]["xla_temp_mb"] / per["dense"]["xla_temp_mb"], 3)
+    out[f"vocab_{vocab // 1024}k"] = entry
+  return out
+
+
 def _BenchRingAttention(jax, jnp, on_tpu):
   """Long-context sp path: ring-attention decomposition at t=32k.
 
@@ -445,7 +587,7 @@ def _BenchMoE(jax, jnp, model_registry, on_tpu, peak):
   from lingvo_tpu.core import input_policy
   gen = input_policy.Instantiate(mp.input)
   batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
-  step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
+  step_fn = jax.jit(task.TrainStep, donate_argnums=_DonateState(on_tpu))
 
   def _Dispatch(_):
     nonlocal state
@@ -538,7 +680,7 @@ def _BenchDense(jax, jnp, model_registry, on_tpu, peak):
   attn_flops = 12.0 * b * t * t * p.model_dim * p.num_layers
   flops_per_step = matmul_flops + softmax_flops + attn_flops
 
-  step_fn = jax.jit(task.TrainStep, donate_argnums=(0,))
+  step_fn = jax.jit(task.TrainStep, donate_argnums=_DonateState(on_tpu))
   # Compile ONCE; read XLA's cost analysis off the same executable as a
   # cross-check of the analytic FLOPs formula (None when unavailable).
   xla_flops = None
@@ -612,38 +754,34 @@ def main():
       sys.exit(3)
     return
 
+  mem_before = _MemSnapshot(dev)
   mfu, detail = _BenchDense(jax, jnp, model_registry, on_tpu, peak)
+  detail["mem"] = _MemDelta(mem_before, _MemSnapshot(dev))
   detail["device"] = str(getattr(dev, "device_kind", dev.platform))
   detail["peak_tflops"] = peak / 1e12
 
   # Secondary benches: never let them kill the primary number. Each runs
   # after a gc pass so the previous bench's train state is actually freed
-  # on-device (the dense f32 state + MoE state together OOM a 16G chip).
-  gc.collect()
-  try:
-    detail["flash_attention"] = _BenchFlashAttention(jax, jnp, on_tpu)
-  except Exception as e:  # noqa: BLE001
-    detail["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-  gc.collect()
-  try:
-    detail["decode"] = _BenchDecode(jax, jnp, model_registry, on_tpu)
-  except Exception as e:  # noqa: BLE001
-    detail["decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-  gc.collect()
-  try:
-    detail["moe"] = _BenchMoE(jax, jnp, model_registry, on_tpu, peak)
-  except Exception as e:  # noqa: BLE001
-    detail["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-  gc.collect()
-  try:
-    detail["ring_attention"] = _BenchRingAttention(jax, jnp, on_tpu)
-  except Exception as e:  # noqa: BLE001
-    detail["ring_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-  gc.collect()
-  try:
-    detail["embedding"] = _BenchEmbedding(jax, jnp, on_tpu)
-  except Exception as e:  # noqa: BLE001
-    detail["embedding"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+  # on-device (the dense f32 state + MoE state together OOM a 16G chip),
+  # and each records a per-section peak-memory figure so this and future
+  # memory optimisations have a trajectory in the BENCH json.
+  sections = [
+      ("flash_attention", lambda: _BenchFlashAttention(jax, jnp, on_tpu)),
+      ("decode", lambda: _BenchDecode(jax, jnp, model_registry, on_tpu)),
+      ("fused_xent",
+       lambda: _BenchFusedXent(jax, jnp, model_registry, on_tpu)),
+      ("moe", lambda: _BenchMoE(jax, jnp, model_registry, on_tpu, peak)),
+      ("ring_attention", lambda: _BenchRingAttention(jax, jnp, on_tpu)),
+      ("embedding", lambda: _BenchEmbedding(jax, jnp, on_tpu)),
+  ]
+  for name, fn in sections:
+    gc.collect()
+    before = _MemSnapshot(dev)
+    try:
+      detail[name] = fn()
+    except Exception as e:  # noqa: BLE001
+      detail[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    detail[name]["mem"] = _MemDelta(before, _MemSnapshot(dev))
 
   # A CPU run measures nothing about the 45%-MFU-on-TPU bar: stamp it
   # invalid and exit nonzero (unless CPU was explicitly requested) so the

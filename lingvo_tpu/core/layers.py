@@ -682,6 +682,12 @@ class SimpleFullSoftmax(base_layer.BaseLayer):
     p.Define("num_classes", 0, "Output classes.")
     p.Define("has_bias", True, "Bias on logits.")
     p.Define("logits_soft_max", 0.0, "If >0, cap logits with tanh.")
+    p.Define("xent_block_size", 0,
+             "If >0, FProp with class_ids computes the fused blockwise "
+             "xent (ops/fused_xent.py) this many vocab entries at a time "
+             "and never materializes [..., V] logits (out.logits and "
+             "out.log_probs are None; out.argmax/label_log_probs are "
+             "provided instead). 0 = exact legacy dense path.")
     return p
 
   def __init__(self, params):
@@ -717,11 +723,44 @@ class SimpleFullSoftmax(base_layer.BaseLayer):
 
   def FProp(self, theta, inputs, class_ids=None, class_probabilities=None,
             label_smoothing=0.0):
+    p = self.p
+    if FusedXentEligible(p, class_ids, class_probabilities):
+      th = self.CastTheta(theta)
+      return _FusedXentFProp(
+          self, self.ToFPropDtype(inputs), th.linear, class_ids,
+          label_smoothing, weight_layout="dv",
+          bias=th.bias if p.has_bias else None)
     logits = self.Logits(theta, inputs)
     out = self.XentLossFromLogits(
         logits, class_ids, class_probabilities, label_smoothing)
     out.logits = logits
     return out
+
+
+def FusedXentEligible(p, class_ids, class_probabilities) -> bool:
+  """Gate for the blockwise fused LM-head xent: opted in via
+  p.xent_block_size, needs integer labels (dense class_probabilities would
+  re-materialize [..., V] anyway — fall back to the legacy path)."""
+  return (getattr(p, "xent_block_size", 0) > 0 and class_ids is not None
+          and class_probabilities is None)
+
+
+def _FusedXentFProp(layer, inputs, weight, class_ids, label_smoothing,
+                    weight_layout, bias=None):
+  """Shared fused-path FProp for the softmax layers: same NestedMap shape
+  as the dense path minus the [..., V] tensors, plus the per-block argmax
+  (so `fraction_of_correct_next_step_preds` needn't re-materialize
+  logits) and the label log-probs (the scoring path)."""
+  from lingvo_tpu.ops import fused_xent
+  p = layer.p
+  out = fused_xent.FusedXent(
+      inputs, weight, class_ids, block_size=p.xent_block_size,
+      bias=bias, logits_soft_max=p.logits_soft_max,
+      label_smoothing=label_smoothing, weight_layout=weight_layout)
+  return NestedMap(per_example_xent=out.per_example_xent,
+                   log_probs=None, logits=None,
+                   label_log_probs=out.label_log_prob,
+                   argmax=out.argmax)
 
 
 class SingleShardFullSoftmax(SimpleFullSoftmax):
@@ -783,6 +822,10 @@ class SharedEmbeddingSoftmaxLayer(base_layer.BaseLayer):
     p.Define("embedding_dim", 0, "Depth.")
     p.Define("scale_sqrt_depth", True, "Scale embeddings by sqrt(dim).")
     p.Define("logits_soft_max", 0.0, "If >0, cap logits with tanh.")
+    p.Define("xent_block_size", 0,
+             "If >0, FProp with class_ids computes the fused blockwise "
+             "xent (ops/fused_xent.py) over the tied table and never "
+             "materializes [..., V] logits. 0 = exact legacy dense path.")
     return p
 
   def __init__(self, params):
@@ -818,6 +861,11 @@ class SharedEmbeddingSoftmaxLayer(base_layer.BaseLayer):
 
   def FProp(self, theta, inputs, class_ids=None, class_probabilities=None,
             label_smoothing=0.0):
+    if FusedXentEligible(self.p, class_ids, class_probabilities):
+      th = self.CastTheta(theta)
+      return _FusedXentFProp(
+          self, self.ToFPropDtype(inputs), th.emb, class_ids,
+          label_smoothing, weight_layout="vd")
     logits = self.Logits(theta, inputs)
     out = self.XentLossFromLogits(
         logits, class_ids, class_probabilities, label_smoothing)

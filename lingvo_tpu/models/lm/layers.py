@@ -47,6 +47,14 @@ class TransformerLm(base_model.BaseTask):
              "No causal mask (BERT-style encoder; pair with an MLM task).")
     p.Define("label_smoothing", 0.0, "Label smoothing.")
     p.Define("softmax_logits_soft_max", 30.0, "Logit tanh cap (gshard-style).")
+    p.Define("xent_block_size", 0,
+             "If >0, train/eval loss runs the fused blockwise LM-head "
+             "xent (ops/fused_xent.py) this many vocab entries at a time: "
+             "ComputePredictions returns the final hidden instead of "
+             "logits and the [B, T, V] logits tensor is never "
+             "materialized in either direction (the peak train-step "
+             "activation at vocab >= 32k). 0 = exact legacy dense path. "
+             "Decode (ExtendStep/Prefill) is unaffected.")
     p.Define("softmax_num_sampled", 0,
              "If >0, train with a sampled softmax over this many log-uniform "
              "negatives (untied output head; the word-level 793k-vocab "
@@ -77,6 +85,7 @@ class TransformerLm(base_model.BaseTask):
         layers_lib.SharedEmbeddingSoftmaxLayer.Params().Set(
             vocab_size=p.vocab_size, embedding_dim=p.model_dim,
             logits_soft_max=p.softmax_logits_soft_max,
+            xent_block_size=p.xent_block_size,
             weight_split_dims_mapping=("model", None)))
     if not p.use_rotary:
       self.CreateChild(
@@ -134,6 +143,9 @@ class TransformerLm(base_model.BaseTask):
               num_layers=p.num_layers, input_dim=p.model_dim,
               transformer_layer_params_tpl=layer_body, final_ln=False))
     if p.softmax_num_sampled > 0:
+      assert p.xent_block_size == 0, (
+          "sampled softmax and the fused blockwise xent are both "
+          "no-[B,T,V]-logits training paths; pick one")
       assert p.label_smoothing == 0.0, (
           "label_smoothing is not supported with the sampled softmax "
           "(the sampled xent has no smoothing term)")
@@ -162,10 +174,19 @@ class TransformerLm(base_model.BaseTask):
     def score_fn(theta, inputs):
       with py_utils.EvalContext():
         preds = self.ComputePredictions(theta, inputs)
-      log_probs = jax.nn.log_softmax(preds.logits.astype(jnp.float32), -1)
+      logits = self._FullLogits(theta, preds)
+      log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
       return NestedMap(log_probs=log_probs)
 
     return {"score": (score_fn, example)}
+
+  def _FullLogits(self, theta, predictions):
+    """Dense [..., V] logits from a predictions map — the fallback for
+    consumers that genuinely need the full distribution (serving export)
+    when the fused-xent gate deferred them."""
+    if "logits" in predictions:
+      return predictions.logits
+    return self.emb.Logits(theta.emb, predictions.hidden)
 
   def ComputePredictions(self, theta, input_batch):
     p = self.p
@@ -187,6 +208,11 @@ class TransformerLm(base_model.BaseTask):
       # training with a sampled softmax: defer to ComputeLoss (no [B,T,V]
       # logits are ever materialized — the point for 793k vocabs)
       return NestedMap(hidden=x)
+    if p.xent_block_size > 0:
+      # fused blockwise xent: ComputeLoss / ScoreSequences stream the
+      # vocab; only full-distribution consumers (_FullLogits) pay for
+      # dense logits
+      return NestedMap(hidden=x)
     logits = self.emb.Logits(theta.emb, x) if p.softmax_num_sampled == 0 \
         else self.sampled_softmax.Logits(
             self.ChildTheta(theta, "sampled_softmax"), x)
@@ -196,7 +222,7 @@ class TransformerLm(base_model.BaseTask):
     p = self.p
     weights = py_utils.SequenceMask(input_batch.paddings)
     tot_weight = jnp.maximum(jnp.sum(weights), 1e-8)
-    if "hidden" in predictions:
+    if "hidden" in predictions and p.softmax_num_sampled > 0:
       per_tok = self.sampled_softmax.XentLossFromInputs(
           self.ChildTheta(theta, "sampled_softmax"), predictions.hidden,
           input_batch.labels)
@@ -206,19 +232,53 @@ class TransformerLm(base_model.BaseTask):
           log_pplx=(avg_xent, tot_weight),
           num_predictions=(tot_weight, 1.0))
       return metrics, NestedMap(xent=per_tok)
-    xent = self.emb.XentLossFromLogits(
-        predictions.logits, class_ids=input_batch.labels,
-        label_smoothing=p.label_smoothing)
-    avg_xent = jnp.sum(xent.per_example_xent * weights) / tot_weight
+    if "hidden" in predictions:
+      # fused blockwise xent over the tied table: per-token loss AND the
+      # argmax metric come out of the streaming pass — [B, T, V] logits
+      # are never live in either direction
+      out = self.emb.FProp(theta.emb, predictions.hidden,
+                           class_ids=input_batch.labels,
+                           label_smoothing=p.label_smoothing)
+      correct = (out.argmax == input_batch.labels)
+    else:
+      out = self.emb.XentLossFromLogits(
+          predictions.logits, class_ids=input_batch.labels,
+          label_smoothing=p.label_smoothing)
+      correct = (jnp.argmax(predictions.logits, -1) == input_batch.labels)
+    avg_xent = jnp.sum(out.per_example_xent * weights) / tot_weight
     metrics = NestedMap(
         loss=(avg_xent, tot_weight),
         log_pplx=(avg_xent, tot_weight),
         fraction_of_correct_next_step_preds=(
-            jnp.sum((jnp.argmax(predictions.logits, -1) == input_batch.labels)
-                    * weights) / tot_weight, tot_weight),
+            jnp.sum(correct * weights) / tot_weight, tot_weight),
         num_predictions=(tot_weight, 1.0))
-    per_example = NestedMap(xent=xent.per_example_xent)
+    per_example = NestedMap(xent=out.per_example_xent)
     return metrics, per_example
+
+  def ScoreSequences(self, theta, input_batch):
+    """Per-position label log-probs for given target sequences.
+
+    input_batch: NestedMap with ids/labels/paddings (the training batch
+    format). Returns NestedMap(label_log_probs [b, t] f32, weights
+    [b, t]) — log P(labels[t] | ids[<=t]) at non-padded positions.
+
+    With the fused gate on (p.xent_block_size > 0) the score comes out of
+    the blockwise streaming pass; the legacy path is the f32 log-softmax
+    over dense logits. Both agree to float tolerance.
+    """
+    with py_utils.EvalContext():
+      preds = self.ComputePredictions(theta, input_batch)
+    if "hidden" in preds and self.p.softmax_num_sampled == 0:
+      out = self.emb.FProp(theta.emb, preds.hidden,
+                           class_ids=input_batch.labels)
+      log_probs = out.label_log_probs
+    else:
+      logits = self._FullLogits(theta, preds)
+      lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+      log_probs = jnp.take_along_axis(
+          lp, input_batch.labels[..., None].astype(jnp.int32), -1)[..., 0]
+    return NestedMap(label_log_probs=log_probs,
+                     weights=py_utils.SequenceMask(input_batch.paddings))
 
   # -- decode (sampling; beam search comes from core/beam_search) ------------
 
@@ -293,19 +353,29 @@ class BertLm(TransformerLm):
 
   def ComputeLoss(self, theta, predictions, input_batch):
     p = self.p
-    xent = self.emb.XentLossFromLogits(
-        predictions.logits, class_ids=input_batch.labels,
-        label_smoothing=p.label_smoothing)
+    assert p.softmax_num_sampled == 0, (
+        "BertLm has no sampled-softmax loss; use xent_block_size for a "
+        "no-[B,T,V] MLM head")
+    if "hidden" in predictions:
+      # fused blockwise xent (p.xent_block_size > 0): loss + accuracy
+      # without [B, T, V] logits
+      out = self.emb.FProp(theta.emb, predictions.hidden,
+                           class_ids=input_batch.labels,
+                           label_smoothing=p.label_smoothing)
+      correct = (out.argmax == input_batch.labels)
+    else:
+      out = self.emb.XentLossFromLogits(
+          predictions.logits, class_ids=input_batch.labels,
+          label_smoothing=p.label_smoothing)
+      correct = (jnp.argmax(predictions.logits, -1) == input_batch.labels)
     weights = input_batch.masked_weights * py_utils.SequenceMask(
         input_batch.paddings)
     tot_weight = jnp.maximum(jnp.sum(weights), 1e-8)
-    avg_xent = jnp.sum(xent.per_example_xent * weights) / tot_weight
-    acc = jnp.sum(
-        (jnp.argmax(predictions.logits, -1) == input_batch.labels)
-        * weights) / tot_weight
+    avg_xent = jnp.sum(out.per_example_xent * weights) / tot_weight
+    acc = jnp.sum(correct * weights) / tot_weight
     metrics = NestedMap(
         loss=(avg_xent, tot_weight),
         mlm_log_pplx=(avg_xent, tot_weight),
         mlm_accuracy=(acc, tot_weight),
         num_predictions=(tot_weight, 1.0))
-    return metrics, NestedMap(xent=xent.per_example_xent)
+    return metrics, NestedMap(xent=out.per_example_xent)

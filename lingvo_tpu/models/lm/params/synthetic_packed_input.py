@@ -29,6 +29,11 @@ class DenseLmTemplate(base_model_params.SingleTaskModelParams):
   NUM_HEADS = 16
   HIDDEN_DIM = 4096
   USE_REPEAT = True
+  # If >0, the fused blockwise LM-head xent (docs/fused_xent.md): the
+  # [B, T, V] logits tensor — the peak train-step activation, and the one
+  # remat can't save — is never materialized. Prefer a value dividing
+  # VOCAB_SIZE; 0 = legacy dense head.
+  XENT_BLOCK_SIZE = 0
   LEARNING_RATE = 2.5e-4
   MAX_STEPS = 1_000_000
 
@@ -51,6 +56,7 @@ class DenseLmTemplate(base_model_params.SingleTaskModelParams):
     p.num_heads = self.NUM_HEADS
     p.hidden_dim = self.HIDDEN_DIM
     p.use_repeat_layer = self.USE_REPEAT
+    p.xent_block_size = self.XENT_BLOCK_SIZE
     p.train.learner = learner_lib.Learner.Params().Set(
         learning_rate=self.LEARNING_RATE,
         optimizer=opt_lib.Adafactor.Params().Set(
@@ -87,6 +93,22 @@ class DenseLm1B(DenseLmTemplate):
   NUM_LAYERS = 24
   NUM_HEADS = 16
   HIDDEN_DIM = 8192
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLmWord793k(DenseLmTemplate):
+  """Word-level one-billion-words head (the reference's 793k-vocab
+  recipe): dense [B, T, 793k] logits are prohibitive — ~6.5 GB f32 per
+  step at this geometry before the backward — so the fused blockwise
+  head (docs/fused_xent.md) is on. The alternative no-[B,T,V] recipe is
+  `softmax_num_sampled` (sampled softmax, untied head); this config is
+  the exact-loss tied-head variant."""
+
+  SEQUENCE_LENGTH = 256
+  MODEL_DIM = 1024
+  NUM_LAYERS = 8
+  VOCAB_SIZE = 793_600    # 793471 words rounded up to a 1024 multiple
+  XENT_BLOCK_SIZE = 1024  # divides VOCAB_SIZE: no masking, no weight pad
 
 
 @model_registry.RegisterSingleTaskModel

@@ -1,0 +1,6 @@
+"""TPU-native fused ops: Pallas kernels with XLA twins for CPU.
+
+- flash_attention: blocked exact attention, fwd + bwd kernels
+- flash_decode: length-aware paged single-token decode attention
+- fused_xent: blockwise LM-head + cross-entropy (no [B, T, V] logits)
+"""

@@ -27,6 +27,14 @@ from lingvo_tpu.core import py_utils
 from lingvo_tpu.core.nested_map import NestedMap
 
 
+def _StateDonation() -> tuple:
+  """donate_argnums for the train-state argument: donation only buys the
+  in-place update on accelerators, and the CPU backend warns 'Some donated
+  buffers were not usable' for every non-aliasable leaf (same gating as
+  gshard_decode's decode-state donation)."""
+  return (0,) if jax.default_backend() != "cpu" else ()
+
+
 def _ScalarSummaryPairs(train_out: NestedMap) -> dict:
   """In-loop `tpu_summary.scalar` values as accumulable (value, 1.0) pairs.
 
@@ -202,7 +210,7 @@ class TrainProgram(BaseProgram):
                                                        state_shardings)
         return new_state, out
 
-      self._step_fn = jax.jit(_Step, donate_argnums=(0,))
+      self._step_fn = jax.jit(_Step, donate_argnums=_StateDonation())
     return self._step_fn
 
   def Compile(self, state: NestedMap) -> None:
@@ -259,7 +267,7 @@ class TrainProgram(BaseProgram):
             _Body, (state, acc0, stats0), stacked_batches)
         return state, acc, stats_acc
 
-      self._loop_fn = jax.jit(_Loop, donate_argnums=(0,))
+      self._loop_fn = jax.jit(_Loop, donate_argnums=_StateDonation())
     return self._loop_fn
 
   def _RefreshHostSchedules(self) -> None:

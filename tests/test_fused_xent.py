@@ -1,0 +1,326 @@
+"""Fused blockwise LM-head cross-entropy (ops/fused_xent.py).
+
+Covers docs/fused_xent.md:
+- fused forward == dense logits + f32 log-softmax xent (label smoothing
+  on/off, tanh logits cap on/off, ragged V % block != 0 tail, both weight
+  layouts), including the label log-prob, logsumexp and argmax outputs,
+- fused gradients (custom_vjp, block-recompute backward) == dense
+  gradients for hidden / weight / bias, under padded-position weighting,
+  and through the label_log_prob / lse outputs,
+- the xent_block_size eligibility gate on SimpleFullSoftmax /
+  SharedEmbeddingSoftmaxLayer (0 = legacy dense path, dense fallback when
+  class_probabilities are passed),
+- TransformerLm / BertLm end-to-end: loss, fraction_of_correct (per-block
+  argmax), theta gradients and ScoreSequences match the dense path; the
+  Inference 'score' subgraph still exports full log-probs,
+- the Pallas TPU kernel matches the XLA reference lowering (slow).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import layers as layers_lib
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.ops import fused_xent
+
+
+def _DenseRef(x, w_vd, b, labels, cap, ls):
+  """Dense reference: f32 logits + XentLossFromLogits-style xent."""
+  logits = (x @ w_vd.T).astype(jnp.float32)
+  if b is not None:
+    logits = logits + b
+  if cap > 0:
+    logits = cap * jnp.tanh(logits / cap)
+  log_probs = jax.nn.log_softmax(logits)
+  v = w_vd.shape[0]
+  q = jax.nn.one_hot(labels, v, dtype=jnp.float32)
+  if ls > 0:
+    q = (1.0 - ls) * q + ls / v
+  xent = -jnp.sum(q * log_probs, axis=-1)
+  return xent, log_probs, logits
+
+
+def _Inputs(m=9, d=16, v=50, seed=0):
+  kx, kw, kb, kl = jax.random.split(jax.random.PRNGKey(seed), 4)
+  x = jax.random.normal(kx, (m, d), jnp.float32)
+  w = jax.random.normal(kw, (v, d), jnp.float32) * 0.3
+  b = jax.random.normal(kb, (v,), jnp.float32) * 0.1
+  labels = jax.random.randint(kl, (m,), 0, v)
+  return x, w, b, labels
+
+
+class TestFusedXentOp:
+
+  @pytest.mark.parametrize("cap", [0.0, 5.0])
+  @pytest.mark.parametrize("ls", [0.0, 0.1])
+  @pytest.mark.parametrize("v,block", [(48, 16), (50, 16), (50, 64)])
+  def test_forward_matches_dense(self, cap, ls, v, block):
+    """Online blockwise stats == dense f32 log-softmax: xent, label
+    log-prob, lse and argmax — incl. the ragged V % block tail and a
+    block larger than V."""
+    x, w, b, labels = _Inputs(v=v)
+    out = fused_xent.FusedXent(
+        x, w, labels, block_size=block, bias=b, logits_soft_max=cap,
+        label_smoothing=ls, lowering="xla")
+    xent_d, lp_d, logits_d = _DenseRef(x, w, b, labels, cap, ls)
+    np.testing.assert_allclose(out.per_example_xent, xent_d,
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        out.label_log_prob,
+        jnp.take_along_axis(lp_d, labels[:, None], -1)[:, 0],
+        rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        out.lse, jax.scipy.special.logsumexp(logits_d, axis=-1),
+        rtol=2e-5, atol=2e-6)
+    np.testing.assert_array_equal(out.argmax,
+                                  jnp.argmax(logits_d, axis=-1))
+
+  @pytest.mark.parametrize("cap,ls", [(0.0, 0.0), (5.0, 0.1)])
+  @pytest.mark.parametrize("layout", ["vd", "dv"])
+  def test_grads_match_dense(self, cap, ls, layout):
+    """custom_vjp block-recompute backward == autodiff through the dense
+    path, for d_hidden, d_emb and d_bias, with padded positions carrying
+    zero weight (V=50, block=16: ragged tail exercised in bwd too)."""
+    x, w, b, labels = _Inputs(v=50)
+    wgt = jnp.asarray([1.0] * 6 + [0.0] * 3)  # padded tail positions
+
+    def fused_loss(x, w, b):
+      w_arg = w if layout == "vd" else w.T
+      out = fused_xent.FusedXent(
+          x, w_arg, labels, block_size=16, bias=b, logits_soft_max=cap,
+          label_smoothing=ls, weight_layout=layout, lowering="xla")
+      return jnp.sum(out.per_example_xent * wgt)
+
+    def dense_loss(x, w, b):
+      return jnp.sum(_DenseRef(x, w, b, labels, cap, ls)[0] * wgt)
+
+    gf = jax.grad(fused_loss, argnums=(0, 1, 2))(x, w, b)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(x, w, b)
+    for got, want in zip(gf, gd):
+      np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-6)
+
+  def test_grads_through_score_outputs(self):
+    """label_log_prob and lse carry exact cotangents too (the scoring
+    path is differentiable, not stop-gradiented)."""
+    x, w, b, labels = _Inputs(v=50)
+
+    def fused_score(x, w, b):
+      out = fused_xent.FusedXent(x, w, labels, block_size=16, bias=b,
+                                 lowering="xla")
+      return jnp.sum(out.label_log_prob) + 0.5 * jnp.sum(out.lse)
+
+    def dense_score(x, w, b):
+      _, lp, logits = _DenseRef(x, w, b, labels, 0.0, 0.0)
+      return (jnp.sum(jnp.take_along_axis(lp, labels[:, None], -1))
+              + 0.5 * jnp.sum(jax.scipy.special.logsumexp(logits, -1)))
+
+    gf = jax.grad(fused_score, argnums=(0, 1, 2))(x, w, b)
+    gd = jax.grad(dense_score, argnums=(0, 1, 2))(x, w, b)
+    for got, want in zip(gf, gd):
+      np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-6)
+
+  def test_leading_dims_and_jit(self):
+    """[B, T, D] inputs keep their leading shape; works under jit."""
+    x, w, b, labels = _Inputs(m=12, v=50)
+    x3 = x.reshape(3, 4, -1)
+    l2 = labels.reshape(3, 4)
+    out = jax.jit(lambda x, w, b: fused_xent.FusedXent(
+        x, w, l2, block_size=16, bias=b, lowering="xla"))(x3, w, b)
+    assert out.per_example_xent.shape == (3, 4)
+    flat = fused_xent.FusedXent(x, w, labels, block_size=16, bias=b,
+                                lowering="xla")
+    np.testing.assert_allclose(out.per_example_xent.reshape(-1),
+                               flat.per_example_xent, rtol=1e-6)
+
+
+class TestLayerGate:
+
+  def _Softmax(self, block, has_bias=True, cap=0.0):
+    p = layers_lib.SimpleFullSoftmax.Params().Set(
+        name="sm", input_dim=16, num_classes=50, has_bias=has_bias,
+        logits_soft_max=cap, xent_block_size=block)
+    layer = p.Instantiate()
+    layer.FinalizePaths()
+    return layer
+
+  def test_simple_full_softmax_gate(self):
+    """xent_block_size>0 FProp == dense FProp per_example_xent; logits /
+    log_probs are deliberately absent; argmax matches the dense argmax."""
+    dense, fused = self._Softmax(0, cap=4.0), self._Softmax(16, cap=4.0)
+    theta = dense.InstantiateVariables(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 16))
+    ids = jax.random.randint(jax.random.PRNGKey(2), (3, 5), 0, 50)
+    out_d = dense.FProp(theta, x, class_ids=ids, label_smoothing=0.1)
+    out_f = fused.FProp(theta, x, class_ids=ids, label_smoothing=0.1)
+    np.testing.assert_allclose(out_f.per_example_xent,
+                               out_d.per_example_xent, rtol=2e-5, atol=2e-6)
+    assert out_f.logits is None and out_f.log_probs is None
+    np.testing.assert_array_equal(out_f.argmax,
+                                  jnp.argmax(out_d.logits, -1))
+
+  def test_gate_falls_back_on_class_probabilities(self):
+    """Dense class_probabilities would re-materialize [.., V] anyway: the
+    gate takes the exact legacy path (logits present)."""
+    fused = self._Softmax(16)
+    theta = fused.InstantiateVariables(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    probs = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(2), (4, 50)))
+    out = fused.FProp(theta, x, class_probabilities=probs)
+    assert out.logits is not None
+
+  def test_shared_embedding_gate(self):
+    p0 = layers_lib.SharedEmbeddingSoftmaxLayer.Params().Set(
+        name="emb", vocab_size=50, embedding_dim=16, logits_soft_max=3.0)
+    p1 = p0.Copy().Set(xent_block_size=16)
+    dense, fused = p0.Instantiate(), p1.Instantiate()
+    dense.FinalizePaths(), fused.FinalizePaths()
+    theta = dense.InstantiateVariables(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 16))
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0, 50)
+    out_d = dense.FProp(theta, x, class_ids=ids)
+    out_f = fused.FProp(theta, x, class_ids=ids)
+    np.testing.assert_allclose(out_f.per_example_xent,
+                               out_d.per_example_xent, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        out_f.label_log_probs,
+        jnp.take_along_axis(out_d.log_probs, ids[..., None], -1)[..., 0],
+        rtol=2e-5, atol=2e-6)
+
+
+def _Lm(block, cls=None, **kw):
+  from lingvo_tpu.models.lm import layers as lm_layers
+  cls = cls or lm_layers.TransformerLm
+  kw.setdefault("label_smoothing", 0.1)
+  p = cls.Params().Set(
+      name="lm", vocab_size=50, model_dim=32, num_layers=2, num_heads=2,
+      hidden_dim=64, xent_block_size=block, **kw)
+  task = p.Instantiate()
+  task.FinalizePaths()
+  return task
+
+
+def _LmBatch(b=2, t=8, vocab=50, masked=False):
+  batch = NestedMap(
+      ids=jax.random.randint(jax.random.PRNGKey(1), (b, t), 1, vocab),
+      labels=jax.random.randint(jax.random.PRNGKey(2), (b, t), 1, vocab),
+      paddings=jnp.concatenate(
+          [jnp.zeros((b, t - 2)), jnp.ones((b, 2))], axis=1))
+  if masked:
+    batch.masked_weights = (batch.ids % 3 == 0).astype(jnp.float32)
+  return batch
+
+
+class TestTransformerLmFused:
+
+  def test_loss_metrics_and_grads_match_dense(self):
+    """Same theta (the gate adds no variables): loss, log_pplx and
+    fraction_of_correct_next_step_preds (fused per-block argmax) match
+    the dense path, as do gradients wrt every theta leaf."""
+    t0, t1 = _Lm(0), _Lm(16)
+    theta = t0.InstantiateVariables(jax.random.PRNGKey(0))
+    batch = _LmBatch()
+
+    def loss(task, th):
+      metrics, _ = task.ComputeLoss(
+          th, task.ComputePredictions(th, batch), batch)
+      return metrics.loss[0], metrics
+
+    (l0, m0) = loss(t0, theta)
+    (l1, m1) = loss(t1, theta)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    np.testing.assert_allclose(
+        m0.fraction_of_correct_next_step_preds[0],
+        m1.fraction_of_correct_next_step_preds[0], rtol=1e-6)
+    g0 = jax.grad(lambda th: loss(t0, th)[0])(theta)
+    g1 = jax.grad(lambda th: loss(t1, th)[0])(theta)
+    for got, want in zip(jax.tree_util.tree_leaves(g1),
+                         jax.tree_util.tree_leaves(g0)):
+      np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-6)
+
+  def test_predictions_defer_logits(self):
+    """The fused gate keeps [B, T, V] out of the predictions map."""
+    t1 = _Lm(16)
+    theta = t1.InstantiateVariables(jax.random.PRNGKey(0))
+    preds = t1.ComputePredictions(theta, _LmBatch())
+    assert "logits" not in preds and "hidden" in preds
+
+  def test_score_sequences_fused_vs_dense(self):
+    t0, t1 = _Lm(0), _Lm(16)
+    theta = t0.InstantiateVariables(jax.random.PRNGKey(0))
+    batch = _LmBatch()
+    s0 = t0.ScoreSequences(theta, batch)
+    s1 = t1.ScoreSequences(theta, batch)
+    np.testing.assert_allclose(s1.label_log_probs, s0.label_log_probs,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(s0.weights, s1.weights)
+
+  def test_inference_score_still_dense(self):
+    """Serving export needs the full distribution: the 'score' subgraph
+    falls back to dense logits from the deferred hidden."""
+    t1 = _Lm(16)
+    theta = t1.InstantiateVariables(jax.random.PRNGKey(0))
+    fn, _ = t1.Inference()["score"]
+    batch = _LmBatch()
+    out = fn(theta, NestedMap(ids=batch.ids, paddings=batch.paddings))
+    assert out.log_probs.shape == (*batch.ids.shape, 50)
+
+  def test_bert_lm_fused(self):
+    from lingvo_tpu.models.lm import layers as lm_layers
+    t0 = _Lm(0, cls=lm_layers.BertLm)
+    t1 = _Lm(16, cls=lm_layers.BertLm)
+    theta = t0.InstantiateVariables(jax.random.PRNGKey(0))
+    batch = _LmBatch(masked=True)
+    m0, _ = t0.ComputeLoss(theta, t0.ComputePredictions(theta, batch), batch)
+    m1, _ = t1.ComputeLoss(theta, t1.ComputePredictions(theta, batch), batch)
+    np.testing.assert_allclose(m0.loss[0], m1.loss[0], rtol=1e-5)
+    np.testing.assert_allclose(m0.mlm_accuracy[0], m1.mlm_accuracy[0],
+                               rtol=1e-6)
+
+  def test_sampled_softmax_excludes_fused(self):
+    with pytest.raises(AssertionError):
+      _Lm(16, softmax_num_sampled=8, label_smoothing=0.0)
+
+
+@pytest.mark.slow
+class TestPallasKernel:
+  """Pallas TPU kernel vs the XLA reference lowering (interpret mode —
+  same twin-kernel contract as tests/test_decode_fast_path.py)."""
+
+  @pytest.mark.parametrize("cap,ls", [(0.0, 0.0), (5.0, 0.1)])
+  @pytest.mark.parametrize("v", [256, 200])  # aligned + ragged tail
+  def test_pallas_matches_xla(self, cap, ls, v):
+    m, d, bs = 13, 128, 128
+    kx, kw, kl = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (m, d), jnp.float32)
+    w = jax.random.normal(kw, (v, d), jnp.float32) * 0.3
+    labels = jax.random.randint(kl, (m,), 0, v)
+    kw_args = dict(block_size=bs, logits_soft_max=cap, label_smoothing=ls)
+    o_x = fused_xent.FusedXent(x, w, labels, lowering="xla", **kw_args)
+    o_p = fused_xent.FusedXent(x, w, labels, lowering="pallas",
+                               interpret=True, **kw_args)
+    for name in ("per_example_xent", "label_log_prob", "lse"):
+      np.testing.assert_allclose(getattr(o_p, name), getattr(o_x, name),
+                                 rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(o_p.argmax, o_x.argmax)
+
+  def test_pallas_dv_layout(self):
+    m, d, v, bs = 16, 128, 256, 128
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, d))
+    w = jax.random.normal(jax.random.PRNGKey(1), (v, d)) * 0.3
+    labels = jax.random.randint(jax.random.PRNGKey(2), (m,), 0, v)
+    o_x = fused_xent.FusedXent(x, w, labels, block_size=bs, lowering="xla")
+    o_p = fused_xent.FusedXent(x, w.T, labels, block_size=bs,
+                               weight_layout="dv", lowering="pallas",
+                               interpret=True)
+    np.testing.assert_allclose(o_p.per_example_xent, o_x.per_example_xent,
+                               rtol=1e-6, atol=1e-6)
+
+
+class TestSupportedOnTpu:
+
+  def test_alignment_gate(self):
+    assert fused_xent.SupportedOnTpu(128, 256)
+    assert not fused_xent.SupportedOnTpu(100, 256)
+    assert not fused_xent.SupportedOnTpu(128, 100)
