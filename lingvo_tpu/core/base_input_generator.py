@@ -53,6 +53,12 @@ class BaseInputGenerator(base_layer.BaseLayer):
   def GetPreprocessedInputBatch(self) -> NestedMap:
     return self._InputBatch()
 
+  def InputStats(self) -> dict:
+    """Generator-side health counters, exported as `input_*` train
+    summaries by the programs (ref RecordBatcher stats logging): record /
+    drop / partial-flush counts, prefetch queue depth. {} by default."""
+    return {}
+
   def __iter__(self) -> Iterator[NestedMap]:
     while True:
       try:
@@ -130,6 +136,7 @@ class FileBasedSequenceInputGenerator(BaseSequenceInputGenerator):
     super().__init__(params)
     self._batch_iter = None
     self._prefetcher = None
+    self._batcher = None
 
   # -- subclass point --------------------------------------------------------
   def ProcessRecord(self, record: bytes):
@@ -159,6 +166,7 @@ class FileBasedSequenceInputGenerator(BaseSequenceInputGenerator):
         self._MakeSource(), self.ProcessRecord,
         bucket_upper_bound=p.bucket_upper_bound,
         bucket_batch_limit=p.bucket_batch_limit)
+    self._batcher = batcher  # kept for InputStats (stats were invisible)
     for batch, limit in ((b, self._LimitFor(b)) for b in batcher):
       yield self._PadBatchDim(batch, limit)
 
@@ -199,6 +207,18 @@ class FileBasedSequenceInputGenerator(BaseSequenceInputGenerator):
     if batch is None:
       raise StopIteration
     return batch
+
+  def InputStats(self) -> dict:
+    """Batcher counters (records / dropped_too_long / flushed_partial /
+    batches) + prefetch queue depth. Counters are cumulative ints mutated
+    by the prefetch thread; the dict copy is a consistent-enough snapshot
+    (GIL-atomic int reads) for summary export."""
+    out = {}
+    if self._batcher is not None:
+      out.update(self._batcher.Snapshot())
+    if self._prefetcher is not None:
+      out["prefetch_queue_depth"] = self._prefetcher.Depth()
+    return out
 
   def Reset(self):
     super().Reset()
@@ -257,11 +277,23 @@ class _Prefetcher:
         raise self._error
     return batch
 
+  def Depth(self) -> int:
+    """Prefetched batches currently buffered (0 = consumer may starve)."""
+    return self._queue.qsize()
+
   def Stop(self):
     self._stop.set()
     try:
       while True:
         self._queue.get_nowait()
+    except Exception:
+      pass
+    # Wake any consumer blocked in Next()'s untimed get: once stop is set
+    # the filler never posts its end-of-stream sentinel, and a blocked
+    # consumer (e.g. an async-infeed producer thread being torn down)
+    # would otherwise hang forever.
+    try:
+      self._queue.put_nowait(None)
     except Exception:
       pass
 

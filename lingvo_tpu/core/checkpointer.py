@@ -223,7 +223,10 @@ def ApplyInitFromCheckpointRules(state: NestedMap, rules: dict) -> NestedMap:
             break  # first matching rule wins
       # partial restore: only the mapped source vars are read (a few vars
       # from a 175B checkpoint must not materialize the whole thing on host)
-      meta = _ToNested(mgr.item_metadata(src_step).tree)
+      # orbax >= 0.9 wraps the metadata tree in an object with `.tree`;
+      # 0.7.x (this container) returns the raw dict
+      meta_obj = mgr.item_metadata(src_step)
+      meta = _ToNested(getattr(meta_obj, "tree", meta_obj))
       meta_flat = dict(meta.GetItem("theta").FlattenItems())
       for path, src_path in mapping.items():
         if src_path not in meta_flat:
@@ -239,9 +242,19 @@ def ApplyInitFromCheckpointRules(state: NestedMap, rules: dict) -> NestedMap:
           node = node.setdefault(key, {})
         m = meta_flat[src_path]
         node[parts[-1]] = jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
-      restored = mgr.restore(
-          src_step, args=ocp.args.PyTreeRestore(abstract,
-                                                partial_restore=True))
+      try:
+        restore_args = ocp.args.PyTreeRestore(abstract,
+                                              partial_restore=True)
+      except TypeError:
+        # orbax 0.7.x: no partial_restore kwarg — the transformations-mode
+        # equivalent (transforms={} + per-leaf restore_args) reads only the
+        # leaves present in `abstract`
+        per_leaf = jax.tree_util.tree_map(
+            lambda s: ocp.ArrayRestoreArgs(dtype=s.dtype,
+                                           global_shape=s.shape), abstract)
+        restore_args = ocp.args.PyTreeRestore(
+            abstract, restore_args=per_leaf, transforms={})
+      restored = mgr.restore(src_step, args=restore_args)
       src_flat = dict(_ToNested(dict(restored)["theta"]).FlattenItems())
       n_loaded = 0
       for path, src_path in mapping.items():

@@ -162,11 +162,16 @@ class SequenceBatcher:
     self._limits = list(bucket_batch_limit)
     self._pad_fields = set(pad_field_to_bucket)
     self._flush_every_n = flush_every_n
-    # stats (ref RecordBatcher stats logging)
+    # stats (ref RecordBatcher stats logging); exported as train summaries
+    # via FileBasedSequenceInputGenerator.InputStats
     self.stats = {
         "records": 0, "dropped_too_long": 0, "batches": 0,
         "flushed_partial": 0,
     }
+
+  def Snapshot(self) -> dict:
+    """Copy of the counters, safe to export from another thread."""
+    return dict(self.stats)
 
   def __iter__(self):
     buckets: list[list[NestedMap]] = [[] for _ in self._bounds]

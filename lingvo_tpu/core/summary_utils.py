@@ -21,12 +21,19 @@ import numpy as np
 
 
 class SummaryWriter:
-  """Event-file writer; falls back to no-op when tensorboardX is missing."""
+  """Event-file writer; falls back to no-op when tensorboardX is missing.
+
+  Writes are serialized with a lock: under deferred telemetry
+  (runners/infeed.py) the train program writes from a background worker
+  while Flush/Close may come from the main thread at program boundaries.
+  """
 
   def __init__(self, logdir: str, enabled: bool = True):
+    import threading
     self._writer = None
     self._enabled = enabled
     self._logdir = logdir
+    self._lock = threading.Lock()
     if not enabled:
       return
     try:
@@ -40,8 +47,9 @@ class SummaryWriter:
     return self._writer is not None
 
   def Scalar(self, tag: str, value, step: int):
-    if self._writer is not None:
-      self._writer.add_scalar(tag, float(value), step)
+    with self._lock:
+      if self._writer is not None:
+        self._writer.add_scalar(tag, float(value), step)
 
   def Scalars(self, values: dict, step: int, prefix: str = ""):
     for k, v in values.items():
@@ -49,29 +57,34 @@ class SummaryWriter:
         self.Scalar(f"{prefix}{k}" if prefix else k, v, step)
 
   def Histogram(self, tag: str, values, step: int):
-    if self._writer is not None:
-      self._writer.add_histogram(tag, np.asarray(values), step)
+    with self._lock:
+      if self._writer is not None:
+        self._writer.add_histogram(tag, np.asarray(values), step)
 
   def Image(self, tag: str, image_hwc, step: int):
     """image_hwc: [H, W, C] float in [0, 1] or uint8."""
-    if self._writer is not None:
-      img = np.asarray(image_hwc)
-      if img.dtype != np.uint8:
-        img = (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
-      self._writer.add_image(tag, img, step, dataformats="HWC")
+    with self._lock:
+      if self._writer is not None:
+        img = np.asarray(image_hwc)
+        if img.dtype != np.uint8:
+          img = (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+        self._writer.add_image(tag, img, step, dataformats="HWC")
 
   def Text(self, tag: str, text: str, step: int):
-    if self._writer is not None:
-      self._writer.add_text(tag, text, step)
+    with self._lock:
+      if self._writer is not None:
+        self._writer.add_text(tag, text, step)
 
   def Flush(self):
-    if self._writer is not None:
-      self._writer.flush()
+    with self._lock:
+      if self._writer is not None:
+        self._writer.flush()
 
   def Close(self):
-    if self._writer is not None:
-      self._writer.close()
-      self._writer = None
+    with self._lock:
+      if self._writer is not None:
+        self._writer.close()
+        self._writer = None
 
 
 def AttentionProbsToImage(probs) -> np.ndarray:

@@ -234,7 +234,13 @@ def MeshContext(mesh: Mesh):
   """Enters `mesh` as the ambient mesh so PartitionSpec-based
   with_sharding_constraint hints (MoE dispatch, pipeline buffers) reach
   GSPMD. Use around jit calls: `with mesh_lib.MeshContext(mesh): ...`."""
-  return jax.set_mesh(mesh)
+  set_mesh = getattr(jax, "set_mesh", None)
+  if set_mesh is not None:  # jax >= 0.6: ambient abstract mesh
+    return set_mesh(mesh)
+  # jax 0.4.x: the Mesh object itself is the context manager (physical
+  # mesh / pjit resource env), which with_sharding_constraint uses to
+  # resolve bare PartitionSpecs
+  return mesh
 
 
 def WithShardingConstraint(x, spec_or_names):
@@ -253,7 +259,12 @@ def WithShardingConstraint(x, spec_or_names):
     from jax.sharding import get_abstract_mesh
     mesh_axes = tuple(get_abstract_mesh().axis_names)
   except Exception:
-    mesh_axes = ()
+    try:  # jax 0.4.x: the physical mesh entered by MeshContext
+      from jax._src import mesh as _mesh_impl
+      mesh_axes = tuple(
+          _mesh_impl.thread_resources.env.physical_mesh.axis_names)
+    except Exception:
+      mesh_axes = ()
   if not mesh_axes:
     return x
   filtered = []

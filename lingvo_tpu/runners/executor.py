@@ -212,7 +212,53 @@ class ExecutorTpu:
         self._mlperf.Close()
       raise
 
+  def _SchedulePrograms(self):
+    return list(getattr(self._schedule, "programs", None) or [])
+
+  def _FlushPrograms(self) -> dict:
+    """Lands every program's deferred telemetry (summaries, metric fetch)
+    — called before the final checkpoint so nothing is lost at exit. A
+    telemetry error propagates: it is a real failed summary write/fetch.
+    Returns {program name: result} for results no Run handed out yet (the
+    lag-1 tail), so the caller can NaN-check and export them."""
+    out = {}
+    for prog in self._SchedulePrograms():
+      flush = getattr(prog, "Flush", None)
+      if callable(flush):
+        r = flush()
+        if isinstance(r, dict):
+          out[getattr(getattr(prog, "p", None), "name", "") or "train"] = r
+    return out
+
+  def _RecoverPrograms(self):
+    """Transient-retry hook: drain pending telemetry (the failure is
+    already being handled) and restart errored infeed producers."""
+    for prog in self._SchedulePrograms():
+      rec = getattr(prog, "RecoverFromFailure", None)
+      if callable(rec):
+        try:
+          rec()
+        except BaseException:  # noqa: BLE001
+          pass
+
+  def _ShutdownPrograms(self):
+    """Stops infeed producer threads + telemetry workers (programs stay
+    restartable). Best-effort: teardown must not mask the real error."""
+    for prog in self._SchedulePrograms():
+      sd = getattr(prog, "Shutdown", None)
+      if callable(sd):
+        try:
+          sd()
+        except BaseException:  # noqa: BLE001
+          pass
+
   def _MainLoop(self, state, start_step):
+    try:
+      return self._MainLoopBody(state, start_step)
+    finally:
+      self._ShutdownPrograms()
+
+  def _MainLoopBody(self, state, start_step):
     from lingvo_tpu.core import retry as retry_lib
     step = start_step
     consecutive_failures = 0
@@ -242,7 +288,9 @@ class ExecutorTpu:
               f"in {delay:.0f}s", flush=True)
         time.sleep(delay)
         # rebuild device state from the last checkpoint (ref: cleanup +
-        # rebuild session + resume from checkpoint)
+        # rebuild session + resume from checkpoint); restart any errored
+        # infeed producers so the retried Run pulls fresh batches
+        self._RecoverPrograms()
         state, step = self._checkpointer.Restore(
             self._PlaceState(self._CreateTrainState()))
         continue
@@ -313,6 +361,23 @@ class ExecutorTpu:
                 f"(no {tp.early_stop_metric} improvement in "
                 f"{tp.early_stop_window} steps)", flush=True)
           break
+    # land deferred telemetry (lagging <= 1 loop) before the final save so
+    # summaries/metrics are complete when FINISHED appears; the tail
+    # result the lag-1 return path never surfaced still gets its metrics
+    # row and NaN check here
+    flushed = self._FlushPrograms()
+    if flushed:
+      self._ExportMetrics(step, flushed)
+      import math as _math
+      tail_nan = any(
+          isinstance(r, dict) and "loss" in r
+          and not _math.isfinite(r["loss"])
+          for name, r in flushed.items() if name.startswith("train"))
+      if tail_nan and not self._trial_done:
+        self._trial.ReportDone(infeasible=True, reason="nan_loss")
+        self._trial_done = True
+        print("[executor] NaN/Inf train loss in final deferred loop: "
+              "reporting trial infeasible", flush=True)
     if self._mlperf is not None:
       self._mlperf.Print(self._mllog.RUN_STOP,
                          metadata={"status": "success", "step": step})

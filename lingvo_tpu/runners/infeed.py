@@ -1,0 +1,240 @@
+"""Async device infeed + deferred telemetry for program host loops.
+
+Re-designs the reference's L2 input machinery (`CreateTpuEnqueueOps`,
+`base_input_generator.py:446`): there, host->device enqueue is double-buffered
+against device dequeue so the accelerator never waits on input, and outfeed /
+summary fetch runs on separate threads. In the JAX stack the device loop is a
+jitted program fed by `device_put` batches, so the equivalent overlap is:
+
+- `DeviceInfeed`: ONE background producer thread pulls host batches from the
+  input generator (and optionally places them under the input sharding) into
+  a bounded FIFO queue while the device computes the previous loop. A single
+  producer + FIFO means the consumed batch sequence is bit-identical to
+  calling the generator inline.
+- `DeferredTelemetry`: ONE background worker runs the post-loop
+  `device_get` of metrics/stats and the summary writes, so host fetch never
+  sits between two device loops. Jobs run in submission order (single
+  worker), keeping summaries ordered and the step-rate tracker monotone.
+
+Producer/worker exceptions are latched and re-raised at the consumer
+(`Get()` / `Future.result()`), so the train loop — and the executor's
+transient-retry path above it — sees the real error instead of a silent
+end-of-data or a dropped summary.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Iterator
+
+_EOS = object()  # end-of-stream sentinel (never a valid batch)
+
+# Producer threads that outlived their Stop() join (blocked inside the input
+# generator), keyed by input stream: a NEW producer over the same stream —
+# including one from a fresh DeviceInfeed instance (eval creates a throwaway
+# infeed per Run) — must wait these out or fail loudly rather than race the
+# generator and corrupt batch order.
+_LINGERING_LOCK = threading.Lock()
+_LINGERING: dict[Any, threading.Thread] = {}
+
+
+class DeviceInfeed:
+  """Bounded background producer queue feeding device (or host) batches.
+
+  Args:
+    make_iter: callable returning a FRESH iterator of host batches; invoked
+      once per producer start (and again after `Reset`).
+    place_fn: optional host->device placement applied per batch.
+    depth: queue capacity (loop batches for on-device loops, single batches
+      for per-step loops) — bounds host memory while the device lags.
+    place_in_producer: apply `place_fn` on the producer thread so the H2D
+      transfer overlaps compute too. False hands numpy to the consumer,
+      which must place — the verified-safe multi-process variant, keeping
+      `make_array_from_process_local_data` on the consumer thread.
+    name: thread-name prefix for debugging.
+    stream_key: identity of the underlying input stream (e.g.
+      `id(generator)`). Serializes producers across DeviceInfeed
+      *instances* sharing one stream — see _LINGERING. Defaults to this
+      instance (per-instance protection only).
+
+  Batch ORDER is the iterator's order: one producer thread and one FIFO
+  queue, so the consumed sequence is bit-identical to the synchronous path.
+  """
+
+  def __init__(self, make_iter: Callable[[], Iterator[Any]],
+               place_fn: Callable[[Any], Any] | None = None,
+               depth: int = 2, place_in_producer: bool = True,
+               name: str = "infeed", stream_key: Any = None):
+    self._stream_key = stream_key if stream_key is not None else id(self)
+    self._make_iter = make_iter
+    self._place_fn = place_fn
+    self._depth = max(1, int(depth))
+    self._place_in_producer = bool(place_in_producer and
+                                   place_fn is not None)
+    self._name = name
+    self._thread: threading.Thread | None = None
+    self._queue: "queue.Queue" | None = None
+    self._stop: threading.Event | None = None
+    self._error: BaseException | None = None
+    self._done = False
+    self.wait_s = 0.0  # cumulative consumer blocking time (starvation)
+    self.batches = 0   # batches handed to the consumer
+
+  @property
+  def places_batches(self) -> bool:
+    """True when Get() returns device-placed batches (skip _PutBatch)."""
+    return self._place_in_producer
+
+  @property
+  def healthy(self) -> bool:
+    return self._error is None
+
+  def QueueDepth(self) -> int:
+    q = self._queue
+    return q.qsize() if q is not None else 0
+
+  def _EnsureStarted(self) -> None:
+    if self._thread is not None or self._done:
+      return
+    with _LINGERING_LOCK:
+      lingering = _LINGERING.pop(self._stream_key, None)
+    if lingering is not None and lingering.is_alive():
+      # a previous Stop() (possibly on a DISCARDED DeviceInfeed over the
+      # same stream) timed out while its producer was blocked inside the
+      # generator; two producers pulling one generator would race and
+      # break batch order — wait it out (it parks after its current pull)
+      # or fail loudly rather than corrupt the stream
+      lingering.join(timeout=30.0)
+      if lingering.is_alive():
+        with _LINGERING_LOCK:
+          _LINGERING[self._stream_key] = lingering
+        raise RuntimeError(
+            f"{self._name}: previous producer thread is still blocked in "
+            "the input generator; refusing to start a second producer "
+            "over the same stream")
+    self._queue = queue.Queue(maxsize=self._depth)
+    self._stop = threading.Event()
+    self._thread = threading.Thread(
+        target=self._Produce, args=(self._queue, self._stop),
+        name=f"{self._name}-producer", daemon=True)
+    self._thread.start()
+
+  def _Produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+    # q/stop passed as args (not read from self): a Reset() from the
+    # consumer swaps the members, and an abandoned producer must keep
+    # honoring ITS stop event rather than the replacement's.
+    try:
+      for item in self._make_iter():
+        if self._place_in_producer:
+          item = self._place_fn(item)
+        while not stop.is_set():
+          try:
+            q.put(item, timeout=0.2)
+            break
+          except queue.Full:
+            continue
+        if stop.is_set():
+          return
+    except BaseException as e:  # noqa: BLE001 - surfaced at Get()
+      if not stop.is_set():
+        # a stopped producer's late exception must not poison the latch a
+        # Reset() just cleared for the NEXT epoch
+        self._error = e
+    finally:
+      while not stop.is_set():
+        try:
+          q.put(_EOS, timeout=0.2)
+          return
+        except queue.Full:
+          continue
+
+  def Get(self) -> Any | None:
+    """Next batch, or None at end-of-stream (latched).
+
+    Re-raises a producer exception (also latched: a dead producer must not
+    masquerade as end-of-data). Blocking time accumulates in `wait_s`.
+    """
+    self._EnsureStarted()
+    if self._done:
+      if self._error is not None:
+        raise self._error
+      return None
+    t0 = time.perf_counter()
+    item = self._queue.get()
+    self.wait_s += time.perf_counter() - t0
+    if item is _EOS:
+      self._done = True
+      if self._error is not None:
+        raise self._error
+      return None
+    self.batches += 1
+    return item
+
+  def Iter(self) -> Iterator[Any]:
+    """Generator view over Get() (finite-stream consumers, e.g. eval)."""
+    while True:
+      item = self.Get()
+      if item is None:
+        return
+      yield item
+
+  def Stop(self) -> None:
+    """Stops the producer and discards queued batches. Safe to call twice."""
+    thread, q, stop = self._thread, self._queue, self._stop
+    self._thread = None
+    self._queue = None
+    self._stop = None
+    if stop is not None:
+      stop.set()
+    if q is not None:
+      try:
+        while True:
+          q.get_nowait()
+      except queue.Empty:
+        pass
+    if thread is not None:
+      # The producer may be blocked inside the generator itself (e.g. an
+      # upstream prefetcher); it is a daemon and parks after its current
+      # pull, so don't hang the trainer on it here — but remember it, so a
+      # restart can't race it on the same generator (_EnsureStarted).
+      thread.join(timeout=5.0)
+      if thread.is_alive():
+        with _LINGERING_LOCK:
+          _LINGERING[self._stream_key] = thread
+
+  def Reset(self) -> None:
+    """Stop + clear latched end/error state; the next Get() starts a fresh
+    `make_iter()` iterator. Prefetched-but-unconsumed batches are discarded
+    (callers resetting the underlying generator get a consistent restart)."""
+    self.Stop()
+    self._done = False
+    self._error = None
+
+
+class DeferredTelemetry:
+  """Single-worker executor for post-loop metric fetch + summary writes.
+
+  One worker => jobs complete in submission order. The consumer keeps at
+  most one loop in flight (`TrainProgram.Run` returns the most recent
+  COMPLETED loop's result), so results the executor consumes — NaN-stop,
+  trial reporting, early-stop — lag dispatch by at most one loop.
+  """
+
+  def __init__(self, name: str = "telemetry"):
+    self._name = name
+    self._pool: ThreadPoolExecutor | None = None
+
+  def Submit(self, fn: Callable[[], Any]) -> Future:
+    if self._pool is None:
+      self._pool = ThreadPoolExecutor(max_workers=1,
+                                      thread_name_prefix=self._name)
+    return self._pool.submit(fn)
+
+  def Shutdown(self) -> None:
+    """Waits for in-flight jobs; the next Submit() lazily restarts."""
+    pool, self._pool = self._pool, None
+    if pool is not None:
+      pool.shutdown(wait=True)

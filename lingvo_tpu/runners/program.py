@@ -71,6 +71,21 @@ class BaseProgram:
              "If >0, wrap every Nth Run() in a jax.profiler trace written "
              "to <program_dir>/plugins/profile (SURVEY §5: profiling is "
              "first-class; view in XProf/TensorBoard).")
+    p.Define("async_infeed", True,
+             "Overlap host batch prep (+ H2D placement) with device compute "
+             "via a background producer thread (runners/infeed.py), and — "
+             "for TrainProgram — defer the post-loop metric fetch + summary "
+             "writes to a background worker. False restores the exact "
+             "fully-synchronous legacy control flow (kill switch).")
+    p.Define("infeed_depth", 2,
+             "Bounded infeed queue depth: stacked loop batches for "
+             "on_device_loop, single batches otherwise.")
+    p.Define("infeed_place_on_device", None,
+             "Where H2D placement happens under async_infeed: True = on the "
+             "producer thread (transfer overlaps compute too), False = "
+             "numpy in the thread, placement on the consumer (the "
+             "verified-safe multi-process variant), None = auto (True "
+             "single-process, False multi-process).")
     return p
 
   def __init__(self, params, task=None, input_generator=None):
@@ -83,6 +98,14 @@ class BaseProgram:
     self._step_fn = None
     self._loop_fn = None
     self._run_count = 0
+    self._profiling_run = False
+    # async-infeed machinery (runners/infeed.py), created lazily on the
+    # first async Run so Compile() can pull warm-up batches without racing
+    # the producer thread for the input stream
+    self._infeed = None
+    self._telemetry = None
+    self._pending_telemetry = None
+    self._pending_consumed = True  # was the pending result already returned?
     from lingvo_tpu.core import summary_utils
     self._tb = summary_utils.SummaryWriter(
         self._program_dir, enabled=self.p.write_tensorboard)
@@ -169,9 +192,78 @@ class BaseProgram:
     import contextlib
     n = self.p.profiler_capture_every_n_runs
     self._run_count += 1
-    if n > 0 and self._run_count % n == 0:
+    self._profiling_run = n > 0 and self._run_count % n == 0
+    if self._profiling_run:
       return jax.profiler.trace(self._program_dir)
     return contextlib.nullcontext()
+
+  # -- async infeed / deferred telemetry lifecycle ---------------------------
+
+  def _PlaceInProducer(self) -> bool:
+    """Auto policy for where H2D placement runs (see infeed_place_on_device):
+    multi-process defaults to numpy-in-thread + consumer placement so
+    `make_array_from_process_local_data` stays on the consumer thread."""
+    if self.p.infeed_place_on_device is not None:
+      return bool(self.p.infeed_place_on_device)
+    return jax.process_count() == 1
+
+  @staticmethod
+  def _InputStatsOf(gen) -> dict:
+    """Generator-side counters (SequenceBatcher stats, prefetch depth) for
+    the train summaries; {} when the generator doesn't expose them."""
+    fn = getattr(gen, "InputStats", None)
+    if not callable(fn):
+      return {}
+    try:
+      return dict(fn())
+    except Exception:  # noqa: BLE001 - stats must never kill a train loop
+      return {}
+
+  def Flush(self):
+    """Waits for deferred telemetry and flushes the TB writer; returns the
+    pending Run result if no Run handed it out yet, else None. Called by
+    schedules at program boundaries and by the executor before the final
+    checkpoint, so summaries land in order and the lag-1 tail result still
+    reaches NaN-stop/metrics. No-op for fully-synchronous programs."""
+    out = None
+    if self._pending_telemetry is not None:
+      res = self._pending_telemetry.result()[1]
+      if not self._pending_consumed:
+        out = res
+      self._pending_telemetry = None
+      self._pending_consumed = True
+    self._tb.Flush()
+    return out
+
+  def RecoverFromFailure(self) -> None:
+    """Executor retry hook: drain pending telemetry (swallowing the error
+    already being handled upstream) and restart an errored infeed producer
+    so the retried Run pulls fresh batches."""
+    fut, self._pending_telemetry = self._pending_telemetry, None
+    self._pending_consumed = True
+    if fut is not None:
+      try:
+        fut.result()
+      except BaseException:  # noqa: BLE001
+        pass
+    if self._infeed is not None and not self._infeed.healthy:
+      self._infeed.Reset()
+
+  def Shutdown(self) -> None:
+    """Clean teardown between programs / at executor exit: best-effort
+    telemetry flush, then stop the producer thread and the worker. The
+    program stays usable — the next Run lazily restarts both (note any
+    prefetched-but-unconsumed batches are discarded at Stop)."""
+    try:
+      self.Flush()
+    except BaseException:  # noqa: BLE001 - already surfaced via Run/Flush
+      pass
+    if self._infeed is not None:
+      self._infeed.Stop()
+      self._infeed = None
+    if self._telemetry is not None:
+      self._telemetry.Shutdown()
+      self._telemetry = None
 
 
 class TrainProgram(BaseProgram):
@@ -191,6 +283,12 @@ class TrainProgram(BaseProgram):
              "stacked batch) — one host round-trip per loop instead of per "
              "step (ref tpu_training_loop.repeat, program.py:601-609). The "
              "host prefetches steps_per_loop batches and stacks them.")
+    p.Define("defer_telemetry", True,
+             "Under async_infeed, run the post-loop metric device_get + "
+             "summary writes on a background worker; Run returns the most "
+             "recent COMPLETED loop's result (lags dispatch by <= 1 loop). "
+             "False fetches synchronously after dispatch (infeed overlap "
+             "only). Ignored when async_infeed is False.")
     return p
 
   def _GetStepFn(self, state: NestedMap | None = None):
@@ -270,6 +368,61 @@ class TrainProgram(BaseProgram):
       self._loop_fn = jax.jit(_Loop, donate_argnums=_StateDonation())
     return self._loop_fn
 
+  def _PutStackedBatch(self, stacked: NestedMap) -> NestedMap:
+    """[steps_per_loop, ...]-stacked host batches -> device arrays. The
+    stacked leading dim is the STEPS axis: keep it unsharded and shift the
+    per-step batch spec right by one."""
+    if self.p.mesh is not None and self.p.input_sharding is not None:
+      spec = jax.sharding.PartitionSpec(None, *self.p.input_sharding)
+      sharding = jax.sharding.NamedSharding(self.p.mesh, spec)
+      return stacked.Transform(
+          lambda x: self._PlaceLocalShard(x, sharding, batch_dim=1))
+    return stacked.Transform(jnp.asarray)
+
+  def _MakeTrainIter(self):
+    """Host batch units in exactly the order the sync path consumes them:
+    stacked loop batches for on_device_loop, single batches otherwise.
+    Runs on the infeed producer thread (the only generator caller once
+    async Run starts)."""
+    p = self.p
+    gen = self.input_generator
+    if p.on_device_loop:
+      while True:
+        batches = []
+        try:
+          for _ in range(p.steps_per_loop):
+            batches.append(gen.GetPreprocessedInputBatch())
+        except StopIteration:
+          return  # partial loop at stream end: dropped (sync path raises
+                  # StopIteration mid-stack and loses the same batches)
+        yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    else:
+      while True:
+        try:
+          batch = gen.GetPreprocessedInputBatch()
+        except StopIteration:
+          return
+        yield batch
+
+  def _GetInfeed(self):
+    if self._infeed is None:
+      from lingvo_tpu.runners import infeed as infeed_lib
+      p = self.p
+      place = self._PutStackedBatch if p.on_device_loop else self._PutBatch
+      self._infeed = infeed_lib.DeviceInfeed(
+          self._MakeTrainIter, place_fn=place, depth=p.infeed_depth,
+          place_in_producer=self._PlaceInProducer(),
+          name=f"{p.name or 'train'}-infeed",
+          stream_key=id(self.input_generator))
+    return self._infeed
+
+  def _GetTelemetry(self):
+    if self._telemetry is None:
+      from lingvo_tpu.runners import infeed as infeed_lib
+      self._telemetry = infeed_lib.DeferredTelemetry(
+          name=f"{self.p.name or 'train'}-telemetry")
+    return self._telemetry
+
   def _RefreshHostSchedules(self) -> None:
     """Host-driven schedules (DevBasedSchedule anneal-on-plateau) may change
     between runs; their values are trace-time constants, so a change must
@@ -291,24 +444,27 @@ class TrainProgram(BaseProgram):
       self._host_sched_key = key
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    self._RefreshHostSchedules()
+    if not self.p.async_infeed:
+      return self._RunSync(state)
+    return self._RunAsync(state)
+
+  def _RunSync(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    """The legacy fully-synchronous loop (p.async_infeed = False): host
+    batch prep, device loop, metric fetch and summary writes all serialize
+    on this thread. Kept bit-exact as the kill-switch reference behavior;
+    only the infeed_wait_s / host_overhead_s timers are new."""
     p = self.p
     t0 = time.time()
-    self._RefreshHostSchedules()
     if p.on_device_loop:
       # host: prefetch + stack steps_per_loop batches; device: one program
+      t_in = time.perf_counter()
       batches = [self.input_generator.GetPreprocessedInputBatch()
                  for _ in range(p.steps_per_loop)]
       stacked = jax.tree_util.tree_map(
           lambda *xs: np.stack(xs), *batches)
-      if self.p.mesh is not None and self.p.input_sharding is not None:
-        # the stacked leading dim is the STEPS axis: keep it unsharded and
-        # shift the per-step batch spec right by one
-        spec = jax.sharding.PartitionSpec(None, *self.p.input_sharding)
-        sharding = jax.sharding.NamedSharding(self.p.mesh, spec)
-        stacked = stacked.Transform(
-            lambda x: self._PlaceLocalShard(x, sharding, batch_dim=1))
-      else:
-        stacked = stacked.Transform(jnp.asarray)
+      stacked = self._PutStackedBatch(stacked)
+      infeed_wait_s = time.perf_counter() - t_in
       fn = self._GetLoopFn(state)
       with self._MeshScope(), self._ProfilerScope():
         state, acc, stats_acc = fn(state, stacked)
@@ -317,10 +473,13 @@ class TrainProgram(BaseProgram):
       fn = self._GetStepFn(state)
       acc = None
       stats_acc = None
+      infeed_wait_s = 0.0
       with self._MeshScope(), self._ProfilerScope():
         for _ in range(p.steps_per_loop):
+          t_in = time.perf_counter()
           batch = self._PutBatch(
               self.input_generator.GetPreprocessedInputBatch())
+          infeed_wait_s += time.perf_counter() - t_in
           state, out = fn(state, batch)
           acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
           stats_pairs = NestedMap(
@@ -331,6 +490,7 @@ class TrainProgram(BaseProgram):
         # inside the profiler scope so traces capture the device work.
         jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
     wall = time.time() - t0
+    t_tel = time.perf_counter()
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     if stats_acc:
       result.update(metrics_lib.FinalizeMetrics(stats_acc))
@@ -338,11 +498,114 @@ class TrainProgram(BaseProgram):
     result["examples_per_second"] = (
         p.steps_per_loop * self.input_generator.GlobalBatchSize() / wall)
     step = int(jax.device_get(state.step))
+    # loop wall attribution (satellite of the async-infeed PR): input wait
+    # vs host-side telemetry fetch — on this path both sit on the critical
+    # path between device loops
+    result["infeed_wait_s"] = round(infeed_wait_s, 6)
+    result["host_overhead_s"] = round(
+        infeed_wait_s + (time.perf_counter() - t_tel), 6)
+    for k, v in self._InputStatsOf(self.input_generator).items():
+      result[f"input_{k}"] = v
     # smoothed cross-Run rate incl. eval gaps (ref StepRateTracker:393)
     result["global_steps_per_second"] = self._rate_tracker.Update(
         step, self.input_generator.GlobalBatchSize())
     self.WriteSummaries(step, result)
     return state, result
+
+  def _RunAsync(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    """Async pipeline: batches come pre-prepared (and, single-process,
+    pre-placed) from the infeed producer; the post-loop metric fetch +
+    summary write run on the telemetry worker. Batch order is bit-identical
+    to _RunSync; the returned result is the most recent COMPLETED loop's
+    (<= 1 loop stale; the first Run blocks for its own)."""
+    p = self.p
+    t0 = time.time()
+    infeed = self._GetInfeed()
+    wait0 = infeed.wait_s
+    if p.on_device_loop:
+      stacked = infeed.Get()
+      if stacked is None:
+        raise StopIteration("train input exhausted")
+      if not infeed.places_batches:
+        stacked = self._PutStackedBatch(stacked)
+      fn = self._GetLoopFn(state)
+      with self._MeshScope(), self._ProfilerScope():
+        state, acc, stats_acc = fn(state, stacked)
+        if self._profiling_run:
+          # opt-in diagnostics: keep the device work inside the trace
+          jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    else:
+      fn = self._GetStepFn(state)
+      acc = None
+      stats_acc = None
+      with self._MeshScope(), self._ProfilerScope():
+        for _ in range(p.steps_per_loop):
+          batch = infeed.Get()
+          if batch is None:
+            raise StopIteration("train input exhausted")
+          if not infeed.places_batches:
+            batch = self._PutBatch(batch)
+          state, out = fn(state, batch)
+          acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
+          stats_pairs = NestedMap(
+              {k: (v, 1.0) for k, v in out.stats.FlattenItems()})
+          stats_pairs.update(_ScalarSummaryPairs(out))
+          stats_acc = metrics_lib.AccumulateMetrics(stats_acc, stats_pairs)
+        if self._profiling_run:
+          jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    # host-side cost of this Run (input wait + placement + dispatch);
+    # everything below the dispatch is off the critical path
+    host_overhead_s = time.time() - t0
+    infeed_wait_s = infeed.wait_s - wait0
+    queue_depth = infeed.QueueDepth()
+    input_stats = self._InputStatsOf(self.input_generator)
+    step_arr = state.step
+    if _StateDonation():
+      # the NEXT Run's dispatch donates `state` (incl. .step) on
+      # accelerator backends; hand the worker an independent derived array
+      # so its deferred device_get can't hit a deleted buffer
+      step_arr = step_arr + 0
+    job = functools.partial(
+        self._FinalizeLoop, step_arr, acc, stats_acc, t0,
+        host_overhead_s, infeed_wait_s, queue_depth, input_stats)
+    if not p.defer_telemetry:
+      return state, job()[1]
+    fut = self._GetTelemetry().Submit(job)
+    prev, self._pending_telemetry = self._pending_telemetry, fut
+    # steady state: return loop k-1's result (its fetch overlapped this
+    # loop's dispatch); first Run after a Flush blocks for its own — and
+    # marks it consumed so Flush won't report it a second time
+    self._pending_consumed = prev is None
+    result = (prev if prev is not None else fut).result()[1]
+    return state, result
+
+  def _FinalizeLoop(self, step_arr, acc, stats_acc, t_start,
+                    host_overhead_s, infeed_wait_s, queue_depth,
+                    input_stats) -> tuple[int, dict[str, float]]:
+    """Telemetry-worker job: device_get of one loop's metrics + summary
+    write. The np.asarray inside FinalizeMetrics synchronizes on the loop's
+    completion, so `wall` covers dispatch through device completion."""
+    p = self.p
+    result = metrics_lib.FinalizeMetrics(acc) if acc else {}
+    if stats_acc:
+      result.update(metrics_lib.FinalizeMetrics(stats_acc))
+    wall = max(time.time() - t_start, 1e-9)
+    result["steps_per_second"] = p.steps_per_loop / wall
+    result["examples_per_second"] = (
+        p.steps_per_loop * self.input_generator.GlobalBatchSize() / wall)
+    result["infeed_wait_s"] = round(infeed_wait_s, 6)
+    result["host_overhead_s"] = round(host_overhead_s, 6)
+    result["infeed_queue_depth"] = queue_depth
+    for k, v in input_stats.items():
+      result[f"input_{k}"] = v
+    step = int(jax.device_get(step_arr))
+    result["global_steps_per_second"] = self._rate_tracker.Update(
+        step, self.input_generator.GlobalBatchSize())
+    self.WriteSummaries(step, result)
+    # stamped AFTER the summary write (the jsonl rows are keyed by step
+    # already): lets executor metrics rows disambiguate the <=1-loop lag
+    result["at_step"] = step
+    return step, result
 
 
 class EvalProgram(BaseProgram):
@@ -389,18 +652,42 @@ class EvalProgram(BaseProgram):
     acc = None
     gen = self.input_generator
     max_batches = self._MaxEvalBatches()
+    raw = (gen.EpochBatches() if hasattr(gen, "EpochBatches")
+           else _TakeN(gen, max_batches))
+    # Async infeed: prefetch (and, single-process, pre-place) eval batches
+    # on a producer thread so host batch prep overlaps the device eval
+    # steps. The multi-host batch-availability barrier stays on THIS thread
+    # (its process_allgather must not run concurrently with the eval step's
+    # collectives). One throwaway infeed per Run: eval streams are finite
+    # and the generator is Reset between cycles.
+    infeed = None
+    if self.p.async_infeed:
+      from lingvo_tpu.runners import infeed as infeed_lib
+      infeed = infeed_lib.DeviceInfeed(
+          lambda: raw, place_fn=self._PutBatch, depth=self.p.infeed_depth,
+          place_in_producer=self._PlaceInProducer(),
+          name=f"{self.p.name or 'eval'}-infeed", stream_key=id(gen))
     batches = _CoordinateFiniteStream(
-        gen.EpochBatches() if hasattr(gen, "EpochBatches")
-        else _TakeN(gen, max_batches))
+        infeed.Iter() if infeed is not None else raw)
     n = 0
-    with self._MeshScope(), self._ProfilerScope():
-      for batch in batches:
-        out = fn(theta, self._PutBatch(batch), state.step)
-        acc = metrics_lib.AccumulateMetrics(acc, out)
-        n += 1
-        if n >= max_batches:
-          break
+    infeed_wait_s = 0.0
+    try:
+      with self._MeshScope(), self._ProfilerScope():
+        for batch in batches:
+          if infeed is None or not infeed.places_batches:
+            batch = self._PutBatch(batch)
+          out = fn(theta, batch, state.step)
+          acc = metrics_lib.AccumulateMetrics(acc, out)
+          n += 1
+          if n >= max_batches:
+            break
+    finally:
+      if infeed is not None:
+        infeed_wait_s = infeed.wait_s
+        infeed.Stop()
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
+    if infeed is not None:
+      result["infeed_wait_s"] = round(infeed_wait_s, 6)
     _MaybeResetFiniteStream(gen)
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)
@@ -643,6 +930,13 @@ class SimpleProgramSchedule:
       for _ in range(max(1, self.p.train_executions_per_eval)):
         state, train_result = self.train_program.Run(state)
       results["train"] = train_result
+      if self.eval_programs:
+        # program boundary: land the deferred telemetry of the last train
+        # loop before eval starts (summary ordering), and report the
+        # CURRENT loop's result to the executor instead of the lagged one
+        flushed = self.train_program.Flush()
+        if flushed is not None:
+          results["train"] = flushed
     for ep in self.eval_programs:
       state, r = ep.Run(state)
       results[ep.p.name] = r
@@ -755,6 +1049,11 @@ class MultiTaskProgramSchedule:
     self._runs_since_eval += 1
     if self._runs_since_eval >= max(1, self.p.train_executions_per_eval):
       self._runs_since_eval = 0
+      if self.eval_programs:
+        # program boundary: see SimpleProgramSchedule.Run
+        flushed = self.train_programs[name].Flush()
+        if flushed is not None:
+          results[f"train_{name}"] = flushed
       for ep in self.eval_programs:
         task_name = (getattr(ep.p, "task_name", None)
                      or next(iter(self._tasks)))

@@ -1,0 +1,382 @@
+"""Async device infeed + deferred telemetry (runners/infeed.py; ref
+CreateTpuEnqueueOps double-buffering, base_input_generator.py:446): batch
+order and loss trajectories bit-identical to the sync path, producer
+exceptions reach the executor retry path, clean Reset/shutdown across
+program schedules, deferred-summary Flush ordering, and the async_infeed
+kill switch."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.runners import executor as executor_lib
+from lingvo_tpu.runners import infeed as infeed_lib
+from lingvo_tpu.runners import program as program_lib
+
+from tests.test_executor_hardening import (_MakeScheduleAndTask,
+                                           _RegressionInput, _RegressionTask,
+                                           _TaskParams)
+
+
+class _CountedInput(_RegressionInput):
+  """Deterministic regression input that counts (and can fail) pulls."""
+
+  def __init__(self, fail_at=None, fail_msg="UNAVAILABLE: reader died",
+               **kw):
+    super().__init__(**kw)
+    self.pulls = 0
+    self._fail_at = fail_at
+    self._fail_msg = fail_msg
+
+  def GetPreprocessedInputBatch(self):
+    self.pulls += 1
+    if self._fail_at is not None and self.pulls == self._fail_at:
+      raise RuntimeError(self._fail_msg)
+    return super().GetPreprocessedInputBatch()
+
+
+def _ProducerThreads():
+  return [t for t in threading.enumerate() if "-producer" in t.name]
+
+
+class TestDeviceInfeed:
+
+  def test_bit_identical_order(self):
+    """The consumed sequence equals calling the generator inline."""
+    ref = _RegressionInput(seed=7)
+    want = [ref.GetPreprocessedInputBatch() for _ in range(8)]
+    gen = _RegressionInput(seed=7)
+
+    def it():
+      while True:
+        yield gen.GetPreprocessedInputBatch()
+
+    feed = infeed_lib.DeviceInfeed(it, depth=3)
+    try:
+      for k in range(8):
+        got = feed.Get()
+        np.testing.assert_array_equal(got.x, want[k].x)
+        np.testing.assert_array_equal(got.y, want[k].y)
+    finally:
+      feed.Stop()
+
+  def test_end_of_stream_latches_and_reset_restarts(self):
+    def make_iter():
+      return iter([NestedMap(x=np.ones(2)), NestedMap(x=np.zeros(2))])
+
+    feed = infeed_lib.DeviceInfeed(make_iter, depth=2)
+    assert feed.Get() is not None
+    assert feed.Get() is not None
+    assert feed.Get() is None
+    assert feed.Get() is None  # latched: a second eval cycle must not hang
+    feed.Reset()
+    assert feed.Get() is not None  # fresh make_iter() after Reset
+    feed.Stop()
+
+  def test_producer_exception_propagates_and_latches(self):
+    def it():
+      yield NestedMap(x=np.ones(2))
+      raise RuntimeError("UNAVAILABLE: socket closed")
+
+    feed = infeed_lib.DeviceInfeed(it, depth=2)
+    assert feed.Get() is not None
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+      feed.Get()
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+      feed.Get()  # latched, not end-of-data
+    assert not feed.healthy
+    feed.Reset()
+    assert feed.healthy
+    feed.Stop()
+
+  def test_stop_joins_producer_thread(self):
+    feed = infeed_lib.DeviceInfeed(
+        lambda: iter(NestedMap(x=np.ones(2)) for _ in range(10**6)),
+        depth=2, name="t-stop")
+    feed.Get()
+    assert any("t-stop" in t.name for t in _ProducerThreads())
+    feed.Stop()
+    deadline = time.time() + 5
+    while time.time() < deadline and any(
+        "t-stop" in t.name for t in _ProducerThreads()):
+      time.sleep(0.02)
+    assert not any("t-stop" in t.name for t in _ProducerThreads())
+
+
+def _MakeProg(tmp_path, name, gen, async_infeed, on_device_loop,
+              steps_per_loop=3, **overrides):
+  task_p = _TaskParams(max_steps=100, steps_per_loop=steps_per_loop)
+  task = task_p.Instantiate()
+  task.FinalizePaths()
+  tp = program_lib.TrainProgram.Params().Set(
+      task=task_p, logdir=str(tmp_path / name), name=name,
+      steps_per_loop=steps_per_loop, async_infeed=async_infeed,
+      on_device_loop=on_device_loop, write_tensorboard=False, **overrides)
+  prog = program_lib.TrainProgram(tp, task=task, input_generator=gen)
+  state = task.CreateTrainState(jax.random.PRNGKey(0))
+  return prog, state
+
+
+class TestTrainProgramAsync:
+
+  @pytest.mark.parametrize("on_device_loop", [False, True])
+  def test_loss_trajectory_bit_identical(self, tmp_path, on_device_loop):
+    """Async vs sync over 4 loops: same batches, same device programs =>
+    bitwise-equal losses and final theta (the GSPMD contract is untouched:
+    identical placement, identical programs)."""
+    losses = {}
+    thetas = {}
+    for mode in ("sync", "async"):
+      gen = _CountedInput(seed=3)
+      prog, state = _MakeProg(tmp_path, f"{mode}_{on_device_loop}", gen,
+                              async_infeed=(mode == "async"),
+                              on_device_loop=on_device_loop)
+      seen = []
+      for _ in range(4):
+        state, result = prog.Run(state)
+        seen.append(result["loss"])
+      final = prog.Flush()
+      if final is not None:
+        seen.append(final["loss"])
+      prog.Shutdown()
+      # the per-Run result stream may lag/repeat by design; compare the
+      # per-loop summaries, which carry exactly one entry per loop
+      path = os.path.join(str(tmp_path / f"{mode}_{on_device_loop}"),
+                          f"{mode}_{on_device_loop}", "summaries.jsonl")
+      with open(path) as f:
+        rows = [json.loads(line) for line in f]
+      losses[mode] = [(r["step"], r["loss"]) for r in rows]
+      thetas[mode] = jax.device_get(state.theta)
+    assert losses["sync"] == losses["async"]  # bitwise: json round-trip
+    for a, b in zip(jax.tree_util.tree_leaves(thetas["sync"]),
+                    jax.tree_util.tree_leaves(thetas["async"])):
+      np.testing.assert_array_equal(a, b)
+
+  def test_kill_switch_restores_legacy_flow(self, tmp_path):
+    """async_infeed=False never constructs infeed/telemetry machinery."""
+    gen = _CountedInput(seed=1)
+    prog, state = _MakeProg(tmp_path, "kill", gen, async_infeed=False,
+                            on_device_loop=True)
+    before = set(_ProducerThreads())
+    state, result = prog.Run(state)
+    assert prog._infeed is None and prog._telemetry is None
+    assert prog._pending_telemetry is None
+    assert set(_ProducerThreads()) == before
+    # sync accounting keys still present (loop wall attribution satellite)
+    assert "infeed_wait_s" in result and "host_overhead_s" in result
+    assert gen.pulls == 3  # exactly steps_per_loop: no background prefetch
+    prog.Shutdown()
+
+  def test_result_lag_bounded_by_one_loop(self, tmp_path):
+    gen = _CountedInput(seed=5)
+    prog, state = _MakeProg(tmp_path, "lag", gen, async_infeed=True,
+                            on_device_loop=True)
+    state, r1 = prog.Run(state)       # first Run blocks for its own result
+    assert "loss" in r1 and np.isfinite(r1["loss"])
+    state, r2 = prog.Run(state)       # steady state: most recent COMPLETED
+    assert "loss" in r2
+    final = prog.Flush()              # lands loop 2's telemetry
+    assert final is not None and "loss" in final
+    path = os.path.join(str(tmp_path / "lag"), "lag", "summaries.jsonl")
+    with open(path) as f:
+      steps = [json.loads(l)["step"] for l in f]
+    assert steps == [3, 6]            # one summary per loop, in order
+    prog.Shutdown()
+
+  def test_deferred_result_carries_accounting(self, tmp_path):
+    gen = _CountedInput(seed=2)
+    prog, state = _MakeProg(tmp_path, "acct", gen, async_infeed=True,
+                            on_device_loop=True)
+    state, result = prog.Run(state)
+    for key in ("infeed_wait_s", "host_overhead_s", "infeed_queue_depth",
+                "steps_per_second", "examples_per_second"):
+      assert key in result, key
+    prog.Shutdown()
+
+  def test_input_stats_exported(self, tmp_path):
+    class _StatsInput(_CountedInput):
+      def InputStats(self):
+        return {"records": 123, "dropped_too_long": 1}
+
+    gen = _StatsInput(seed=2)
+    prog, state = _MakeProg(tmp_path, "stats", gen, async_infeed=True,
+                            on_device_loop=True)
+    state, result = prog.Run(state)
+    assert result["input_records"] == 123
+    assert result["input_dropped_too_long"] == 1
+    prog.Shutdown()
+
+  def test_producer_exception_reaches_run(self, tmp_path):
+    gen = _CountedInput(seed=0, fail_at=5)
+    prog, state = _MakeProg(tmp_path, "fail", gen, async_infeed=True,
+                            on_device_loop=True)
+    state, _ = prog.Run(state)  # loop 1 consumes pulls 1..3
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+      for _ in range(3):
+        state, _ = prog.Run(state)
+    prog.Shutdown()
+
+
+class TestExecutorIntegration:
+
+  def test_transient_input_failure_recovers(self, tmp_path):
+    """A transient producer death propagates into the executor's retry
+    path, which restores the checkpoint, resets the infeed, and finishes."""
+    logdir = str(tmp_path)
+    task_p = _TaskParams(max_steps=30, steps_per_loop=5, save_interval=5)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    gen = _CountedInput(seed=0, fail_at=12)  # dies mid-loop 3
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=logdir, steps_per_loop=5)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+        task=task, input_generators={"Train": gen})
+    ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task)
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 30
+    assert gen.pulls > 12  # the producer really did die and restart
+
+  def test_train_eval_train_schedule_clean_lifecycle(self, tmp_path):
+    """Two full train->eval cycles: deferred telemetry flushes at program
+    boundaries (current-loop results, ordered summaries), eval infeeds are
+    throwaway per Run, and executor shutdown leaves no producer threads."""
+    logdir = str(tmp_path)
+    task_p = _TaskParams(max_steps=20, steps_per_loop=5)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=logdir, steps_per_loop=5, on_device_loop=True)
+    eval_p = program_lib.EvalProgram.Params().Set(
+        task=task_p, logdir=logdir, name="eval_test", steps_per_loop=2)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(
+            train_program=train_p, eval_programs=[eval_p]),
+        task=task,
+        input_generators={"Train": _RegressionInput(seed=0),
+                          "Test": _RegressionInput(seed=9)})
+    before = set(_ProducerThreads())
+    ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task)
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 20
+    # boundary Flush => metrics.jsonl carries the CURRENT cycle's train
+    # loss at every step (no lag when eval programs run)
+    with open(os.path.join(logdir, "metrics.jsonl")) as f:
+      rows = [json.loads(l) for l in f]
+    assert [r["step"] for r in rows] == [5, 10, 15, 20]
+    assert all("loss" in r["train"] and "loss" in r["eval_test"]
+               for r in rows)
+    # train summaries landed for every loop, in step order
+    with open(os.path.join(logdir, "train", "summaries.jsonl")) as f:
+      steps = [json.loads(l)["step"] for l in f]
+    assert steps == [5, 10, 15, 20]
+    # executor Shutdown stopped all infeed producers it started
+    deadline = time.time() + 5
+    while time.time() < deadline and set(_ProducerThreads()) - before:
+      time.sleep(0.02)
+    assert not (set(_ProducerThreads()) - before)
+
+  def test_nan_stop_still_fires_with_lagged_results(self, tmp_path):
+    """NaN train loss stops the run within the documented <= 1-loop lag."""
+
+    class _NanInput(_RegressionInput):
+      def __init__(self, nan_from_pull, **kw):
+        super().__init__(**kw)
+        self.pulls = 0
+        self._nan_from = nan_from_pull
+
+      def GetPreprocessedInputBatch(self):
+        self.pulls += 1
+        b = super().GetPreprocessedInputBatch()
+        if self.pulls >= self._nan_from:
+          b.y = b.y + np.float32("nan")
+        return b
+
+    logdir = str(tmp_path)
+    task_p = _TaskParams(max_steps=100, steps_per_loop=5, save_interval=100)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=logdir, steps_per_loop=5)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+        task=task, input_generators={"Train": _NanInput(6, seed=0)})
+    ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task,
+                                  max_train_retries=0)
+    state = ex.Start()
+    # NaN enters at loop 2 (steps 6-10); lag <= 1 loop => stop by step 15
+    assert int(jax.device_get(state.step)) <= 15
+
+  def test_nan_in_final_loop_reaches_trial_via_flush(self, tmp_path):
+    """A NaN in the LAST loop before max_steps is only ever seen by the
+    exit-time Flush (the lag-1 return path never surfaces it) — the
+    executor must still report the trial infeasible."""
+    from lingvo_tpu.core import base_trial
+
+    class _RecordingTrial(base_trial.NoOpTrial):
+      def __init__(self):
+        self.done = None
+
+      def ReportDone(self, infeasible=False, reason=""):
+        if self.done is None or infeasible:
+          self.done = (infeasible, reason)
+
+    class _NanTailInput(_RegressionInput):
+      def __init__(self, nan_from_pull, **kw):
+        super().__init__(**kw)
+        self.pulls = 0
+        self._nan_from = nan_from_pull
+
+      def GetPreprocessedInputBatch(self):
+        self.pulls += 1
+        b = super().GetPreprocessedInputBatch()
+        if self.pulls >= self._nan_from:
+          b.y = b.y + np.float32("nan")
+        return b
+
+    logdir = str(tmp_path)
+    task_p = _TaskParams(max_steps=10, steps_per_loop=5, save_interval=100)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    train_p = program_lib.TrainProgram.Params().Set(
+        task=task_p, logdir=logdir, steps_per_loop=5)
+    sched = program_lib.SimpleProgramSchedule(
+        program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+        task=task,
+        input_generators={"Train": _NanTailInput(6, seed=0)})  # loop 2 only
+    trial = _RecordingTrial()
+    ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task,
+                                  trial=trial, max_train_retries=0)
+    ex.Start()
+    assert trial.done == (True, "nan_loss")
+
+
+class TestEvalProgramInfeed:
+
+  def test_eval_matches_sync_and_stops_cleanly(self, tmp_path):
+    results = {}
+    for mode in (False, True):
+      task_p = _TaskParams()
+      task = task_p.Instantiate()
+      task.FinalizePaths()
+      ep = program_lib.EvalProgram.Params().Set(
+          task=task_p, logdir=str(tmp_path / str(mode)), name="eval_test",
+          steps_per_loop=3, async_infeed=mode, write_tensorboard=False)
+      prog = program_lib.EvalProgram(ep, task=task,
+                                     input_generator=_RegressionInput(seed=4))
+      state = task.CreateTrainState(jax.random.PRNGKey(0))
+      before = set(_ProducerThreads())
+      _, r = prog.Run(state)
+      results[mode] = r["loss"]
+      deadline = time.time() + 5
+      while time.time() < deadline and set(_ProducerThreads()) - before:
+        time.sleep(0.02)
+      assert not (set(_ProducerThreads()) - before)  # stopped in finally
+    assert results[False] == results[True]  # same batches, same program
