@@ -492,6 +492,109 @@ class MultiHeadedAttention(base_layer.BaseLayer):
         key=key_cache, value=value_cache, time_step=t + c)
     return self._PostProj(theta, ctx), new_states
 
+  # -- block-table paged decode (serving engine) -----------------------------
+
+  def InitPagedStates(self, theta, num_pages: int,
+                      page_size: int) -> NestedMap:
+    """Global KV page pool [num_pages, page_size, N, H] shared by all
+    sequences; which pages belong to whom lives host-side in the serving
+    engine's block tables, so there is no time_step here (per-sequence
+    lengths ride each PagedStep call). The engine reserves the LAST page as
+    the trash page that padding-token writes scatter into — allocate with
+    one extra page and never hand page num_pages-1 to the allocator."""
+    del theta
+    n, h = self.p.num_heads, self._dim_per_head
+    dtype = self.fprop_dtype
+    return NestedMap(
+        key=jnp.zeros((num_pages, page_size, n, h), dtype),
+        value=jnp.zeros((num_pages, page_size, n, h), dtype))
+
+  def BlockDecodeEligible(self, page_size: int) -> bool:
+    """Same gate family as PagedDecodeEligible, for the block-table kernel:
+    plain masked-softmax attention only. Ineligible configs run PagedStep's
+    gather-dense fallback (exact, just not paged-fast) — the engine surfaces
+    that in its stats so a dense run never masquerades as paged."""
+    p = self.p
+    if jax.default_backend() == "tpu":
+      from lingvo_tpu.ops import block_decode
+      if not block_decode.SupportedOnTpu(page_size, self._dim_per_head):
+        return False
+    return (page_size > 0 and p.rel_pos_emb_dim == 0
+            and p.atten_logit_cap == 0 and p.atten_dropout_prob == 0.0
+            and p.qdomain_softmax is None)
+
+  def PagedStep(self, theta, query_vec, cached_states: NestedMap,
+                block_tables, q_pos, in_len):
+    """One continuous-batching step against the block-table page pool.
+
+    query_vec: [B, C, D] — row b's tokens for global slots
+    [q_pos[b], q_pos[b] + in_len[b]); queries past in_len[b] are padding
+    (their pool writes go to the trash page, their outputs are garbage the
+    engine discards). C == 1 is the steady-state decode step; C > 1 is a
+    chunked-prefill step (decode rows riding a mixed step use in_len == 1).
+    block_tables: [B, t_pages] int32 physical page ids (allocator-owned;
+    rows own disjoint pages, so valid writes never collide). q_pos/in_len:
+    [B] int32. Returns ([B, C, D], updated states). Unlike ExtendStep the
+    layout is LEFT-aligned with no cache_paddings: rotary attention depends
+    only on relative position, so numerics match the right-aligned dense
+    path (asserted by the engine parity tests).
+    """
+    from lingvo_tpu.ops import block_decode
+    p = self.p
+    assert p.rel_pos_emb_dim <= 0, (
+        "PagedStep computes positions from q_pos; the T5 relative bias "
+        "would use wrong buckets")
+    k_pool, v_pool = cached_states.key, cached_states.value
+    np_total, page_size = k_pool.shape[0], k_pool.shape[1]
+    t_pages = block_tables.shape[1]
+    b, c, _ = query_vec.shape
+    q_pos = q_pos.astype(jnp.int32)
+    in_len = in_len.astype(jnp.int32)
+    q = self._HeadsProj(theta, "query", query_vec)
+    k_new = self._HeadsProj(theta, "key", query_vec)
+    v_new = self._HeadsProj(theta, "value", query_vec)
+    pos_i = q_pos[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [B, C]
+    if p.use_rotary_position_emb:
+      rt = self.ChildTheta(theta, "rotary")
+      pos = pos_i.astype(jnp.float32)
+      q = self.rotary.FProp(rt, q, position=pos)
+      k_new = self.rotary.FProp(rt, k_new, position=pos)
+    q = self._ScaleQuery(theta, q)
+    # scatter the chunk's K/V through the block table BEFORE the attention
+    # read (chunk self-attention needs them); padding queries write to the
+    # trash page (pool page np_total - 1, never in any block table)
+    valid = jnp.arange(c, dtype=jnp.int32)[None] < in_len[:, None]  # [B, C]
+    logical = jnp.clip(pos_i // page_size, 0, t_pages - 1)
+    phys = jnp.take_along_axis(
+        jnp.clip(block_tables.astype(jnp.int32), 0, np_total - 1),
+        logical, axis=1)                                           # [B, C]
+    phys = jnp.where(valid, phys, np_total - 1)
+    off = jnp.where(valid, pos_i % page_size,
+                    jnp.arange(c, dtype=jnp.int32)[None] % page_size)
+    k_pool = k_pool.at[phys, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, off].set(v_new.astype(v_pool.dtype))
+    new_states = NestedMap(key=k_pool, value=v_pool)
+    if self.BlockDecodeEligible(page_size):
+      if c == 1:
+        ctx = block_decode.BlockDecode(
+            q, k_pool, v_pool, block_tables, q_pos + in_len,
+            page_size=page_size)
+      else:
+        ctx = block_decode.BlockPrefill(
+            q, k_pool, v_pool, block_tables, q_pos, in_len,
+            page_size=page_size)
+    else:
+      # gather-dense fallback: materialize the row's logical cache view and
+      # run the einsum path (handles logit cap / dropout / prob quant).
+      # Slots <= q_pos + c are by construction inside the row's live prefix
+      # (owned pages); everything past is stale/foreign and masked.
+      k_dense = block_decode.GatherPages(k_pool, block_tables)
+      v_dense = block_decode.GatherPages(v_pool, block_tables)
+      slot = jnp.arange(t_pages * page_size)[None, None, None, :]
+      mask = jnp.where(slot <= pos_i[:, None, :, None], 0.0, _NEG_INF)
+      ctx, _ = self._Atten(theta, q, k_dense, v_dense, mask)
+    return self._PostProj(theta, ctx), new_states
+
 
 class LocalSelfAttention(MultiHeadedAttention):
   """Blocked sliding-window self-attention (ref

@@ -158,6 +158,17 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
         theta.atten, x, cached_states, paddings=cache_paddings, **kw)
     return query_vec + out, new_states
 
+  def InitPagedStates(self, theta, num_pages, page_size):
+    return self.atten.InitPagedStates(theta.atten, num_pages, page_size)
+
+  def PagedStep(self, theta, query_vec, cached_states, block_tables, q_pos,
+                in_len):
+    """Block-table continuous-batching step (see attention.PagedStep)."""
+    x = self.ln.FProp(theta.ln, query_vec)
+    out, new_states = self.atten.PagedStep(
+        theta.atten, x, cached_states, block_tables, q_pos, in_len)
+    return query_vec + out, new_states
+
 
 class TransformerLayer(base_layer.BaseLayer):
   """Self-atten (+ optional cross-atten) + FFN (ref `TransformerLayer:6265`)."""
@@ -238,6 +249,20 @@ class TransformerLayer(base_layer.BaseLayer):
     out = self.fflayer.FProp(theta.fflayer, x)
     return out, NestedMap(self_atten=new_sa)
 
+  def InitPagedStates(self, theta, num_pages, page_size):
+    assert not self.p.has_aux_atten, (
+        "continuous-batching serving is decoder-only (no cross-attention)")
+    return NestedMap(self_atten=self.self_atten.InitPagedStates(
+        theta.self_atten, num_pages, page_size))
+
+  def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
+                in_len):
+    x, new_sa = self.self_atten.PagedStep(
+        theta.self_atten, inputs, cached_states.self_atten, block_tables,
+        q_pos, in_len)
+    out = self.fflayer.FProp(theta.fflayer, x)
+    return out, NestedMap(self_atten=new_sa)
+
 
 class StackedTransformerLayers(base_layer.BaseLayer):
   """N distinct transformer layers (ref `StackedTransformerLayers:7116`)."""
@@ -301,6 +326,25 @@ class StackedTransformerLayers(base_layer.BaseLayer):
                                      cached_states.x_layers[i], aux_vecs,
                                      aux_paddings,
                                      cache_paddings=cache_paddings, **kw)
+      new_states.x_layers.append(ns)
+    if self.p.final_ln:
+      x = self.final_ln.FProp(theta.final_ln, x)
+    return x, new_states
+
+  def InitPagedStates(self, theta, num_pages, page_size):
+    return NestedMap(x_layers=[
+        l.InitPagedStates(theta.x_layers[i], num_pages, page_size)
+        for i, l in enumerate(self.x_layers)
+    ])
+
+  def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
+                in_len):
+    x = inputs
+    new_states = NestedMap(x_layers=[])
+    for i, layer in enumerate(self.x_layers):
+      x, ns = layer.PagedStep(theta.x_layers[i], x,
+                              cached_states.x_layers[i], block_tables, q_pos,
+                              in_len)
       new_states.x_layers.append(ns)
     if self.p.final_ln:
       x = self.final_ln.FProp(theta.final_ln, x)
@@ -411,6 +455,24 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
       x, new_states = getattr(self.body, method)(
           theta_i, carry, states_i, aux_vecs, aux_paddings,
           cache_paddings=cache_paddings, **kw)
+      return x, new_states
+
+    out, new_states = jax.lax.scan(_Body, inputs,
+                                   (theta.body, cached_states.body))
+    return out, NestedMap(body=new_states)
+
+  def InitPagedStates(self, theta, num_pages, page_size):
+    def _One(theta_i):
+      return self.body.InitPagedStates(theta_i, num_pages, page_size)
+
+    return NestedMap(body=jax.vmap(_One)(theta.body))
+
+  def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
+                in_len):
+    def _Body(carry, per_layer):
+      theta_i, states_i = per_layer
+      x, new_states = self.body.PagedStep(theta_i, carry, states_i,
+                                          block_tables, q_pos, in_len)
       return x, new_states
 
     out, new_states = jax.lax.scan(_Body, inputs,

@@ -334,6 +334,38 @@ class TransformerLm(base_model.BaseTask):
       logits = self.emb.Logits(theta.emb, x)
     return logits, new_states
 
+  def InitPagedDecodeState(self, theta, num_pages: int, page_size: int):
+    """Global KV page pool for the continuous-batching serving engine.
+
+    Unlike InitDecodeState there is no batch/max_len shape — capacity is
+    num_pages * page_size slots shared by however many sequences the
+    engine's block tables map into it (serving/engine.py owns the layout;
+    it passes allocator pages + 1 so the last page is the trash page)."""
+    return self.stack.InitPagedStates(theta.stack, num_pages, page_size)
+
+  def PagedStep(self, theta, ids, states, block_tables, q_pos, in_len):
+    """Continuous-batching step: ids [b, c] -> (logits [b, c, vocab],
+    states).
+
+    Row b's tokens land at its sequence's global slots
+    [q_pos[b], q_pos[b] + in_len[b]) through block_tables [b, t_pages];
+    c == 1 is a pure decode step, c > 1 a mixed prefill/decode step
+    (decode rows use in_len == 1, padding queries past in_len are
+    discarded by the engine). Same position policy as Prefill: rotary
+    positions are the global slot indices, no absolute pos_emb (serve
+    rotary models).
+    """
+    x = self.emb.EmbLookup(theta.emb, ids)
+    x, new_states = self.stack.PagedStep(theta.stack, x, states,
+                                         block_tables, q_pos, in_len)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    if self.p.softmax_num_sampled > 0:
+      logits = self.sampled_softmax.Logits(
+          self.ChildTheta(theta, "sampled_softmax"), x)
+    else:
+      logits = self.emb.Logits(theta.emb, x)
+    return logits, new_states
+
 
 class BertLm(TransformerLm):
   """Masked-LM pretraining task (ref `tasks/lm/params/wiki_bert.py` +

@@ -78,8 +78,14 @@ class GShardDecode:
     self._len_buckets = tuple(len_buckets)
     self._template = jax.eval_shape(
         self._task.CreateTrainState, jax.random.PRNGKey(init_seed))
-    # jitted (init_fn, decode_fn) per bucketed static (p_len, t_max)
+    # jitted (init_fn, prefill_fn, sample_fn) per bucketed static
+    # (p_len, t_max)
     self._decode_fns = {}
+    # per-call timing of the last DecodeOnce (also attached to every
+    # result rec under "telemetry"): prefill_s / decode_s / total_s /
+    # tokens_per_sec — the apples-to-apples numbers the serving-engine
+    # bench compares against
+    self._last_telemetry = None
 
   def _GetDecodeFn(self, p_len: int, t_max: int):
     """Builds (init_fn, decode_fn) for a static (p_len, t_max) pair."""
@@ -95,9 +101,15 @@ class GShardDecode:
     def _Init(theta, batch_size):
       return task.InitDecodeState(theta, batch_size, total)
 
-    def _Decode(theta, prompts, prompt_lens, key, states):
-      """prompts [B, P] RIGHT-ALIGNED (left-padded) -> continuations
-      [B, t_max].
+    def _CachePaddings(prompt_lens):
+      # slot s is pad for row i iff s < P - len_i
+      slot = jnp.arange(total)[None, :]
+      return (slot < (p_len - prompt_lens)[:, None]).astype(
+          jnp.float32)                                     # [B, total]
+
+    def _Prefill(theta, prompts, prompt_lens, states):
+      """prompts [B, P] RIGHT-ALIGNED (left-padded) -> (last_logits [B, V],
+      primed states).
 
       Variable-length support: each row's prompt occupies cache slots
       [P - len_i, P), so every row's last prompt token sits at slot P-1 and
@@ -106,11 +118,7 @@ class GShardDecode:
       Rotary attention depends only on relative positions, so global slot
       indices give the same numerics as an unpadded per-length batch.
       """
-      # slot s is pad for row i iff s < P - len_i
-      slot = jnp.arange(total)[None, :]
-      cache_paddings = (slot < (p_len - prompt_lens)[:, None]).astype(
-          jnp.float32)                                     # [B, total]
-
+      cache_paddings = _CachePaddings(prompt_lens)
       if legacy_prime:
         # teacher-force the prompt one token at a time (O(p_len) sequential
         # full-cache attention calls; the pre-fast-path behavior)
@@ -122,19 +130,22 @@ class GShardDecode:
 
         states, logits = jax.lax.scan(_Prime, states,
                                       prompts.swapaxes(0, 1))
-        last_logits = logits[-1]                           # [B, V]
-      else:
-        # chunked prefill: ceil(p_len / chunk) attention passes write the
-        # whole prompt's K/V and produce the last-position logits; each
-        # pass reads only the written cache prefix (live_len), not the
-        # max_len decode tail
-        chunk_logits = None
-        for start in range(0, p_len, chunk):
-          ids_c = prompts[:, start:start + chunk]
-          chunk_logits, states = task.Prefill(
-              theta, ids_c, states, cache_paddings=cache_paddings,
-              live_len=start + ids_c.shape[1])
-        last_logits = chunk_logits[:, -1, :]               # [B, V]
+        return logits[-1], states                          # [B, V]
+      # chunked prefill: ceil(p_len / chunk) attention passes write the
+      # whole prompt's K/V and produce the last-position logits; each
+      # pass reads only the written cache prefix (live_len), not the
+      # max_len decode tail
+      chunk_logits = None
+      for start in range(0, p_len, chunk):
+        ids_c = prompts[:, start:start + chunk]
+        chunk_logits, states = task.Prefill(
+            theta, ids_c, states, cache_paddings=cache_paddings,
+            live_len=start + ids_c.shape[1])
+      return chunk_logits[:, -1, :], states                # [B, V]
+
+    def _SampleLoop(theta, last_logits, prompt_lens, key, states):
+      """Greedy/temperature sampling scan -> continuations [B, t_max]."""
+      cache_paddings = _CachePaddings(prompt_lens)
 
       def _Sample(carry, key_t):
         states, logits = carry
@@ -151,12 +162,18 @@ class GShardDecode:
       _, out_ids = jax.lax.scan(_Sample, (states, last_logits), keys)
       return out_ids.swapaxes(0, 1)                        # [B, t_max]
 
-    # the KV cache is donated: the decode program reuses the init program's
-    # buffers in place instead of copying them through the jit boundary
-    # (XLA:CPU can't alias these buffers and warns, so donate off-cpu only)
-    donate = (4,) if jax.default_backend() != "cpu" else ()
+    # the KV cache is donated through BOTH jit boundaries: the prefill
+    # program reuses the init program's buffers in place and the sample
+    # program reuses the prefill program's, instead of copying at each
+    # boundary (XLA:CPU can't alias these buffers and warns, so donate
+    # off-cpu only). The prefill/sample split (vs the old fused _Decode)
+    # exists for per-phase telemetry: DecodeOnce times each program
+    # separately so prefill_s/decode_s in the result dict are real
+    # device-time measurements, not estimates.
+    on_cpu = jax.default_backend() == "cpu"
     fns = (jax.jit(_Init, static_argnums=(1,)),
-           jax.jit(_Decode, donate_argnums=donate))
+           jax.jit(_Prefill, donate_argnums=() if on_cpu else (3,)),
+           jax.jit(_SampleLoop, donate_argnums=() if on_cpu else (4,)))
     self._decode_fns[cache_key] = fns
     return fns
 
@@ -194,21 +211,44 @@ class GShardDecode:
     # only p_len varies across calls; max_steps is a constructor constant,
     # so bucketing it would just run extra discarded decode steps
     p_len = py_utils.RoundUpToBucket(prompts.shape[1], self._len_buckets)
-    init_fn, decode_fn = self._GetDecodeFn(p_len, self._max_steps)
+    init_fn, prefill_fn, sample_fn = self._GetDecodeFn(p_len, self._max_steps)
     aligned = self._RightAlign(prompts, prompt_lens, width=p_len)
     states = init_fn(state.theta, prompts.shape[0])
-    out = decode_fn(state.theta, jnp.asarray(aligned),
-                    jnp.asarray(prompt_lens), jax.random.PRNGKey(restored),
-                    states)
+    jax.block_until_ready(states)
+    lens_dev = jnp.asarray(prompt_lens)
+    # per-phase wall timing (block_until_ready fences async dispatch so
+    # each phase's time is its own, not its predecessor's flush)
+    t0 = time.perf_counter()
+    last_logits, states = prefill_fn(state.theta, jnp.asarray(aligned),
+                                     lens_dev, states)
+    jax.block_until_ready(last_logits)
+    t1 = time.perf_counter()
+    out = sample_fn(state.theta, last_logits, lens_dev,
+                    jax.random.PRNGKey(restored), states)
+    out = jax.block_until_ready(out)
+    t2 = time.perf_counter()
     self._last_step = restored
+    b = prompts.shape[0]
+    decode_s = t2 - t1
+    telemetry = {
+        "prefill_s": t1 - t0,
+        "decode_s": decode_s,
+        "total_s": t2 - t0,
+        "prompt_tokens": int(np.sum(prompt_lens)),
+        "decode_tokens": b * self._max_steps,
+        "tokens_per_sec": (b * self._max_steps / decode_s
+                           if decode_s > 0 else 0.0),
+    }
+    self._last_telemetry = telemetry
     results = []
     with open(self._output_path, "a") as f:
-      for i in range(prompts.shape[0]):
+      for i in range(b):
         rec = {
             "checkpoint_step": int(restored),
             "prompt_ids": [int(x) for x in
                            prompts[i, :int(prompt_lens[i])]],
             "output_ids": [int(x) for x in np.asarray(out[i])],
+            "telemetry": telemetry,
         }
         f.write(json.dumps(rec) + "\n")
         results.append(rec)

@@ -1,0 +1,97 @@
+"""KV block allocator: host-side ownership of the global page pool.
+
+The device side of paged serving is a dumb `[num_pages, page_size, N, H]`
+pool (attention.InitPagedStates); everything that makes it a cache — which
+pages belong to which sequence, which are free — lives here, in plain
+Python on the host, updated between device steps. That split keeps every
+compiled program shape-static: admitting or evicting a sequence only
+rewrites small int32 block tables, never reshapes device buffers.
+
+Allocation policy: a min-heap free list. Always handing out the
+lowest-numbered free page keeps the live set packed toward the low end of
+the pool — eviction "defragments" by construction (freed high pages sink
+to the back of the heap and are reused last), so a long-running server's
+working set stays dense without ever copying K/V between pages.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+
+class OutOfPages(Exception):
+  """Raised by Allocate when the pool cannot satisfy the request."""
+
+
+class PageAllocator:
+  """Owns [0, num_pages) of the device pool; sequences hold disjoint sets.
+
+  NOT thread-safe on its own — the serving engine serializes all calls
+  under its scheduler lock. The trash page the engine appends to the
+  device pool is outside [0, num_pages) and never managed here.
+  """
+
+  def __init__(self, num_pages: int, page_size: int):
+    assert num_pages > 0 and page_size > 0, (num_pages, page_size)
+    self.num_pages = num_pages
+    self.page_size = page_size
+    self._free = list(range(num_pages))  # already a valid min-heap
+    self._owned: dict[object, list[int]] = {}
+    self.peak_in_use = 0
+
+  # -- queries ---------------------------------------------------------------
+
+  @property
+  def num_free(self) -> int:
+    return len(self._free)
+
+  @property
+  def num_in_use(self) -> int:
+    return self.num_pages - len(self._free)
+
+  def PagesFor(self, num_tokens: int) -> int:
+    """Pages needed to hold num_tokens logical slots."""
+    return -(-num_tokens // self.page_size)
+
+  def CanAllocate(self, n: int) -> bool:
+    return n <= len(self._free)
+
+  def PagesOf(self, seq_id) -> list[int]:
+    """The sequence's pages in logical order (index i = logical page i)."""
+    return list(self._owned[seq_id])
+
+  def Stats(self) -> dict:
+    return {
+        "num_pages": self.num_pages,
+        "page_size": self.page_size,
+        "in_use": self.num_in_use,
+        "free": self.num_free,
+        "utilization": self.num_in_use / self.num_pages,
+        "peak_in_use": self.peak_in_use,
+        "num_sequences": len(self._owned),
+    }
+
+  # -- mutations -------------------------------------------------------------
+
+  def Allocate(self, seq_id, n: int) -> list[int]:
+    """Grants n MORE pages to seq_id (appended to its logical order).
+
+    All-or-nothing: raises OutOfPages without side effects if fewer than n
+    pages are free — the scheduler checks CanAllocate first and queues the
+    request instead of admitting it."""
+    if n > len(self._free):
+      raise OutOfPages(f"need {n} pages, {len(self._free)} free")
+    got = [heapq.heappop(self._free) for _ in range(n)]
+    self._owned.setdefault(seq_id, []).extend(got)
+    self.peak_in_use = max(self.peak_in_use, self.num_in_use)
+    return got
+
+  def Free(self, seq_id) -> int:
+    """Returns every page owned by seq_id to the pool; returns the count.
+
+    Idempotent: freeing an unknown/already-freed id is a no-op (eviction
+    and cancellation can race to the same sequence at a step boundary)."""
+    pages = self._owned.pop(seq_id, [])
+    for pg in pages:
+      heapq.heappush(self._free, pg)
+    return len(pages)

@@ -1,0 +1,271 @@
+"""Continuous-batching request scheduler.
+
+Owns the host-side serving state machine: a FIFO of waiting requests, a
+fixed array of B decode slots, and the page allocator. Each engine
+iteration is admit → build → (device step) → commit:
+
+- `Admit` moves queued requests into free slots while the allocator can
+  reserve their WHOLE worst-case footprint (ceil((prompt + max_new) /
+  page_size) pages) up front. Reserve-all-on-admission means an admitted
+  sequence can never run out of pages mid-flight, so there is no
+  preemption/swap machinery — pool pressure shows up only as queueing
+  (the allocator-exhaustion satellite: graceful, never a crash).
+- `BuildStep` flattens the live slots into one batch for the compiled
+  PagedStep program. Steady state is a pure decode step (chunk width
+  C == 1, every live row feeds its last sampled token). Whenever any slot
+  is still prefilling, the step widens to C == prefill_chunk and becomes a
+  MIXED step: prefilling rows consume up to C prompt tokens, decoding rows
+  ride along with in_len == 1 — decode is never stalled behind prefill,
+  which is the per-step prefill budget the ISSUE asks for.
+- `CommitStep` folds the device's sampled tokens back in: advances prompt
+  cursors, turns finished prefills into decoders (their first generated
+  token is the sample at the last valid chunk position), appends decode
+  tokens, retires sequences on max_new/EOS, and frees their slot + pages
+  immediately so `Admit` can refill the slot on the very next iteration.
+
+Sequences/requests are identified by the user-visible request id. The
+scheduler is deliberately device-free (pure Python + numpy) so its
+lifecycle is unit-testable with fabricated sample arrays.
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+from typing import Optional
+
+import numpy as np
+
+from lingvo_tpu.serving import kv_cache
+
+
+class SeqState(enum.Enum):
+  QUEUED = "queued"
+  PREFILL = "prefill"
+  DECODE = "decode"
+  FINISHED = "finished"
+  CANCELLED = "cancelled"
+
+
+class Request:
+  """One user request: prompt ids + generation budget."""
+
+  def __init__(self, req_id, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None):
+    prompt = [int(t) for t in prompt]
+    assert len(prompt) >= 1, "empty prompt"
+    assert max_new_tokens >= 1, max_new_tokens
+    self.id = req_id
+    self.prompt = prompt
+    self.max_new = int(max_new_tokens)
+    self.eos_id = eos_id
+
+
+class Sequence:
+  """A request's in-flight decode state (slot-resident)."""
+
+  def __init__(self, request: Request):
+    self.req = request
+    self.state = SeqState.QUEUED
+    self.pos = 0          # tokens WRITTEN to the KV cache so far
+    self.out = []         # generated tokens (out[-1] may not be cached yet)
+    self.finish_reason = None
+
+  @property
+  def id(self):
+    return self.req.id
+
+  @property
+  def prompt_remaining(self) -> int:
+    return len(self.req.prompt) - self.pos
+
+
+class StepBatch:
+  """One flattened device step (numpy; the engine jits over it)."""
+
+  def __init__(self, ids, q_pos, in_len, rows, mixed: bool,
+               prompt_tokens: int):
+    self.ids = ids          # [B, C] int32
+    self.q_pos = q_pos      # [B] int32
+    self.in_len = in_len    # [B] int32 (0 = inactive row)
+    self.rows = rows        # slot -> Sequence or None, frozen at build time
+    self.mixed = mixed      # True if any prefill row rode this step
+    self.prompt_tokens = prompt_tokens  # prompt tokens consumed this step
+
+
+class Scheduler:
+  """Admission + step building + commit over B slots and a page pool."""
+
+  def __init__(self, max_slots: int, allocator: kv_cache.PageAllocator,
+               table_pages: int, prefill_chunk: int):
+    """table_pages: block-table width (pages per sequence) — the static
+    max_seq_len / page_size bound every compiled program carries.
+    prefill_chunk: prompt tokens a prefilling row consumes per mixed step.
+    """
+    assert max_slots >= 1 and table_pages >= 1 and prefill_chunk >= 1
+    self.max_slots = max_slots
+    self.alloc = allocator
+    self.table_pages = table_pages
+    self.prefill_chunk = prefill_chunk
+    self.waiting = collections.deque()        # of Sequence (QUEUED)
+    self.slots: list[Optional[Sequence]] = [None] * max_slots
+    self._by_id: dict[object, Sequence] = {}
+    # block tables as one stable [B, table_pages] array, rewritten on
+    # admit/evict only (steady-state decode steps reuse it as-is)
+    self.block_tables = np.zeros((max_slots, table_pages), np.int32)
+    # counters surfaced via engine Stats()
+    self.admitted = 0
+    self.finished = 0
+    self.cancelled = 0
+    self.rejected_overlong = 0
+
+  # -- submission ------------------------------------------------------------
+
+  def Submit(self, request: Request) -> Sequence:
+    total = len(request.prompt) + request.max_new
+    if self.alloc.PagesFor(total) > self.table_pages:
+      self.rejected_overlong += 1
+      raise ValueError(
+          f"request {request.id!r} needs {self.alloc.PagesFor(total)} pages "
+          f"(prompt {len(request.prompt)} + max_new {request.max_new}) but "
+          f"block tables hold {self.table_pages}")
+    seq = Sequence(request)
+    self._by_id[request.id] = seq
+    self.waiting.append(seq)
+    return seq
+
+  def Cancel(self, req_id) -> bool:
+    """Marks a request cancelled; resources return at the next boundary."""
+    seq = self._by_id.get(req_id)
+    if seq is None or seq.state in (SeqState.FINISHED, SeqState.CANCELLED):
+      return False
+    if seq.state is SeqState.QUEUED:
+      try:
+        self.waiting.remove(seq)
+      except ValueError:
+        pass
+      self._Retire(seq, SeqState.CANCELLED, "cancelled")
+      self.cancelled += 1
+      return True
+    seq.state = SeqState.CANCELLED   # slot/pages reclaimed by EvictCancelled
+    seq.finish_reason = "cancelled"
+    return True
+
+  # -- boundary phases -------------------------------------------------------
+
+  def EvictCancelled(self) -> list:
+    """Frees slots/pages of mid-flight cancellations. Call before Admit."""
+    evicted = []
+    for i, seq in enumerate(self.slots):
+      if seq is not None and seq.state is SeqState.CANCELLED:
+        self.slots[i] = None
+        self.alloc.Free(seq.id)
+        self.cancelled += 1
+        evicted.append(seq)
+    return evicted
+
+  def Admit(self) -> list:
+    """FIFO-admits waiting requests into free slots while pages last.
+
+    Head-of-line blocking on the pool is intentional: skipping a big
+    request to admit a small one behind it would starve the big one."""
+    admitted = []
+    for i in range(self.max_slots):
+      if self.slots[i] is not None or not self.waiting:
+        continue
+      seq = self.waiting[0]
+      need = self.alloc.PagesFor(len(seq.req.prompt) + seq.req.max_new)
+      if not self.alloc.CanAllocate(need):
+        break
+      self.waiting.popleft()
+      pages = self.alloc.Allocate(seq.id, need)
+      self.slots[i] = seq
+      seq.state = SeqState.PREFILL
+      self.block_tables[i, :] = 0
+      self.block_tables[i, :len(pages)] = pages
+      self.admitted += 1
+      admitted.append(seq)
+    return admitted
+
+  def HasWork(self) -> bool:
+    return any(s is not None for s in self.slots) or bool(self.waiting)
+
+  def BuildStep(self) -> Optional[StepBatch]:
+    """Flattens live slots into one [B, C] device step (None if idle)."""
+    rows = list(self.slots)
+    if not any(s is not None for s in rows):
+      return None
+    mixed = any(s is not None and s.state is SeqState.PREFILL for s in rows)
+    c = self.prefill_chunk if mixed else 1
+    b = self.max_slots
+    ids = np.zeros((b, c), np.int32)
+    q_pos = np.zeros((b,), np.int32)
+    in_len = np.zeros((b,), np.int32)
+    prompt_tokens = 0
+    for i, seq in enumerate(rows):
+      if seq is None:
+        continue
+      q_pos[i] = seq.pos
+      if seq.state is SeqState.PREFILL:
+        n = min(c, seq.prompt_remaining)
+        ids[i, :n] = seq.req.prompt[seq.pos:seq.pos + n]
+        in_len[i] = n
+        prompt_tokens += n
+      else:  # DECODE: feed the last sampled token (writes it to the cache)
+        ids[i, 0] = seq.out[-1]
+        in_len[i] = 1
+    return StepBatch(ids, q_pos, in_len, rows, mixed, prompt_tokens)
+
+  def CommitStep(self, batch: StepBatch, sampled: np.ndarray) -> list:
+    """Folds sampled [B, C] back into the state machine.
+
+    Returns [(request_id, token or None, finished: bool)] events in slot
+    order — one event per live row that produced a token or finished."""
+    events = []
+    for i, seq in enumerate(batch.rows):
+      if seq is None or seq.state is SeqState.CANCELLED:
+        continue   # cancelled mid-step: drop the token, evict at boundary
+      if seq.state is SeqState.PREFILL:
+        n = int(batch.in_len[i])
+        seq.pos += n
+        if seq.prompt_remaining > 0:
+          continue                       # more prompt chunks to go
+        tok = int(sampled[i, n - 1])     # sample after the LAST prompt token
+        seq.state = SeqState.DECODE
+      elif seq.state is SeqState.DECODE:
+        seq.pos += 1                     # the fed-back token is now cached
+        tok = int(sampled[i, 0])
+      else:
+        continue
+      seq.out.append(tok)
+      done_eos = (seq.req.eos_id is not None and tok == seq.req.eos_id)
+      done_len = len(seq.out) >= seq.req.max_new
+      if done_eos or done_len:
+        self.slots[i] = None
+        self.alloc.Free(seq.id)
+        self.finished += 1
+        self._Retire(seq, SeqState.FINISHED, "eos" if done_eos else "length")
+        events.append((seq.id, tok, True))
+      else:
+        events.append((seq.id, tok, False))
+    return events
+
+  def _Retire(self, seq: Sequence, state: SeqState, reason: str):
+    seq.state = state
+    seq.finish_reason = reason
+    self.alloc.Free(seq.id)   # idempotent
+
+  # -- introspection ---------------------------------------------------------
+
+  def Stats(self) -> dict:
+    live = [s for s in self.slots if s is not None]
+    return {
+        "slots": self.max_slots,
+        "slots_live": len(live),
+        "slots_prefill": sum(s.state is SeqState.PREFILL for s in live),
+        "queue_depth": len(self.waiting),
+        "admitted": self.admitted,
+        "finished": self.finished,
+        "cancelled": self.cancelled,
+        "rejected_overlong": self.rejected_overlong,
+    }
