@@ -950,6 +950,93 @@ def _BenchMoE(jax, jnp, model_registry, on_tpu, peak):
   }
 
 
+def _BenchMoEDispatchCompareInner(jax, jnp):
+  """einsum vs shard_map MoE dispatch on an 8-device {data,expert,model}
+  mesh: per-variant step time (fwd+bwd) plus the attribution parser's
+  executed-collectives/step and ICI MB/device/step off the compiled HLO.
+  Runs in the BENCH_ONLY=moe_dispatch subprocess (the parent bench process
+  pins a single CPU device; the mesh needs 8)."""
+  from lingvo_tpu.parallel import gshard, mesh as mesh_lib
+  from tools import collective_attribution
+
+  assert len(jax.devices()) >= 8, len(jax.devices())
+  mesh = mesh_lib.MakeMesh({"data": 2, "expert": 2, "model": 2},
+                           devices=jax.devices()[:8])
+  b, t, d = 16, 64, 32
+
+  def _Variant(dispatch_method):
+    layer = gshard.MoEFeedForwardLayer.Params().Set(
+        name="moe", input_dim=d, hidden_dim=2 * d, num_experts=8,
+        num_groups=4, dispatch_method=dispatch_method).Instantiate()
+    theta = layer.InstantiateVariables(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, t, d))
+    with mesh_lib.MeshContext(mesh):
+      theta = jax.device_put(theta,
+                             mesh_lib.ThetaShardings(mesh, layer, theta))
+      x = jax.device_put(
+          x, jax.sharding.NamedSharding(mesh,
+                                        jax.sharding.PartitionSpec("data")))
+
+      def loss(th, x):
+        return jnp.mean(jnp.square(layer.FProp(th, x)))
+
+      fn = jax.jit(jax.value_and_grad(loss))
+      hlo = fn.lower(theta, x).compile().as_text()
+      for _ in range(3):  # warmup / compile
+        val, _ = fn(theta, x)
+      float(val)
+      reps = 20
+      t0 = time.perf_counter()
+      for _ in range(reps):
+        val, grad = fn(theta, x)
+      jax.block_until_ready((val, grad))
+      step_s = (time.perf_counter() - t0) / reps
+    attr = collective_attribution.Analyze(hlo)
+    return {
+        "step_time_ms": round(step_s * 1e3, 3),
+        "executed_per_step": attr["executed_per_step"],
+        # partitioned-module shapes are per-device: bytes/step is the
+        # per-device ICI payload
+        "mb_per_device_per_step": {
+            k: round(v / 1e6, 3)
+            for k, v in attr["bytes_per_step"].items()},
+    }
+
+  out = {
+      "mesh": {"data": 2, "expert": 2, "model": 2},
+      "shape": {"batch": b, "seq": t, "dim": d, "experts": 8, "groups": 4},
+      "einsum": _Variant("einsum"),
+      "shard_map": _Variant("auto"),
+  }
+  sm, es = out["shard_map"], out["einsum"]
+  out["shard_map_vs_einsum_time"] = round(
+      sm["step_time_ms"] / max(es["step_time_ms"], 1e-9), 3)
+  out["permutes_removed_per_step"] = (
+      es["executed_per_step"].get("collective-permute", 0)
+      - sm["executed_per_step"].get("collective-permute", 0))
+  return out
+
+
+def _BenchMoEDispatchCompare():
+  """Parent-side wrapper: spawn the 8-virtual-device subprocess and collect
+  its one JSON line."""
+  env = dict(os.environ)
+  env["BENCH_ONLY"] = "moe_dispatch"
+  env["JAX_PLATFORMS"] = "cpu"
+  flags = env.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  env.pop("PYTHONPATH", None)
+  proc = subprocess.run(
+      [sys.executable, os.path.abspath(__file__)], env=env,
+      capture_output=True, text=True, timeout=1200)
+  if proc.returncode != 0:
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    return {"error": f"subprocess rc={proc.returncode}: {tail}"}
+  return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def _BenchDense(jax, jnp, model_registry, on_tpu, peak):
   """Flagship dense-LM train step. Runs in its own frame so the ~671M-param
   f32 train state is garbage the moment it returns — round 2's official MoE
@@ -1068,6 +1155,11 @@ def main():
   on_tpu = dev.platform != "cpu"
   peak = _PeakFlops(dev)
 
+  if os.environ.get("BENCH_ONLY") == "moe_dispatch":
+    # Subprocess mode for the dispatch comparison (needs the 8-device mesh).
+    print(json.dumps(_BenchMoEDispatchCompareInner(jax, jnp)))
+    return
+
   if os.environ.get("BENCH_ONLY") == "moe":
     # Sweep mode (tools/moe_sweep.py): just the MoE sub-bench, one JSON line.
     moe = _BenchMoE(jax, jnp, model_registry, on_tpu, peak)
@@ -1097,6 +1189,7 @@ def main():
       ("input_pipeline",
        lambda: _BenchInputPipeline(jax, jnp, model_registry, on_tpu)),
       ("moe", lambda: _BenchMoE(jax, jnp, model_registry, on_tpu, peak)),
+      ("moe_dispatch", _BenchMoEDispatchCompare),
       ("ring_attention", lambda: _BenchRingAttention(jax, jnp, on_tpu)),
       ("embedding", lambda: _BenchEmbedding(jax, jnp, on_tpu)),
   ]

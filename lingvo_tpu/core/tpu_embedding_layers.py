@@ -96,7 +96,7 @@ class ShardedEmbeddingTable(base_layer.BaseLayer):
     axis = self.p.shard_axis
     rows = self.p.vocab_size // n_shard
     from jax.sharding import PartitionSpec as P
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = mesh_lib.CurrentMesh()
 
     def _Local(tbl_l, ids_r):
       lo = jax.lax.axis_index(axis) * rows
@@ -106,7 +106,7 @@ class ShardedEmbeddingTable(base_layer.BaseLayer):
       emb = emb * valid[..., None].astype(emb.dtype)
       return jax.lax.psum(emb, axis)
 
-    return jax.shard_map(
+    return mesh_lib.ShardMap(
         _Local, mesh=mesh, in_specs=(P(axis, None), P()),
         out_specs=P())(table, ids)
 

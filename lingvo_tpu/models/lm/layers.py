@@ -75,6 +75,10 @@ class TransformerLm(base_model.BaseTask):
     p.Define("moe_dispatch_method", "auto",
              "MoE dispatch formulation: 'auto' | 'indexed' | 'einsum' "
              "(see gshard.MoEFeedForwardLayer).")
+    p.Define("moe_dispatch_via_shard_map", None,
+             "None = auto (explicit shard_map all_to_all whenever an "
+             "'expert' mesh axis exists); True/False forces the path "
+             "(see gshard.MoEFeedForwardLayer.dispatch_via_shard_map).")
     return p
 
   def __init__(self, params):
@@ -121,6 +125,7 @@ class TransformerLm(base_model.BaseTask):
           second_expert_policy=p.moe_second_expert_policy,
           gating_policy=p.moe_gating_policy,
           dispatch_method=p.moe_dispatch_method,
+          dispatch_via_shard_map=p.moe_dispatch_via_shard_map,
           residual_dropout_prob=p.residual_dropout_prob)
       block = gshard.DenseMoEBlock.Params().Set(
           input_dim=p.model_dim, num_heads=p.num_heads,

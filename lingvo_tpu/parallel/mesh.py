@@ -243,6 +243,52 @@ def MeshContext(mesh: Mesh):
   return mesh
 
 
+def CurrentMesh():
+  """The ambient mesh entered by MeshContext, or None.
+
+  Version-tolerant (the whole point — PR-7's shard_map MoE dispatch silently
+  deactivated on jax 0.4.x because only the abstract-mesh API was queried):
+  jax >= 0.6 exposes the ambient mesh as `jax.sharding.get_abstract_mesh()`;
+  on 0.4.x the Mesh context manager populates the pjit resource env
+  (`thread_resources.env.physical_mesh`) instead. Returns whichever is
+  active and non-empty.
+  """
+  try:
+    from jax.sharding import get_abstract_mesh
+    m = get_abstract_mesh()
+    if m is not None and tuple(m.axis_names):
+      return m
+  except Exception:
+    pass
+  try:  # jax 0.4.x: the physical mesh entered by MeshContext
+    from jax._src import mesh as _mesh_impl
+    m = _mesh_impl.thread_resources.env.physical_mesh
+    if m is not None and not m.empty:
+      return m
+  except Exception:
+    pass
+  return None
+
+
+def ShardMap(fn, mesh=None, *, in_specs, out_specs, check_vma=None):
+  """Version-tolerant `shard_map` (jax >= 0.8 `jax.shard_map` with
+  `check_vma`; 0.4.x `jax.experimental.shard_map.shard_map` where the same
+  knob is called `check_rep`). mesh=None resolves the ambient mesh — raises
+  when there is none, since shard_map without a mesh cannot mean anything.
+  """
+  if mesh is None:
+    mesh = CurrentMesh()
+    assert mesh is not None, "ShardMap outside a MeshContext"
+  try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+    kw = {} if check_vma is None else {"check_vma": check_vma}
+  except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+  return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    **kw)
+
+
 def WithShardingConstraint(x, spec_or_names):
   """MeshSplit equivalent (ref gshard_utils.MeshSplit): annotate inside jit.
 
@@ -255,18 +301,10 @@ def WithShardingConstraint(x, spec_or_names):
     spec = spec_or_names
   else:
     spec = SpecFromSplitDims(spec_or_names)
-  try:
-    from jax.sharding import get_abstract_mesh
-    mesh_axes = tuple(get_abstract_mesh().axis_names)
-  except Exception:
-    try:  # jax 0.4.x: the physical mesh entered by MeshContext
-      from jax._src import mesh as _mesh_impl
-      mesh_axes = tuple(
-          _mesh_impl.thread_resources.env.physical_mesh.axis_names)
-    except Exception:
-      mesh_axes = ()
-  if not mesh_axes:
+  mesh = CurrentMesh()
+  if mesh is None:
     return x
+  mesh_axes = tuple(mesh.axis_names)
   filtered = []
   for entry in spec:
     names = entry if isinstance(entry, tuple) else (
@@ -279,11 +317,7 @@ def WithShardingConstraint(x, spec_or_names):
 
 def CurrentMeshAxisSize(name: str):
   """Size of axis `name` in the ambient mesh, or None if no such axis."""
-  try:
-    from jax.sharding import get_abstract_mesh
-    m = get_abstract_mesh()
-    if m is None or name not in tuple(m.axis_names):
-      return None
-    return int(m.shape[name])
-  except Exception:
+  m = CurrentMesh()
+  if m is None or name not in tuple(m.axis_names):
     return None
+  return int(m.shape[name])

@@ -190,7 +190,7 @@ def _RingCoreFwd(q, k, v, mesh, seq_axis, causal, block_q, block_k):
 
   spec = PartitionSpec(None, seq_axis, None, None)
   lse_spec = PartitionSpec(None, None, seq_axis)
-  out, lse = jax.shard_map(
+  out, lse = mesh_lib.ShardMap(
       _Local, mesh=mesh, in_specs=(spec, spec, spec),
       out_specs=(spec, lse_spec), check_vma=False)(q, k, v)
   return out, (q, k, v, out, lse)
@@ -211,7 +211,7 @@ def _RingCoreBwd(mesh, seq_axis, causal, block_q, block_k, res, g):
 
   spec = PartitionSpec(None, seq_axis, None, None)
   lse_spec = PartitionSpec(None, None, seq_axis)
-  return jax.shard_map(
+  return mesh_lib.ShardMap(
       _Local, mesh=mesh, in_specs=(spec, spec, spec, spec, spec, lse_spec),
       out_specs=(spec, spec, spec), check_vma=False)(q, k, v, g, out, lse)
 

@@ -60,5 +60,5 @@ def UlyssesAttention(q, k, v, *, mesh: Mesh,
   spec = PartitionSpec(None, seq_axis, None, None)
   # check_vma off: the pallas flash kernel doesn't declare varying-across-
   # mesh axes (same setting as ring_attention's shard_maps)
-  return jax.shard_map(_Local, mesh=mesh, in_specs=(spec, spec, spec),
+  return mesh_lib.ShardMap(_Local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)(q, k, v)

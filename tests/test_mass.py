@@ -8,6 +8,7 @@ the tiny WMT fixture. Ref `lingvo/core/ops/mass_op.cc:1`,
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from lingvo_tpu import model_registry
 import lingvo_tpu.models.all_params  # noqa: F401
@@ -84,6 +85,7 @@ class TestMassPretraining:
     assert np.mean(losses[-10:]) < 0.75 * np.mean(losses[:10]), (
         losses[:10], losses[-10:])
 
+  @pytest.mark.slow
   def test_finetune_beats_cold_start(self):
     """Pretrain MASS, warm-start the domain-matched MT task (strided
     sources, the distribution the pretraining saw — as real MASS pairs

@@ -97,6 +97,54 @@ class TestJointGroupSharding:
     assert _CollectiveDefs(hlo).get("all-to-all", 0) >= 2
     assert np.isfinite(val)
 
+  def test_fwd_only_hlo_has_all_to_all(self):
+    # the forward program alone (no value_and_grad) must already carry the
+    # dispatch + combine all-to-all pair
+    mesh = mesh_lib.MakeMesh({"data": 2, "expert": 2, "model": 2},
+                             devices=jax.devices()[:8])
+    layer, theta = _MoeLayer()
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 16))
+    with mesh_lib.MeshContext(mesh):
+      theta = jax.device_put(theta,
+                             mesh_lib.ThetaShardings(mesh, layer, theta))
+      x = jax.device_put(
+          x, jax.sharding.NamedSharding(mesh,
+                                        jax.sharding.PartitionSpec("data")))
+      fwd = jax.jit(lambda th, x: layer.FProp(th, x))
+      hlo = fwd.lower(theta, x).compile().as_text()
+    counts = _CollectiveDefs(hlo)
+    assert counts.get("all-to-all", 0) >= 2, counts
+    assert counts.get("collective-permute", 0) <= 2, counts
+
+  def test_shard_map_matches_einsum_dispatch(self):
+    # same theta through both lowerings on the same mesh: the explicit
+    # shard_map all-to-all must agree with the GSPMD-inferred einsum path
+    # in BOTH the forward value and the gradients
+    mesh = mesh_lib.MakeMesh({"data": 2, "expert": 2, "model": 2},
+                             devices=jax.devices()[:8])
+    sm_layer, theta = _MoeLayer(num_groups=4)
+    es_layer, _ = _MoeLayer(num_groups=4, dispatch_method="einsum")
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 16))
+    with mesh_lib.MeshContext(mesh):
+      theta = jax.device_put(theta,
+                             mesh_lib.ThetaShardings(mesh, sm_layer, theta))
+      x = jax.device_put(
+          x, jax.sharding.NamedSharding(mesh,
+                                        jax.sharding.PartitionSpec("data")))
+
+      def mk_loss(layer):
+        return lambda th, x: jnp.mean(jnp.square(layer.FProp(th, x)))
+
+      sm_val, sm_grad = jax.jit(jax.value_and_grad(mk_loss(sm_layer)))(
+          theta, x)
+      es_val, es_grad = jax.jit(jax.value_and_grad(mk_loss(es_layer)))(
+          theta, x)
+    np.testing.assert_allclose(float(sm_val), float(es_val), rtol=1e-5)
+    for sm_l, es_l in zip(jax.tree_util.tree_leaves(sm_grad),
+                          jax.tree_util.tree_leaves(es_grad)):
+      np.testing.assert_allclose(np.asarray(sm_l), np.asarray(es_l),
+                                 rtol=2e-5, atol=2e-5)
+
   def test_named_remat_boundaries_present(self):
     # the checkpoint_name tags must survive tracing so the 'dots' remat
     # policy can pin them (transformer.RepeatedTransformerLayer)
@@ -109,6 +157,86 @@ class TestJointGroupSharding:
     names = re.findall(r"name=(\w+)", str(jaxpr))
     assert "moe_dispatched" in names, names
     assert "moe_combined" in names, names
+
+
+class TestNumGroupsAutoDerivation:
+  """num_groups auto-derivation (0 = derive from the ambient mesh)."""
+
+  def test_no_mesh_defaults_to_batch_capped(self):
+    layer, _ = _MoeLayer()
+    assert layer._NumGroups(4, 16) == 4   # min(b, 8)
+    assert layer._NumGroups(16, 4) == 8   # capped at 8
+    assert layer._NumGroups(3, 5) == 3
+
+  def test_mesh_product_data_times_expert(self):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs the 8-device CPU mesh")
+    layer, _ = _MoeLayer()
+    with mesh_lib.MeshContext(
+        mesh_lib.MakeMesh({"data": 4, "expert": 2},
+                          devices=jax.devices()[:8])):
+      assert layer._NumGroups(8, 8) == 8
+      assert layer._GroupAxes() == ("data", "expert")
+    with mesh_lib.MeshContext(
+        mesh_lib.MakeMesh({"expert": 8}, devices=jax.devices()[:8])):
+      assert layer._NumGroups(4, 16) == 8
+      assert layer._GroupAxes() == ("expert",)
+
+  def test_clamps_to_token_divisor(self):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs the 8-device CPU mesh")
+    layer, _ = _MoeLayer()
+    with mesh_lib.MeshContext(
+        mesh_lib.MakeMesh({"expert": 8}, devices=jax.devices()[:8])):
+      # b*t=6 < mesh product 8: largest divisor of 6 not above 8 is 6
+      assert layer._NumGroups(3, 2) == 6
+
+  def test_explicit_non_divisor_fails_loudly(self):
+    layer, _ = _MoeLayer(num_groups=5)
+    with pytest.raises(AssertionError):
+      layer._NumGroups(4, 16)
+
+
+@pytest.mark.slow
+class TestMoEDispatchSoak:
+  """Multi-device soak: bigger shapes, several steps, both dispatch paths."""
+
+  def test_multi_step_parity_at_scale(self):
+    if len(jax.devices()) < 8:
+      pytest.skip("needs the 8-device CPU mesh")
+    mesh = mesh_lib.MakeMesh({"data": 2, "expert": 2, "model": 2},
+                             devices=jax.devices()[:8])
+    sm_layer, theta = _MoeLayer(num_experts=8, num_groups=8)
+    es_layer, _ = _MoeLayer(num_experts=8, num_groups=8,
+                            dispatch_method="einsum")
+    with mesh_lib.MeshContext(mesh):
+      theta = jax.device_put(theta,
+                             mesh_lib.ThetaShardings(mesh, sm_layer, theta))
+
+      def mk_step(layer):
+        def loss(th, x):
+          return jnp.mean(jnp.square(layer.FProp(th, x)))
+        grad_fn = jax.jit(jax.value_and_grad(loss))
+        def step(th, x):
+          val, g = grad_fn(th, x)
+          th = jax.tree_util.tree_map(lambda w, gw: w - 1e-2 * gw, th, g)
+          return th, float(val)
+        return step
+
+      sm_step, es_step = mk_step(sm_layer), mk_step(es_layer)
+      sm_th = es_th = theta
+      for i in range(4):
+        x = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(10 + i), (16, 32, 16)),
+            jax.sharding.NamedSharding(mesh,
+                                       jax.sharding.PartitionSpec("data")))
+        sm_th, sm_val = sm_step(sm_th, x)
+        es_th, es_val = es_step(es_th, x)
+        np.testing.assert_allclose(sm_val, es_val, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(sm_th),
+                    jax.tree_util.tree_leaves(es_th)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 rtol=1e-4, atol=1e-4)
 
 
 class TestNonDivisibleFallback:

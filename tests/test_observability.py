@@ -76,6 +76,7 @@ class TestProgramObservability:
     assert "loss" in result
     assert glob.glob(str(tmp_path / "train" / "events.out.tfevents.*"))
 
+  @pytest.mark.slow
   def test_profiler_capture(self, tmp_path):
     self._run(tmp_path, profiler_capture_every_n_runs=1)
     # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (+ .xplane.pb)

@@ -379,6 +379,7 @@ class TestUlyssesAttention:
           np.asarray(out), np.asarray(self._Ref(q, k, v, causal)),
           atol=2e-5)
 
+  @pytest.mark.slow
   def test_gradients_match_full_attention(self):
     _RequireDevices(8)
     from lingvo_tpu.parallel import ulysses
@@ -447,6 +448,7 @@ class TestRingAttention:
     np.testing.assert_allclose(
         np.asarray(out_ring), np.asarray(out_ref), atol=2e-5)
 
+  @pytest.mark.slow
   def test_gradients_match_full_attention(self):
     # The whole ring is one custom_vjp (second ring pass rotating dK/dV
     # with their blocks); gradients must match plain attention.
@@ -477,6 +479,7 @@ class TestRingAttention:
       np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=3e-5,
                                  err_msg=nm)
 
+  @pytest.mark.slow
   def test_single_device_decomposition_matches(self):
     # the bench's sp-simulation path is the same math as full attention
     import math

@@ -73,7 +73,7 @@ class TestStackedRecurrent:
 class TestSendRecv:
 
   def test_shift_moves_shard_data(self):
-    from jax import shard_map
+    from lingvo_tpu.parallel.mesh import ShardMap as shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     devs = jax.devices()[:4]
     mesh = Mesh(np.array(devs), ("x",))
@@ -91,7 +91,7 @@ class TestSendRecv:
     np.testing.assert_allclose(np.asarray(wrapped), [3.0, 0.0, 1.0, 2.0])
 
   def test_explicit_pairs(self):
-    from jax import shard_map
+    from lingvo_tpu.parallel.mesh import ShardMap as shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     devs = jax.devices()[:4]
     mesh = Mesh(np.array(devs), ("x",))

@@ -10,6 +10,7 @@ import math
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from lingvo_tpu import model_registry
 import lingvo_tpu.models.all_params  # noqa: F401
@@ -159,6 +160,7 @@ class TestDeepFusion:
             "points": pts, "labels": labels,
             "camera": cam.reshape(-1).round(2).tolist()}) + "\n")
 
+  @pytest.mark.slow
   def test_fusion_trains_and_uses_camera(self, tmp_path):
     path = tmp_path / "frames.jsonl"
     self._frames_with_camera(path)

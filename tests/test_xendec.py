@@ -5,6 +5,7 @@ loss wiring, and end-to-end training on the tiny WMT fixture."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from lingvo_tpu import model_registry
 import lingvo_tpu.models.all_params  # noqa: F401
@@ -58,6 +59,7 @@ class TestXEnDec:
     assert np.allclose(total[both_pad], 0.0)
     assert np.allclose(total[~both_pad], 1.0, atol=1e-5)
 
+  @pytest.mark.slow
   def test_loss_has_clean_and_mix_terms(self):
     task, gen = _build("mt.wmt14_en_de.WmtEnDeXEnDecTiny")
     state = task.CreateTrainState(jax.random.PRNGKey(0))
@@ -84,6 +86,7 @@ class TestXEnDec:
     assert np.mean(losses[-10:]) < 0.85 * np.mean(losses[:10]), (
         losses[0], losses[-1])
 
+  @pytest.mark.slow
   def test_eval_path_is_plain_transformer(self):
     from lingvo_tpu.core import py_utils
     task, gen = _build("mt.wmt14_en_de.WmtEnDeXEnDecTiny")

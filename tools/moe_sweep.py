@@ -8,7 +8,12 @@ separates "small-geometry MFU ceiling" from "MoE machinery overhead".
 
 Usage: python tools/moe_sweep.py [variant ...]
 Variants: dense_twin moe_b8 moe_b16 moe_b32 sinkhorn hash groups16 cap125
-          einsum noflash
+          einsum noflash experts8 experts16 experts32 experts64
+
+The experts* ladder confirms the MoE scaling contract: total params grow
+~linearly with the expert count while ACTIVE params/token (dense + top_k/E
+of the expert weights) stay near-flat — so step time should too. On a CPU
+host the geometry shrinks automatically so the ladder still runs.
 """
 
 from __future__ import annotations
@@ -28,21 +33,37 @@ def _Build(jax, jnp, model_registry, **kw):
   mp = model_registry.GetParams("lm.synthetic_packed_input.MoELmTiny",
                                 "Train")
   mp.task.input = mp.input
-  mp.task.model_dim = 1024
-  mp.task.hidden_dim = 4096
-  mp.task.moe_hidden_dim = 2048
-  mp.task.num_heads = 16
-  mp.task.num_layers = 6
-  mp.task.num_experts = 64
-  mp.task.moe_num_groups = 8
-  mp.task.vocab_size = 32768
-  mp.task.input.vocab_size = 32768
-  mp.task.input.seq_len = 1024
-  mp.task.input.batch_size = 8
+  on_cpu = jax.devices()[0].platform == "cpu"
+  if on_cpu:
+    # CPU host: shrink to a geometry that steps in seconds so the expert
+    # ladder / variant comparisons remain runnable without a TPU window
+    mp.task.model_dim = 64
+    mp.task.hidden_dim = 128
+    mp.task.moe_hidden_dim = 128
+    mp.task.num_heads = 4
+    mp.task.num_layers = 2
+    mp.task.num_experts = 64
+    mp.task.moe_num_groups = 8
+    mp.task.vocab_size = 512
+    mp.task.input.vocab_size = 512
+    mp.task.input.seq_len = 64
+    mp.task.input.batch_size = 4
+  else:
+    mp.task.model_dim = 1024
+    mp.task.hidden_dim = 4096
+    mp.task.moe_hidden_dim = 2048
+    mp.task.num_heads = 16
+    mp.task.num_layers = 6
+    mp.task.num_experts = 64
+    mp.task.moe_num_groups = 8
+    mp.task.vocab_size = 32768
+    mp.task.input.vocab_size = 32768
+    mp.task.input.seq_len = 1024
+    mp.task.input.batch_size = 8
   mp.task.remat_policy = "dots"
   from lingvo_tpu.core import attention as attention_lib
   mp.task.atten_tpl = attention_lib.MultiHeadedAttention.Params().Set(
-      use_flash_attention=True)
+      use_flash_attention=not on_cpu)
   mp.task.fprop_dtype = jnp.bfloat16
   for k, v in kw.items():
     if k == "batch_size":
@@ -186,7 +207,8 @@ def _Time(jax, jnp, mp, peak):
     top_k = float(getattr(mp.task, "moe_capacity_factor", 2.0))
   else:
     top_k = 2.0
-  active = (n_params - expert_params) + expert_params * top_k / 64
+  active = (n_params - expert_params) + \
+      expert_params * top_k / max(mp.task.num_experts, 1)
   if mp.task.num_experts == 0:
     active = n_params
   b, t = batch.ids.shape
@@ -219,6 +241,11 @@ VARIANTS = {
     "nomom_b16": dict(beta1=0.0, batch_size=16),
     "nomom_b24": dict(beta1=0.0, batch_size=24),
     "moe_b24": dict(batch_size=24),
+    # expert-count ladder: total params scale ~E, active params ~flat
+    "experts8": dict(num_experts=8),
+    "experts16": dict(num_experts=16),
+    "experts32": dict(num_experts=32),
+    "experts64": dict(),
 }
 
 
@@ -228,7 +255,8 @@ VARIANTS = {
 # immediately).
 AUTO_SWEEP = ("moe_b8", "dense_twin", "moe_b16", "groups16", "groups32",
               "cap125", "expert_choice", "hash", "einsum", "micro",
-              "phases:moe_b8", "moe_b32", "sinkhorn", "noflash")
+              "phases:moe_b8", "moe_b32", "sinkhorn", "noflash",
+              "experts8", "experts16", "experts32")
 
 
 def RunSweep(names=AUTO_SWEEP, budget_s: float = 1500.0,
