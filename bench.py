@@ -950,6 +950,51 @@ def _BenchMoE(jax, jnp, model_registry, on_tpu, peak):
   }
 
 
+def _BenchMixers(jax, jnp, model_registry, on_tpu):
+  """Sequence-mixer family (docs/sequence_mixers.md): plain attention vs
+  pure-SSM vs hybrid stacks on the same recipe geometry — train step time,
+  measured decode tokens/sec, decode-state bytes across the 1k-32k ladder
+  (the acceptance bar: FLAT for the SSM share), and how many concurrent
+  sequences each variant fits in a fixed decode-HBM budget. Geometry and
+  ladder logic live in tools/mixer_sweep.py so the standalone sweep and
+  this section can't drift apart."""
+  repo = os.path.dirname(os.path.abspath(__file__))
+  tools_dir = os.path.join(repo, "tools")
+  if tools_dir not in sys.path:
+    sys.path.insert(0, tools_dir)
+  import mixer_sweep
+  from lingvo_tpu.core import input_policy
+
+  out = {"seq_ladder": list(mixer_sweep.SEQ_LADDER)}
+  for name, every_n in mixer_sweep.VARIANTS.items():
+    res = mixer_sweep._Measure(jax, jnp, model_registry, name, every_n)
+    mp, task = mixer_sweep._Build(jax, jnp, model_registry, every_n)
+    state = task.CreateTrainState(jax.random.PRNGKey(0))
+    gen = input_policy.Instantiate(mp.input)
+    batch = gen.GetPreprocessedInputBatch().Transform(jnp.asarray)
+    step_fn = jax.jit(task.TrainStep, donate_argnums=_DonateState(on_tpu))
+    holder = [state]
+
+    def _Dispatch(_, step_fn=step_fn, holder=holder, batch=batch):
+      holder[0], step_out = step_fn(holder[0], batch)
+      return step_out
+
+    t = _MarginalStepTime(_Dispatch, lambda o: float(o.metrics.loss[0]),
+                          *((3, 13) if on_tpu else (1, 3)))
+    res["train_step_ms"] = round(t * 1e3, 2)
+    out[name] = res
+    del state, holder, step_fn, batch
+  # the two acceptance claims, surfaced as top-level booleans/ratios
+  out["ssm_state_flat_1k_to_32k"] = out["ssm"]["state_flat"]
+  out["hybrid_state_reduction_at_32k"] = round(
+      out["attention"]["decode_state_bytes_per_seq"]["32768"]
+      / max(out["hybrid"]["decode_state_bytes_per_seq"]["32768"], 1), 2)
+  out["slots_vs_attention_at_fixed_hbm"] = {
+      v: out[v]["slots_at_hbm_budget"]["slots"]
+      for v in mixer_sweep.VARIANTS}
+  return out
+
+
 def _BenchMoEDispatchCompareInner(jax, jnp):
   """einsum vs shard_map MoE dispatch on an 8-device {data,expert,model}
   mesh: per-variant step time (fwd+bwd) plus the attribution parser's
@@ -1188,6 +1233,7 @@ def main():
        lambda: _BenchFusedXent(jax, jnp, model_registry, on_tpu)),
       ("input_pipeline",
        lambda: _BenchInputPipeline(jax, jnp, model_registry, on_tpu)),
+      ("mixers", lambda: _BenchMixers(jax, jnp, model_registry, on_tpu)),
       ("moe", lambda: _BenchMoE(jax, jnp, model_registry, on_tpu, peak)),
       ("moe_dispatch", _BenchMoEDispatchCompare),
       ("ring_attention", lambda: _BenchRingAttention(jax, jnp, on_tpu)),

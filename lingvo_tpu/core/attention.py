@@ -494,15 +494,17 @@ class MultiHeadedAttention(base_layer.BaseLayer):
 
   # -- block-table paged decode (serving engine) -----------------------------
 
-  def InitPagedStates(self, theta, num_pages: int,
-                      page_size: int) -> NestedMap:
+  def InitPagedStates(self, theta, num_pages: int, page_size: int,
+                      num_slots: int = 0) -> NestedMap:
     """Global KV page pool [num_pages, page_size, N, H] shared by all
     sequences; which pages belong to whom lives host-side in the serving
     engine's block tables, so there is no time_step here (per-sequence
     lengths ride each PagedStep call). The engine reserves the LAST page as
     the trash page that padding-token writes scatter into — allocate with
-    one extra page and never hand page num_pages-1 to the allocator."""
-    del theta
+    one extra page and never hand page num_pages-1 to the allocator.
+    num_slots is the engine slot count, consumed by O(1)-state mixers
+    (ssm.GatedSSMLayer) and ignored here."""
+    del theta, num_slots
     n, h = self.p.num_heads, self._dim_per_head
     dtype = self.fprop_dtype
     return NestedMap(

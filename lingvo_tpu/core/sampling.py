@@ -1,0 +1,75 @@
+"""Shared token-sampling helper for the decode paths.
+
+One function, used by both serving surfaces (`runners/gshard_decode.py`
+`_SampleLoop` and `serving/engine.py` `_Step`) so the two stay
+token-identical under the same (seed, temperature, top_k) triple:
+
+- `temperature <= 0` lowers to pure argmax — bitwise the greedy path, no
+  RNG traffic at all (the branch is resolved at trace time, so the jitted
+  greedy program is unchanged by this module's existence).
+- `temperature > 0` divides logits by the temperature, optionally keeps
+  only the top-k logits per row, and draws from `jax.random.categorical`.
+- `row_seeds` gives each batch row its own stream: row i draws from
+  `fold_in(key, row_seeds[i])`. Two requests with the same per-request
+  seed produce the same continuation regardless of which batch rows or
+  neighbors they were scheduled with — the property the continuous-
+  batching engine needs for replayable requests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def SampleFromLogits(logits, key, temperature: float = 0.0,
+                     top_k: int = 0, row_seeds=None, positions=None):
+  """Draws one token id per row from `logits`.
+
+  Args:
+    logits: [B, V] float logits (any float dtype).
+    key: PRNGKey for this step. Unused (may be anything) when
+      `temperature <= 0`.
+    temperature: static python float. <= 0 means greedy argmax.
+    top_k: static python int. > 0 restricts sampling to the k largest
+      logits per row (applied after temperature, which doesn't change
+      the top-k set). 0 = full-vocab sampling.
+    row_seeds: optional [B] int32 per-request seeds. When given, row i
+      samples from `fold_in(key, row_seeds[i])` instead of the shared
+      per-step key, making each row's draw independent of its batch
+      neighbors.
+    positions: optional [B] int32 per-row output index, folded in after
+      row_seeds. For callers whose `key` is already per-step (a scan over
+      split keys) this is unnecessary; the continuous-batching engine
+      uses a FIXED key and passes each request's tokens-generated-so-far
+      here, so a request's stream depends only on (key, seed, position),
+      never on which engine iteration decoded it. Requires row_seeds.
+
+  Returns:
+    [B] int32 token ids.
+  """
+  if temperature <= 0.0:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+  logits = logits.astype(jnp.float32) / float(temperature)
+  if top_k > 0 and top_k < logits.shape[-1]:
+    # kth-largest per row; ties at the threshold all stay live, which
+    # only widens the candidate set and keeps the mask monotone in k
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+  if row_seeds is None:
+    assert positions is None, "positions requires row_seeds"
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+  def _RowKey(seed, pos):
+    k = jax.random.fold_in(key, seed)
+    return k if pos is None else jax.random.fold_in(k, pos)
+
+  if positions is None:
+    row_keys = jax.vmap(lambda s: _RowKey(s, None))(
+        row_seeds.astype(jnp.uint32))
+  else:
+    row_keys = jax.vmap(_RowKey)(row_seeds.astype(jnp.uint32),
+                                 positions.astype(jnp.uint32))
+  return jax.vmap(
+      lambda k, l: jax.random.categorical(k, l, axis=-1))(
+          row_keys, logits).astype(jnp.int32)

@@ -1,0 +1,301 @@
+"""Gated state-space-duality sequence mixer with an O(1) decode state.
+
+`GatedSSMLayer` is a drop-in alternative to `attention.MultiHeadedAttention`
+behind `transformer.TransformerAttentionLayer`: same FProp signature, same
+`InitStates`/`ExtendStep`/`Prefill` incremental-decode contract, same
+`InitPagedStates`/`PagedStep` serving contract — so hybrid stacks decode
+through GShardDecode and the continuous-batching engine unchanged. The
+difference is the cache: instead of a `[B, T, N, H]` KV cache that grows
+with sequence length, the decode state is a fixed `[B, N, H, S]` matrix per
+sequence — O(1) in T, which is the whole point (PAPERS.md: "Compiler-First
+State Space Duality and Portable O(1) Autoregressive Caching").
+
+Per head n, the mixer is a gated linear recurrence in SSD form
+(Mamba-2 / gated-linear-attention family):
+
+    b_t = x_t W_b      [S]   write key        c_t = x_t W_c   [S] read key
+    v_t = x_t W_v      [H]   value            g_t = x_t W_g   [H] gate
+    a_t = exp(-softplus(x_t w_dt + b_dt) * exp(A_log))        scalar decay
+    S_t = a_t S_{t-1} + v_t outer b_t                         [H, S] state
+    y_t = S_t c_t + d_skip * v_t
+    out_t = W_post . RMSNorm_head(y_t * silu(g_t))
+
+Training/prefill lowers through `ops/ssd_scan.SsdScan` (chunked XLA or the
+bitwise-equal Pallas twin); single-token decode is `ssd_scan.SequentialStep`
+— literally the same float ops the `sequential` lowering scans over, so the
+decode path and the sequential reference agree bitwise by construction.
+
+Numerics: projections/gating run in fprop dtype-friendly f32 (scan state is
+always f32 — the recurrence compounds over thousands of steps); the final
+output projection casts back to fprop dtype.
+
+Not supported (asserted, not silently wrong): cross-attention inputs,
+additive `atten_mask`s, and non-causal (`causal=False`) FProp — a linear
+recurrence is causal by nature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import base_layer
+from lingvo_tpu.core import py_utils
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+from lingvo_tpu.ops import ssd_scan
+
+
+class GatedSSMLayer(base_layer.BaseLayer):
+  """Gated SSD mixer; plug-compatible with MultiHeadedAttention."""
+
+  @classmethod
+  def Params(cls):
+    p = super().Params()
+    p.Define("input_dim", 0, "Model dim (set by the wrapping layer).")
+    p.Define("hidden_dim", 0, "Total mixer hidden dim (N*H); 0 = input_dim.")
+    p.Define("num_heads", 1, "Number of heads.")
+    p.Define("dim_per_head", 0, "Per-head value dim H (0 = hidden/heads).")
+    p.Define("state_dim", 64, "Per-head state width S (the O(1) cache is "
+             "[N, H, S] floats per sequence).")
+    p.Define("use_bias", True, "Bias on the value/gate/output projections.")
+    p.Define("chunk_size", 64, "Scan chunk width Q for the chunked/Pallas "
+             "lowerings (training + prefill).")
+    p.Define(
+        "scan_lowering", "auto",
+        "ops/ssd_scan lowering for multi-token calls: 'auto' (Pallas on "
+        "real TPU when SupportedOnTpu, chunked XLA elsewhere), 'chunked', "
+        "'pallas', 'associative', or 'sequential'.")
+    return p
+
+  def __init__(self, params):
+    super().__init__(params)
+    p = self.p
+    assert p.input_dim > 0 and p.num_heads > 0
+    hidden = p.hidden_dim or p.input_dim
+    self._dim_per_head = p.dim_per_head or hidden // p.num_heads
+    n, h, s, d = p.num_heads, self._dim_per_head, p.state_dim, p.input_dim
+    assert s > 0
+    wsdm = p.weight_split_dims_mapping  # e.g. (None, 'model', None)
+    wsdm2 = tuple(wsdm[:2]) if wsdm else None
+    for name, width in (("v", h), ("b", s), ("c", s), ("gate", h)):
+      self.CreateVariable(
+          f"w_{name}",
+          WeightParams((d, n, width), p.params_init, p.dtype,
+                       tensor_split_dims_mapping=wsdm))
+    if p.use_bias:
+      for name, width in (("v", h), ("gate", h)):
+        self.CreateVariable(
+            f"b_{name}",
+            WeightParams((n, width), WeightInit.Constant(0.0), p.dtype))
+    # Input-dependent decay: a = exp(-softplus(x w_dt + b_dt) * exp(a_log)).
+    # b_dt = -2 puts softplus ~0.13, i.e. a ~0.88/step at init — history
+    # survives ~tens of steps; a_log tunes the per-head timescale.
+    self.CreateVariable(
+        "w_dt",
+        WeightParams((d, n), p.params_init, p.dtype,
+                     tensor_split_dims_mapping=wsdm2))
+    self.CreateVariable(
+        "b_dt", WeightParams((n,), WeightInit.Constant(-2.0), p.dtype))
+    self.CreateVariable(
+        "a_log", WeightParams((n,), WeightInit.Constant(0.0), p.dtype))
+    self.CreateVariable(
+        "d_skip", WeightParams((n,), WeightInit.Constant(1.0), p.dtype))
+    # Per-head RMS norm on the gated scan output ((1 + scale) convention,
+    # matching layers.LayerNorm).
+    self.CreateVariable(
+        "norm_scale",
+        WeightParams((n, h), WeightInit.Constant(0.0), p.dtype))
+    self.CreateVariable(
+        "w_post",
+        WeightParams((d, n, h), p.params_init, p.dtype,
+                     tensor_split_dims_mapping=wsdm))
+    if p.use_bias:
+      self.CreateVariable(
+          "b_post", WeightParams((d,), WeightInit.Constant(0.0), p.dtype))
+
+  # -- projections -----------------------------------------------------------
+
+  def _Project(self, theta, x):
+    """x: [B, T, D] -> (decay_log, b, c, v, gate), all f32.
+
+    decay_log [B, T, N]; b/c [B, T, N, S]; v/gate [B, T, N, H].
+    """
+    th = self.CastTheta(theta)
+    v = jnp.einsum("btd,dnh->btnh", x, th.w_v)
+    gate = jnp.einsum("btd,dnh->btnh", x, th.w_gate)
+    if self.p.use_bias:
+      v = v + th.b_v
+      gate = gate + th.b_gate
+    b = jnp.einsum("btd,dns->btns", x, th.w_b).astype(jnp.float32)
+    c = jnp.einsum("btd,dns->btns", x, th.w_c).astype(jnp.float32)
+    dt_raw = (jnp.einsum("btd,dn->btn", x, th.w_dt).astype(jnp.float32)
+              + th.b_dt.astype(jnp.float32))
+    rate = jnp.exp(th.a_log.astype(jnp.float32))
+    decay_log = -jax.nn.softplus(dt_raw) * rate
+    return decay_log, b, c, v.astype(jnp.float32), gate.astype(jnp.float32)
+
+  def _Finish(self, theta, y, v, gate):
+    """Skip + gate + per-head RMS norm + output projection.
+
+    y/v/gate: [B, T, N, H] f32 -> [B, T, D] in fprop dtype.
+    """
+    th = self.CastTheta(theta)
+    y = y + th.d_skip.astype(jnp.float32)[:, None] * v
+    y = y * jax.nn.silu(gate)
+    var = jnp.mean(jnp.square(y), axis=-1, keepdims=True)
+    y = y * jax.lax.rsqrt(var + 1e-6)
+    y = y * (1.0 + th.norm_scale.astype(jnp.float32))
+    out = jnp.einsum("btnh,dnh->btd", y.astype(self.fprop_dtype), th.w_post)
+    if self.p.use_bias:
+      out = out + th.b_post
+    return out
+
+  @staticmethod
+  def _MaskScanInputs(decay_log, v, paddings=None, segment_ids=None):
+    """Apply the ssd_scan masking contract.
+
+    Padded steps become exact identity (decay_log = 0, v = 0); segment
+    starts become resets (decay_log = RESET_LOG). Resets are applied first
+    so a padded step can never resurrect cross-segment state (packed inputs
+    only pad at the tail, where nothing reads the state anyway).
+    """
+    if segment_ids is not None:
+      prev = jnp.concatenate([segment_ids[:, :1], segment_ids[:, :-1]],
+                             axis=1)
+      is_reset = (segment_ids != prev)[..., None]           # [B, T, 1]
+      decay_log = jnp.where(is_reset, ssd_scan.RESET_LOG, decay_log)
+    if paddings is not None:
+      valid = (1.0 - paddings.astype(jnp.float32))          # [B, T]
+      decay_log = decay_log * valid[..., None]
+      v = v * valid[..., None, None]
+    return decay_log, v
+
+  # -- training / full-sequence ----------------------------------------------
+
+  def FProp(self, theta, query_vec, key_vec=None, value_vec=None,
+            paddings=None, atten_mask=None, segment_ids=None, causal=False):
+    """Returns ([B, T, D] output, None) — probs slot kept for API parity."""
+    if key_vec is not None or value_vec is not None:
+      raise NotImplementedError(
+          "GatedSSMLayer is a self-mixer; cross-attention layers must keep "
+          "MultiHeadedAttention")
+    if atten_mask is not None:
+      raise NotImplementedError(
+          "GatedSSMLayer cannot apply additive attention masks; use "
+          "paddings/segment_ids")
+    if not causal:
+      raise ValueError(
+          "GatedSSMLayer is causal by construction; bidirectional stacks "
+          "(causal=False) must keep attention")
+    decay_log, b, c, v, gate = self._Project(theta, query_vec)
+    decay_log, v = self._MaskScanInputs(decay_log, v, paddings, segment_ids)
+    y, _ = ssd_scan.SsdScan(
+        decay_log, b, c, v, chunk_size=self.p.chunk_size,
+        lowering=self.p.scan_lowering)
+    out = self._Finish(theta, y, v, gate)
+    if paddings is not None:
+      out = py_utils.ApplyPadding(paddings, out)
+    return out, None
+
+  # -- incremental decode ----------------------------------------------------
+
+  def InitStates(self, theta, batch_size: int, max_len: int) -> NestedMap:
+    """O(1) decode state: [B, N, H, S] f32, independent of max_len."""
+    del theta, max_len
+    n, h, s = self.p.num_heads, self._dim_per_head, self.p.state_dim
+    return NestedMap(
+        state=jnp.zeros((batch_size, n, h, s), jnp.float32),
+        time_step=jnp.zeros((), jnp.int32))
+
+  def StateBytesPerSlot(self) -> int:
+    """Decode-state bytes per sequence (f32 state matrix)."""
+    return self.p.num_heads * self._dim_per_head * self.p.state_dim * 4
+
+  def ExtendStep(self, theta, query_vec, cached_states: NestedMap,
+                 paddings=None):
+    """query_vec: [B, 1, D]; returns ([B, 1, D], updated states).
+
+    Routes the recurrence through ssd_scan.SequentialStep — the exact float
+    ops of the 'sequential' lowering — so an ExtendStep chain and a
+    sequential-lowering FProp agree bitwise on the state trajectory.
+    """
+    t = cached_states.time_step
+    decay_log, b, c, v, gate = self._Project(theta, query_vec)
+    if paddings is not None:
+      pad_t = jax.lax.dynamic_slice_in_dim(paddings, t, 1, axis=1)  # [B, 1]
+      decay_log, v = self._MaskScanInputs(decay_log, v, pad_t)
+    s_new, y = ssd_scan.SequentialStep(
+        cached_states.state, decay_log[:, 0], b[:, 0], c[:, 0], v[:, 0])
+    out = self._Finish(theta, y[:, None], v, gate)
+    return out, NestedMap(state=s_new, time_step=t + 1)
+
+  def Prefill(self, theta, query_vec, cached_states: NestedMap,
+              paddings=None, live_len: int | None = None):
+    """Whole-chunk state priming: [B, C, D] for slots [t, t + C).
+
+    A prefill starting at t=0 that covers the whole sequence is bitwise
+    identical to FProp (same projections, same scan, zero initial state);
+    live_len is irrelevant here — the state is O(1) regardless of length.
+    """
+    del live_len
+    t = cached_states.time_step
+    c_len = query_vec.shape[1]
+    decay_log, b, c, v, gate = self._Project(theta, query_vec)
+    if paddings is not None:
+      pad_c = jax.lax.dynamic_slice_in_dim(paddings, t, c_len, axis=1)
+      decay_log, v = self._MaskScanInputs(decay_log, v, pad_c)
+    y, s_new = ssd_scan.SsdScan(
+        decay_log, b, c, v, s0=cached_states.state,
+        chunk_size=self.p.chunk_size, lowering=self.p.scan_lowering)
+    out = self._Finish(theta, y, v, gate)
+    return out, NestedMap(state=s_new, time_step=t + c_len)
+
+  # -- continuous-batching serving -------------------------------------------
+
+  def InitPagedStates(self, theta, num_pages: int, page_size: int,
+                      num_slots: int = 0) -> NestedMap:
+    """One fixed [N, H, S] state per engine slot — no page pool share.
+
+    The serving engine passes num_slots = its slot count; attention layers
+    ignore it and SSM layers ignore the page-pool geometry. There is no
+    time_step: per-row positions ride each PagedStep call (q_pos)."""
+    del theta, num_pages, page_size
+    assert num_slots > 0, (
+        "GatedSSMLayer.InitPagedStates needs the engine slot count "
+        "(InitPagedDecodeState(..., num_slots=max_slots))")
+    n, h, s = self.p.num_heads, self._dim_per_head, self.p.state_dim
+    return NestedMap(state=jnp.zeros((num_slots, n, h, s), jnp.float32))
+
+  def PagedStep(self, theta, query_vec, cached_states: NestedMap,
+                block_tables, q_pos, in_len):
+    """One continuous-batching step; query_vec [B, C, D], B = engine slots.
+
+    block_tables is ignored — the O(1) state needs no pages. Slot re-use is
+    handled device-side: a row starting a fresh request arrives with
+    q_pos == 0 and its state resets to zero, so stale state from an evicted
+    or finished occupant can never leak (the attention analogue is the
+    engine masking via block tables). Rows past in_len are identity steps.
+    """
+    del block_tables
+    b, c_len, _ = query_vec.shape
+    q_pos = q_pos.astype(jnp.int32)
+    in_len = in_len.astype(jnp.int32)
+    state = jnp.where((q_pos == 0)[:, None, None, None], 0.0,
+                      cached_states.state)
+    decay_log, b_proj, c_proj, v, gate = self._Project(theta, query_vec)
+    # paddings convention: 1.0 = invalid step.
+    invalid = (jnp.arange(c_len, dtype=jnp.int32)[None]
+               >= in_len[:, None]).astype(jnp.float32)
+    decay_log, v = self._MaskScanInputs(decay_log, v, invalid)
+    if c_len == 1:
+      s_new, y = ssd_scan.SequentialStep(
+          state, decay_log[:, 0], b_proj[:, 0], c_proj[:, 0], v[:, 0])
+      y = y[:, None]
+    else:
+      y, s_new = ssd_scan.SsdScan(
+          decay_log, b_proj, c_proj, v, s0=state,
+          chunk_size=min(self.p.chunk_size, c_len),
+          lowering=self.p.scan_lowering)
+    out = self._Finish(theta, y, v, gate)
+    return out, NestedMap(state=s_new)

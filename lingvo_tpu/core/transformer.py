@@ -158,8 +158,9 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
         theta.atten, x, cached_states, paddings=cache_paddings, **kw)
     return query_vec + out, new_states
 
-  def InitPagedStates(self, theta, num_pages, page_size):
-    return self.atten.InitPagedStates(theta.atten, num_pages, page_size)
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
+    return self.atten.InitPagedStates(theta.atten, num_pages, page_size,
+                                      num_slots=num_slots)
 
   def PagedStep(self, theta, query_vec, cached_states, block_tables, q_pos,
                 in_len):
@@ -187,6 +188,14 @@ class TransformerLayer(base_layer.BaseLayer):
              "same as tr_atten_tpl).")
     p.Define("tr_fflayer_tpl", TransformerFeedForwardLayer.Params(),
              "FFN template.")
+    p.Define(
+        "mixer_tpl", None,
+        "Optional sequence-mixer template replacing the self-attention "
+        "inner layer (e.g. ssm.GatedSSMLayer.Params()). The pre-LN/residual "
+        "wrapper, decode contract, and paged-serving contract are shared — "
+        "only the mixer inside tr_atten_tpl's TransformerAttentionLayer is "
+        "swapped, which is how hybrid stacks mix attention and O(1)-state "
+        "layers per depth. None = keep tr_atten_tpl.atten_tpl.")
     return p
 
   def __init__(self, params):
@@ -194,6 +203,8 @@ class TransformerLayer(base_layer.BaseLayer):
     p = self.p
     atten_p = p.tr_atten_tpl.Copy().Set(
         input_dim=p.input_dim, num_heads=p.num_heads, is_masked=p.mask_self_atten)
+    if p.mixer_tpl is not None:
+      atten_p.atten_tpl = p.mixer_tpl.Copy()
     self.CreateChild("self_atten", atten_p)
     if p.has_aux_atten:
       aux_p = (p.tr_aux_atten_tpl or p.tr_atten_tpl).Copy().Set(
@@ -249,11 +260,11 @@ class TransformerLayer(base_layer.BaseLayer):
     out = self.fflayer.FProp(theta.fflayer, x)
     return out, NestedMap(self_atten=new_sa)
 
-  def InitPagedStates(self, theta, num_pages, page_size):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
     assert not self.p.has_aux_atten, (
         "continuous-batching serving is decoder-only (no cross-attention)")
     return NestedMap(self_atten=self.self_atten.InitPagedStates(
-        theta.self_atten, num_pages, page_size))
+        theta.self_atten, num_pages, page_size, num_slots=num_slots))
 
   def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
                 in_len):
@@ -273,6 +284,15 @@ class StackedTransformerLayers(base_layer.BaseLayer):
     p.Define("num_layers", 0, "Depth.")
     p.Define("transformer_layer_params_tpl", TransformerLayer.Params(),
              "Per-layer template.")
+    p.Define(
+        "layer_tpls", None,
+        "Optional explicit per-layer templates (list of TransformerLayer "
+        "Params, length num_layers) overriding transformer_layer_params_tpl "
+        "— the hook heterogeneous stacks (hybrid attention/SSM) hang off. "
+        "Also the repeat-block body trick: a RepeatedTransformerLayer whose "
+        "body is a StackedTransformerLayers with layer_tpls scans one "
+        "heterogeneous block of depth k, giving num_layers/k repeats of "
+        "e.g. [ssm, ssm, ..., attention].")
     p.Define("final_ln", True, "LayerNorm on the final output.")
     p.Define("input_dim", 0, "Model dim (propagated to layers).")
     return p
@@ -281,15 +301,22 @@ class StackedTransformerLayers(base_layer.BaseLayer):
     super().__init__(params)
     p = self.p
     assert p.num_layers > 0
-    tpl = p.transformer_layer_params_tpl.Copy()
+    if p.layer_tpls:
+      assert len(p.layer_tpls) == p.num_layers, (
+          len(p.layer_tpls), p.num_layers)
+      tpls = [t.Copy() for t in p.layer_tpls]
+    else:
+      tpls = [p.transformer_layer_params_tpl.Copy()
+              for _ in range(p.num_layers)]
     if p.input_dim:
-      tpl.input_dim = p.input_dim
-    self.CreateChildren("x_layers", [tpl.Copy() for _ in range(p.num_layers)])
+      for t in tpls:
+        t.input_dim = p.input_dim
+    self.CreateChildren("x_layers", tpls)
     if p.final_ln:
       self.CreateChild(
           "final_ln",
           layers_lib.LayerNorm.Params().Set(
-              input_dim=p.input_dim or tpl.input_dim))
+              input_dim=p.input_dim or tpls[0].input_dim))
 
   def FProp(self, theta, inputs, paddings=None, aux_vecs=None,
             aux_paddings=None, segment_ids=None, token_ids=None):
@@ -331,9 +358,10 @@ class StackedTransformerLayers(base_layer.BaseLayer):
       x = self.final_ln.FProp(theta.final_ln, x)
     return x, new_states
 
-  def InitPagedStates(self, theta, num_pages, page_size):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
     return NestedMap(x_layers=[
-        l.InitPagedStates(theta.x_layers[i], num_pages, page_size)
+        l.InitPagedStates(theta.x_layers[i], num_pages, page_size,
+                          num_slots=num_slots)
         for i, l in enumerate(self.x_layers)
     ])
 
@@ -461,9 +489,10 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
                                    (theta.body, cached_states.body))
     return out, NestedMap(body=new_states)
 
-  def InitPagedStates(self, theta, num_pages, page_size):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
     def _One(theta_i):
-      return self.body.InitPagedStates(theta_i, num_pages, page_size)
+      return self.body.InitPagedStates(theta_i, num_pages, page_size,
+                                       num_slots=num_slots)
 
     return NestedMap(body=jax.vmap(_One)(theta.body))
 

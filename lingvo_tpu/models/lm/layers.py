@@ -42,6 +42,21 @@ class TransformerLm(base_model.BaseTask):
              "'dots' (save matmul outputs; ~4/3x fewer bwd flops than "
              "'full') | 'none'.")
     p.Define("atten_tpl", None, "Optional attention template override.")
+    p.Define(
+        "mixer_tpl", None,
+        "Optional O(1)-state sequence-mixer template (e.g. "
+        "ssm.GatedSSMLayer.Params()). When set, SSM layers replace "
+        "attention according to mixer_atten_every_n; decode/serving "
+        "contracts are unchanged (the mixer implements "
+        "ExtendStep/Prefill/PagedStep with a fixed [B, N, H, S] state).")
+    p.Define(
+        "mixer_atten_every_n", 0,
+        "Hybrid-stack layout with mixer_tpl: every n-th layer (layers n, "
+        "2n, ... 1-indexed) keeps full attention, the rest run the mixer — "
+        "e.g. 6 gives [ssm x5, attention] blocks. 0 = every layer runs "
+        "the mixer (pure-SSM stack, pageless serving). Under "
+        "use_repeat_layer, num_layers must divide by n (the block is the "
+        "scanned repeat body).")
     p.Define("use_rotary", True, "RoPE instead of absolute positions.")
     p.Define("bidirectional", False,
              "No causal mask (BERT-style encoder; pair with an MLM task).")
@@ -111,6 +126,20 @@ class TransformerLm(base_model.BaseTask):
     layer_body.tr_fflayer_tpl.residual_dropout_prob = p.residual_dropout_prob
     layer_body.tr_fflayer_tpl.weight_split_dims_mapping = (None, "model")
 
+    ssm_body = None
+    if p.mixer_tpl is not None:
+      assert p.num_experts == 0, (
+          "hybrid SSM stacks don't compose with the MoE interleave yet")
+      assert not p.bidirectional, (
+          "GatedSSMLayer is causal; bidirectional stacks keep attention")
+      mixer_tpl = p.mixer_tpl.Copy()
+      mixer_tpl.weight_split_dims_mapping = (None, "model", None)
+      ssm_body = layer_body.Copy().Set(mixer_tpl=mixer_tpl)
+      if p.mixer_atten_every_n == 1:
+        # attention at EVERY layer: the hybrid degenerates to the plain
+        # attention stack and the mixer template is never instantiated
+        ssm_body = None
+
     if p.num_experts > 0:
       from lingvo_tpu.parallel import gshard
       assert p.num_layers % 2 == 0, "MoE interleave needs even num_layers"
@@ -135,18 +164,48 @@ class TransformerLm(base_model.BaseTask):
           transformer_lib.RepeatedTransformerLayer.Params().Set(
               num_layers=p.num_layers // 2, body=block,
               remat_policy=p.remat_policy))
+    elif ssm_body is not None and p.mixer_atten_every_n > 1:
+      # Hybrid stack: attention at layers n, 2n, ... (1-indexed), SSM
+      # elsewhere — [ssm x (n-1), attention] blocks.
+      n = p.mixer_atten_every_n
+      assert p.num_layers % n == 0, (p.num_layers, n)
+      if p.use_repeat_layer:
+        # Scan one heterogeneous block of depth n: a Stacked body with
+        # explicit per-layer templates (same trick as the MoE
+        # DenseMoEBlock, built from stock parts).
+        block = transformer_lib.StackedTransformerLayers.Params().Set(
+            num_layers=n, input_dim=p.model_dim,
+            layer_tpls=[ssm_body.Copy() for _ in range(n - 1)]
+            + [layer_body.Copy()],
+            final_ln=False)
+        self.CreateChild(
+            "stack",
+            transformer_lib.RepeatedTransformerLayer.Params().Set(
+                num_layers=p.num_layers // n, body=block,
+                remat_policy=p.remat_policy))
+      else:
+        tpls = [
+            layer_body.Copy() if (i + 1) % n == 0 else ssm_body.Copy()
+            for i in range(p.num_layers)
+        ]
+        self.CreateChild(
+            "stack",
+            transformer_lib.StackedTransformerLayers.Params().Set(
+                num_layers=p.num_layers, input_dim=p.model_dim,
+                layer_tpls=tpls, final_ln=False))
     elif p.use_repeat_layer:
       self.CreateChild(
           "stack",
           transformer_lib.RepeatedTransformerLayer.Params().Set(
-              num_layers=p.num_layers, body=layer_body,
+              num_layers=p.num_layers, body=ssm_body or layer_body,
               remat_policy=p.remat_policy))
     else:
       self.CreateChild(
           "stack",
           transformer_lib.StackedTransformerLayers.Params().Set(
               num_layers=p.num_layers, input_dim=p.model_dim,
-              transformer_layer_params_tpl=layer_body, final_ln=False))
+              transformer_layer_params_tpl=ssm_body or layer_body,
+              final_ln=False))
     if p.softmax_num_sampled > 0:
       assert p.xent_block_size == 0, (
           "sampled softmax and the fused blockwise xent are both "
@@ -339,14 +398,19 @@ class TransformerLm(base_model.BaseTask):
       logits = self.emb.Logits(theta.emb, x)
     return logits, new_states
 
-  def InitPagedDecodeState(self, theta, num_pages: int, page_size: int):
+  def InitPagedDecodeState(self, theta, num_pages: int, page_size: int,
+                           num_slots: int = 0):
     """Global KV page pool for the continuous-batching serving engine.
 
     Unlike InitDecodeState there is no batch/max_len shape — capacity is
     num_pages * page_size slots shared by however many sequences the
     engine's block tables map into it (serving/engine.py owns the layout;
-    it passes allocator pages + 1 so the last page is the trash page)."""
-    return self.stack.InitPagedStates(theta.stack, num_pages, page_size)
+    it passes allocator pages + 1 so the last page is the trash page).
+    num_slots: the engine's slot count, required by O(1)-state mixer
+    layers (one fixed [N, H, S] state per slot); attention layers ignore
+    it."""
+    return self.stack.InitPagedStates(theta.stack, num_pages, page_size,
+                                      num_slots=num_slots)
 
   def PagedStep(self, theta, ids, states, block_tables, q_pos, in_len):
     """Continuous-batching step: ids [b, c] -> (logits [b, c, vocab],

@@ -124,6 +124,50 @@ class DenseLm8B(DenseLmTemplate):
 
 
 @model_registry.RegisterSingleTaskModel
+class DenseLmSsmHybrid(DenseLmTemplate):
+  """Hybrid O(1)-cache stack: attention every 6th layer, gated-SSD SSM
+  mixers elsewhere (docs/sequence_mixers.md). Decode state per sequence is
+  10 SSM matrices + 2 KV caches instead of 12 KV caches — ~6x less decode
+  HBM at seq 1024, flat in sequence length for the SSM share."""
+
+  SEQUENCE_LENGTH = 1024
+  MODEL_DIM = 1024
+  NUM_LAYERS = 12
+  NUM_HEADS = 16
+  HIDDEN_DIM = 4096
+  MIXER_ATTEN_EVERY_N = 6
+  SSM_STATE_DIM = 64
+  SSM_CHUNK_SIZE = 64
+
+  def Task(self):
+    from lingvo_tpu.core import ssm
+    p = super().Task()
+    p.mixer_tpl = ssm.GatedSSMLayer.Params().Set(
+        state_dim=self.SSM_STATE_DIM, chunk_size=self.SSM_CHUNK_SIZE)
+    p.mixer_atten_every_n = self.MIXER_ATTEN_EVERY_N
+    return p
+
+
+@model_registry.RegisterSingleTaskModel
+class DenseLmSsmHybridTiny(DenseLmSsmHybrid):
+  """Smoke-test scale of the hybrid stack: attention every 2nd layer;
+  decodes on CPU in seconds (serving/bench/test harnesses)."""
+
+  SEQUENCE_LENGTH = 64
+  BATCH_SIZE = 4
+  VOCAB_SIZE = 128
+  MODEL_DIM = 64
+  NUM_LAYERS = 2
+  NUM_HEADS = 4
+  HIDDEN_DIM = 128
+  MIXER_ATTEN_EVERY_N = 2
+  SSM_STATE_DIM = 16
+  SSM_CHUNK_SIZE = 8
+  LEARNING_RATE = 3e-3
+  MAX_STEPS = 2000
+
+
+@model_registry.RegisterSingleTaskModel
 class MoELmTiny(DenseLmTemplate):
   """Smoke-test MoE LM (8 experts, alternate dense/MoE layers)."""
 

@@ -39,6 +39,7 @@ import numpy as np
 
 from lingvo_tpu.core import checkpointer as checkpointer_lib
 from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import sampling
 from lingvo_tpu.core.nested_map import NestedMap
 
 # Decode-program shape buckets (slots, ascending). Lengths beyond the last
@@ -51,6 +52,7 @@ class GShardDecode:
 
   def __init__(self, task, train_dir: str, output_path: str,
                max_decode_steps: int = 32, temperature: float = 0.0,
+               top_k: int = 0,
                poll_interval_secs: float = 10.0,
                timeout_secs: float = 3600.0,
                init_seed: int = 1234,
@@ -59,6 +61,11 @@ class GShardDecode:
                len_buckets=DEFAULT_LEN_BUCKETS):
     """task: a TransformerLm-style task exposing InitDecodeState/ExtendStep.
 
+    temperature/top_k: sampling controls (core/sampling.py). temperature
+    <= 0 is greedy argmax — bitwise the pre-sampling behavior; top_k > 0
+    restricts temperature sampling to the k largest logits. Sampling is
+    seeded per request: row i draws from fold_in(step_key, i), so a
+    request's continuation doesn't depend on its batch neighbors.
     prefill_chunk_size: prompt tokens per prefill attention pass (0 = the
     whole prompt in one pass). use_legacy_prime: prime the cache with the
     per-token ExtendStep scan instead of chunked prefill (slow; kept as
@@ -69,6 +76,7 @@ class GShardDecode:
     self._output_path = output_path
     self._max_steps = max_decode_steps
     self._temperature = temperature
+    self._top_k = top_k
     self._checkpointer = checkpointer_lib.Checkpointer(train_dir)
     self._poll_interval = poll_interval_secs
     self._timeout = timeout_secs
@@ -94,6 +102,7 @@ class GShardDecode:
       return self._decode_fns[cache_key]
     task = self._task
     temp = self._temperature
+    top_k = self._top_k
     total = p_len + t_max
     chunk = self._prefill_chunk if self._prefill_chunk > 0 else p_len
     legacy_prime = self._use_legacy_prime
@@ -146,14 +155,15 @@ class GShardDecode:
     def _SampleLoop(theta, last_logits, prompt_lens, key, states):
       """Greedy/temperature sampling scan -> continuations [B, t_max]."""
       cache_paddings = _CachePaddings(prompt_lens)
+      # per-request streams: row i folds its row index into the step key,
+      # so a row's draws are a function of (checkpoint key, row, step)
+      # only — not of how many neighbors share the batch
+      row_seeds = jnp.arange(last_logits.shape[0], dtype=jnp.int32)
 
       def _Sample(carry, key_t):
         states, logits = carry
-        if temp > 0:
-          nxt = jax.random.categorical(key_t, logits / temp, axis=-1)
-        else:
-          nxt = jnp.argmax(logits, axis=-1)
-        nxt = nxt.astype(jnp.int32)
+        nxt = sampling.SampleFromLogits(logits, key_t, temperature=temp,
+                                        top_k=top_k, row_seeds=row_seeds)
         new_logits, states = task.ExtendStep(theta, nxt[:, None], states,
                                              cache_paddings=cache_paddings)
         return (states, new_logits), nxt
@@ -215,6 +225,12 @@ class GShardDecode:
     aligned = self._RightAlign(prompts, prompt_lens, width=p_len)
     states = init_fn(state.theta, prompts.shape[0])
     jax.block_until_ready(states)
+    # measured BEFORE donation (shape metadata only): total decode-state
+    # HBM per sequence — KV caches grow with p_len + max_steps, O(1) SSM
+    # mixer states don't, so this is the number the mixer bench sweeps
+    state_bytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(states)
+        if hasattr(x, "nbytes"))
     lens_dev = jnp.asarray(prompt_lens)
     # per-phase wall timing (block_until_ready fences async dispatch so
     # each phase's time is its own, not its predecessor's flush)
@@ -238,6 +254,7 @@ class GShardDecode:
         "decode_tokens": b * self._max_steps,
         "tokens_per_sec": (b * self._max_steps / decode_s
                            if decode_s > 0 else 0.0),
+        "decode_state_bytes_per_seq": state_bytes // b,
     }
     self._last_telemetry = telemetry
     results = []

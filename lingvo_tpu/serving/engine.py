@@ -15,10 +15,23 @@ with zero recompilation — the property that lets short requests overtake
 long ones instead of idling behind them (the batch-synchronous
 `GShardDecode` failure mode this engine replaces).
 
-Greedy sampling only: the ISSUE's parity bar is token-identity with
-batch-synchronous `GShardDecode` at temperature 0, and argmax keeps the
-step program deterministic with no per-request RNG state to shuffle
-through slots.
+Sampling: temperature 0 (default) is pure argmax — token-identical to
+batch-synchronous `GShardDecode`, the parity bar asserted in tests. With
+temperature > 0 (optional top_k) each request samples from its OWN
+stream (core/sampling.py): the draw for output position t of a request
+with seed s is a pure function of (engine sample_seed, s, t), carried
+through the scheduler as per-row `row_seeds`/`row_pos`, so continuations
+are replayable no matter which slot or batch neighbors the scheduler
+picked.
+
+O(1)-state mixers (core/ssm.py): stacks whose mixers carry fixed-size
+recurrent state instead of KV pages plug in unchanged — their PagedStep
+state is a [max_batch, ...] per-slot array reset device-side on each
+sequence's first chunk (q_pos == 0). The engine takes a mixer census at
+construction: hybrid stacks price both resources, and pure-SSM stacks
+set `needs_kv_pages=False` so admission is bounded by decode slots only
+(the allocator is never charged — the more-concurrent-requests-at-fixed-
+HBM property the ISSUE's bench demonstrates).
 
 Two front doors:
 - async: `Start()` + `Submit(prompt, max_new) -> StreamHandle` — tokens
@@ -39,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lingvo_tpu.core import sampling
 from lingvo_tpu.serving import kv_cache
 from lingvo_tpu.serving import scheduler as scheduler_lib
 
@@ -100,11 +114,15 @@ class ServingLoop:
 
   def __init__(self, task, theta, *, page_size: int, num_pages: int,
                max_batch: int, max_seq_len: int, prefill_chunk: int = 8,
-               default_max_new: int = 32, eos_id: Optional[int] = None):
+               default_max_new: int = 32, eos_id: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               sample_seed: int = 0):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
     (block-table width = ceil(max_seq_len / page_size)).
+    temperature/top_k/sample_seed: sampling controls (module docstring);
+    temperature <= 0 compiles to the pre-sampling argmax program.
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -116,20 +134,45 @@ class ServingLoop:
     self.prefill_chunk = prefill_chunk
     self.default_max_new = default_max_new
     self.eos_id = eos_id
+    self.temperature = float(temperature)
+    self.top_k = int(top_k)
+    self.sample_seed = int(sample_seed)
     self.alloc = kv_cache.PageAllocator(num_pages, page_size)
     table_pages = self.alloc.PagesFor(max_seq_len)
+    # mixer census: which resource(s) this stack's decode state occupies
+    self.mixers = self._MixerCensus()
+    self.state_pool = None
+    if self.mixers["num_ssm"] > 0:
+      self.state_pool = kv_cache.StateSlotPool(
+          max_batch, self.mixers["decode_state_bytes_per_slot"])
     self.sched = scheduler_lib.Scheduler(
-        max_batch, self.alloc, table_pages, prefill_chunk)
-    # pool page num_pages (the +1) is the trash page padding writes hit
-    init_fn = jax.jit(task.InitPagedDecodeState, static_argnums=(1, 2))
-    self._states = init_fn(theta, num_pages + 1, page_size)
+        max_batch, self.alloc, table_pages, prefill_chunk,
+        needs_kv_pages=self.mixers["num_attention"] > 0,
+        state_pool=self.state_pool)
+    # pool page num_pages (the +1) is the trash page padding writes hit;
+    # num_slots sizes the per-slot O(1) mixer states (attention ignores it)
+    init_fn = jax.jit(task.InitPagedDecodeState, static_argnums=(1, 2, 3))
+    self._states = init_fn(theta, num_pages + 1, page_size, max_batch)
     # donate the pool into each step off-cpu (XLA:CPU can't alias + warns)
     donate = (1,) if jax.default_backend() != "cpu" else ()
+    temp, topk = self.temperature, self.top_k
+    base_key = self.sample_seed
 
-    def _Step(theta, states, ids, q_pos, in_len, tables):
+    def _Step(theta, states, ids, q_pos, in_len, tables, seeds, pos):
       logits, states = task.PagedStep(theta, ids, states, tables, q_pos,
                                       in_len)
-      return jnp.argmax(logits, axis=-1).astype(jnp.int32), states
+      # sample every chunk column with the row's (seed, output-position)
+      # stream; CommitStep consumes exactly one column per row (col 0 for
+      # decode rows, the last valid prompt column for finishing prefills),
+      # so identical draws across columns are never double-consumed
+      key = jax.random.PRNGKey(base_key)
+      cols = [
+          sampling.SampleFromLogits(logits[:, c], key, temperature=temp,
+                                    top_k=topk, row_seeds=seeds,
+                                    positions=pos)
+          for c in range(logits.shape[1])
+      ]
+      return jnp.stack(cols, axis=1), states
 
     self._step_fn = jax.jit(_Step, donate_argnums=donate)
     # silent-fallback visibility: classify ONCE which attention path the
@@ -149,20 +192,52 @@ class ServingLoop:
 
   # -- path classification ---------------------------------------------------
 
-  def _FindAtten(self):
+  def _MixerLayers(self):
+    """[(mixer_layer, multiplicity)] over the whole stack.
+
+    Handles all four stack shapes: plain Stacked (x_layers), plain
+    Repeated (body = one TransformerLayer, xN), and the hybrid Repeated
+    whose body is itself a StackedTransformerLayers block (body.x_layers,
+    each xN)."""
     stack = self._task.stack
-    layer = getattr(stack, "body", None)
-    if layer is None:
-      layer = stack.x_layers[0]
-    return layer.self_atten.atten
+    body = getattr(stack, "body", None)
+    if body is not None:
+      reps = stack.p.num_layers
+      inner = body.x_layers if hasattr(body, "x_layers") else [body]
+      return [(l.self_atten.atten, reps) for l in inner]
+    return [(l.self_atten.atten, 1) for l in stack.x_layers]
+
+  def _MixerCensus(self) -> dict:
+    """Counts attention vs O(1)-state mixers; prices the per-slot state.
+
+    A mixer is 'O(1)-state' iff it exposes StateBytesPerSlot (the
+    core/ssm.py contract); everything else is a paged-KV attention layer.
+    """
+    num_attention = num_ssm = state_bytes = 0
+    for mixer, reps in self._MixerLayers():
+      if hasattr(mixer, "StateBytesPerSlot"):
+        num_ssm += reps
+        state_bytes += reps * mixer.StateBytesPerSlot()
+      else:
+        num_attention += reps
+    return {
+        "num_attention": num_attention,
+        "num_ssm": num_ssm,
+        "decode_state_bytes_per_slot": state_bytes,
+    }
 
   def _ClassifyPath(self) -> str:
-    """'pallas' | 'xla' | 'dense' — what PagedStep actually lowers to.
+    """'pallas' | 'xla' | 'dense' | 'ssm' — what PagedStep lowers to.
 
     A dense fallback (ineligible attention config) is CORRECT but not
-    paged-fast; it must be visible, never silent (ISSUE satellite)."""
-    atten = self._FindAtten()
-    if not atten.BlockDecodeEligible(self.page_size):
+    paged-fast; it must be visible, never silent (ISSUE satellite).
+    'ssm' = no attention layer at all: the page pool is never read and
+    classification is about the recurrent-state path instead."""
+    attens = [m for m, _ in self._MixerLayers()
+              if not hasattr(m, "StateBytesPerSlot")]
+    if not attens:
+      return "ssm"
+    if not all(a.BlockDecodeEligible(self.page_size) for a in attens):
       return "dense"
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
@@ -205,16 +280,20 @@ class ServingLoop:
       self._thread = None
 
   def Submit(self, prompt, max_new_tokens: Optional[int] = None,
-             eos_id=_END) -> StreamHandle:
-    """Queues a request; returns its streaming handle immediately."""
+             eos_id=_END, seed: Optional[int] = None) -> StreamHandle:
+    """Queues a request; returns its streaming handle immediately.
+
+    seed: per-request sampling seed (defaults to the request id) — only
+    observable at temperature > 0; same seed = same continuation."""
     max_new = max_new_tokens or self.default_max_new
     eos = self.eos_id if eos_id is _END else eos_id
     with self._lock:
       self._seq_counter += 1
       req_id = self._seq_counter
-      req = scheduler_lib.Request(req_id, prompt, max_new, eos)
+      req = scheduler_lib.Request(req_id, prompt, max_new, eos, seed=seed)
       total = len(req.prompt) + req.max_new
-      if self.alloc.PagesFor(total) > self.alloc.num_pages:
+      if self.sched.needs_kv_pages and (
+          self.alloc.PagesFor(total) > self.alloc.num_pages):
         raise ValueError(
             f"request needs {self.alloc.PagesFor(total)} pages; the pool "
             f"only has {self.alloc.num_pages} — it could never be admitted")
@@ -257,7 +336,8 @@ class ServingLoop:
     sampled, new_states = self._step_fn(
         self._theta, self._states, jnp.asarray(batch.ids),
         jnp.asarray(batch.q_pos), jnp.asarray(batch.in_len),
-        jnp.asarray(tables))
+        jnp.asarray(tables), jnp.asarray(batch.row_seeds),
+        jnp.asarray(batch.row_pos))
     self._states = new_states
     sampled = np.asarray(sampled)
     with self._lock:
@@ -313,4 +393,7 @@ class ServingLoop:
       stats["paged_path"] = self.paged_path
       stats["scheduler"] = self.sched.Stats()
       stats["kv_pages"] = self.alloc.Stats()
+      stats["mixers"] = dict(self.mixers)
+      if self.state_pool is not None:
+        stats["state_slots"] = self.state_pool.Stats()
     return stats

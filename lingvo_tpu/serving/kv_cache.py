@@ -12,6 +12,15 @@ lowest-numbered free page keeps the live set packed toward the low end of
 the pool — eviction "defragments" by construction (freed high pages sink
 to the back of the heap and are reused last), so a long-running server's
 working set stays dense without ever copying K/V between pages.
+
+O(1)-state mixers (core/ssm.py) need a second, much simpler resource:
+`StateSlotPool`. An SSM layer's decode state is a fixed [B, N, H, S]
+array — one constant-size matrix per batch row, no growth with sequence
+length, nothing to page. Its unit of ownership is the decode SLOT (batch
+row) itself, which the scheduler already assigns; the pool just records
+which sequence holds which slot and prices it in bytes so admission
+accounting and Stats() can compare KV-page HBM against flat mixer-state
+HBM (the ISSUE's more-concurrent-requests-at-fixed-HBM criterion).
 """
 
 from __future__ import annotations
@@ -95,3 +104,66 @@ class PageAllocator:
     for pg in pages:
       heapq.heappush(self._free, pg)
     return len(pages)
+
+
+class StateSlotPool:
+  """Ownership of O(1) mixer-state slots (one per decode batch row).
+
+  Device-side the state is a `[num_slots, ...]` array per SSM layer
+  (ssm.GatedSSMLayer.InitPagedStates); row i belongs to whichever
+  sequence the scheduler placed in decode slot i, and is reset device-
+  side on that sequence's first step (q_pos == 0), so acquisition never
+  touches the device. Like PageAllocator this is host bookkeeping only,
+  serialized by the engine's scheduler lock.
+
+  bytes_per_slot: per-sequence mixer-state HBM across ALL SSM layers
+  (sum of StateBytesPerSlot) — constant in sequence length, which is the
+  whole point; Stats() exposes it next to the allocator's page numbers.
+  """
+
+  def __init__(self, num_slots: int, bytes_per_slot: int):
+    assert num_slots > 0 and bytes_per_slot >= 0, (num_slots, bytes_per_slot)
+    self.num_slots = num_slots
+    self.bytes_per_slot = int(bytes_per_slot)
+    self._slot_of: dict[object, int] = {}
+    self._owner: dict[int, object] = {}
+    self.peak_in_use = 0
+
+  @property
+  def num_in_use(self) -> int:
+    return len(self._slot_of)
+
+  @property
+  def num_free(self) -> int:
+    return self.num_slots - len(self._slot_of)
+
+  def Acquire(self, seq_id, slot: int):
+    """Binds seq_id to decode slot `slot` (must be free)."""
+    assert 0 <= slot < self.num_slots, (slot, self.num_slots)
+    assert slot not in self._owner, (
+        f"slot {slot} already owned by {self._owner[slot]!r}")
+    assert seq_id not in self._slot_of, seq_id
+    self._slot_of[seq_id] = slot
+    self._owner[slot] = seq_id
+    self.peak_in_use = max(self.peak_in_use, self.num_in_use)
+
+  def Release(self, seq_id) -> bool:
+    """Unbinds seq_id's slot. Idempotent, mirroring PageAllocator.Free."""
+    slot = self._slot_of.pop(seq_id, None)
+    if slot is None:
+      return False
+    del self._owner[slot]
+    return True
+
+  def SlotOf(self, seq_id):
+    return self._slot_of.get(seq_id)
+
+  def Stats(self) -> dict:
+    return {
+        "num_slots": self.num_slots,
+        "bytes_per_slot": self.bytes_per_slot,
+        "in_use": self.num_in_use,
+        "free": self.num_free,
+        "peak_in_use": self.peak_in_use,
+        "state_bytes_in_use": self.num_in_use * self.bytes_per_slot,
+    }

@@ -48,10 +48,17 @@ class SeqState(enum.Enum):
 
 
 class Request:
-  """One user request: prompt ids + generation budget."""
+  """One user request: prompt ids + generation budget.
+
+  seed: per-request sampling seed (core/sampling.py row stream). Defaults
+  to the request id for int ids, so every request has a replayable stream
+  even when the caller doesn't pick one: resubmitting with the same seed
+  under the same checkpoint yields the same continuation regardless of
+  which slot or batch neighbors it is scheduled with.
+  """
 
   def __init__(self, req_id, prompt, max_new_tokens: int,
-               eos_id: Optional[int] = None):
+               eos_id: Optional[int] = None, seed: Optional[int] = None):
     prompt = [int(t) for t in prompt]
     assert len(prompt) >= 1, "empty prompt"
     assert max_new_tokens >= 1, max_new_tokens
@@ -59,6 +66,9 @@ class Request:
     self.prompt = prompt
     self.max_new = int(max_new_tokens)
     self.eos_id = eos_id
+    if seed is None:
+      seed = req_id if isinstance(req_id, int) else abs(hash(req_id))
+    self.seed = int(seed) % (2**31)
 
 
 class Sequence:
@@ -84,29 +94,42 @@ class StepBatch:
   """One flattened device step (numpy; the engine jits over it)."""
 
   def __init__(self, ids, q_pos, in_len, rows, mixed: bool,
-               prompt_tokens: int):
+               prompt_tokens: int, row_seeds=None, row_pos=None):
     self.ids = ids          # [B, C] int32
     self.q_pos = q_pos      # [B] int32
     self.in_len = in_len    # [B] int32 (0 = inactive row)
     self.rows = rows        # slot -> Sequence or None, frozen at build time
     self.mixed = mixed      # True if any prefill row rode this step
     self.prompt_tokens = prompt_tokens  # prompt tokens consumed this step
+    # sampling inputs: per-request seed + per-request output index (tokens
+    # generated so far) — together they make each draw a pure function of
+    # (engine seed, request seed, output position), never of scheduling
+    self.row_seeds = row_seeds  # [B] int32
+    self.row_pos = row_pos      # [B] int32
 
 
 class Scheduler:
   """Admission + step building + commit over B slots and a page pool."""
 
   def __init__(self, max_slots: int, allocator: kv_cache.PageAllocator,
-               table_pages: int, prefill_chunk: int):
+               table_pages: int, prefill_chunk: int,
+               needs_kv_pages: bool = True,
+               state_pool: Optional[kv_cache.StateSlotPool] = None):
     """table_pages: block-table width (pages per sequence) — the static
     max_seq_len / page_size bound every compiled program carries.
     prefill_chunk: prompt tokens a prefilling row consumes per mixed step.
+    needs_kv_pages: False for pure-O(1)-mixer stacks (no attention layer
+    writes the paged pool) — admission is then bounded by slots only, and
+    the allocator is never charged. state_pool: slot-ownership accounting
+    for O(1) mixer states (acquired on admit, released on retirement).
     """
     assert max_slots >= 1 and table_pages >= 1 and prefill_chunk >= 1
     self.max_slots = max_slots
     self.alloc = allocator
     self.table_pages = table_pages
     self.prefill_chunk = prefill_chunk
+    self.needs_kv_pages = needs_kv_pages
+    self.state_pool = state_pool
     self.waiting = collections.deque()        # of Sequence (QUEUED)
     self.slots: list[Optional[Sequence]] = [None] * max_slots
     self._by_id: dict[object, Sequence] = {}
@@ -122,6 +145,9 @@ class Scheduler:
   # -- submission ------------------------------------------------------------
 
   def Submit(self, request: Request) -> Sequence:
+    # the max_seq_len capacity bound holds for pageless stacks too: the
+    # compiled step programs still carry table_pages-wide block tables,
+    # and q_pos positions beyond the bound were never validated
     total = len(request.prompt) + request.max_new
     if self.alloc.PagesFor(total) > self.table_pages:
       self.rejected_overlong += 1
@@ -160,6 +186,8 @@ class Scheduler:
       if seq is not None and seq.state is SeqState.CANCELLED:
         self.slots[i] = None
         self.alloc.Free(seq.id)
+        if self.state_pool is not None:
+          self.state_pool.Release(seq.id)
         self.cancelled += 1
         evicted.append(seq)
     return evicted
@@ -174,15 +202,22 @@ class Scheduler:
       if self.slots[i] is not None or not self.waiting:
         continue
       seq = self.waiting[0]
-      need = self.alloc.PagesFor(len(seq.req.prompt) + seq.req.max_new)
-      if not self.alloc.CanAllocate(need):
-        break
-      self.waiting.popleft()
-      pages = self.alloc.Allocate(seq.id, need)
+      if self.needs_kv_pages:
+        need = self.alloc.PagesFor(len(seq.req.prompt) + seq.req.max_new)
+        if not self.alloc.CanAllocate(need):
+          break
+        self.waiting.popleft()
+        pages = self.alloc.Allocate(seq.id, need)
+      else:
+        # pure O(1)-mixer stack: nothing pages, a free slot IS admission
+        self.waiting.popleft()
+        pages = []
       self.slots[i] = seq
       seq.state = SeqState.PREFILL
       self.block_tables[i, :] = 0
       self.block_tables[i, :len(pages)] = pages
+      if self.state_pool is not None:
+        self.state_pool.Acquire(seq.id, i)
       self.admitted += 1
       admitted.append(seq)
     return admitted
@@ -201,11 +236,15 @@ class Scheduler:
     ids = np.zeros((b, c), np.int32)
     q_pos = np.zeros((b,), np.int32)
     in_len = np.zeros((b,), np.int32)
+    row_seeds = np.zeros((b,), np.int32)
+    row_pos = np.zeros((b,), np.int32)
     prompt_tokens = 0
     for i, seq in enumerate(rows):
       if seq is None:
         continue
       q_pos[i] = seq.pos
+      row_seeds[i] = seq.req.seed
+      row_pos[i] = len(seq.out)
       if seq.state is SeqState.PREFILL:
         n = min(c, seq.prompt_remaining)
         ids[i, :n] = seq.req.prompt[seq.pos:seq.pos + n]
@@ -214,7 +253,8 @@ class Scheduler:
       else:  # DECODE: feed the last sampled token (writes it to the cache)
         ids[i, 0] = seq.out[-1]
         in_len[i] = 1
-    return StepBatch(ids, q_pos, in_len, rows, mixed, prompt_tokens)
+    return StepBatch(ids, q_pos, in_len, rows, mixed, prompt_tokens,
+                     row_seeds=row_seeds, row_pos=row_pos)
 
   def CommitStep(self, batch: StepBatch, sampled: np.ndarray) -> list:
     """Folds sampled [B, C] back into the state machine.
@@ -243,6 +283,8 @@ class Scheduler:
       if done_eos or done_len:
         self.slots[i] = None
         self.alloc.Free(seq.id)
+        if self.state_pool is not None:
+          self.state_pool.Release(seq.id)
         self.finished += 1
         self._Retire(seq, SeqState.FINISHED, "eos" if done_eos else "length")
         events.append((seq.id, tok, True))
@@ -254,6 +296,8 @@ class Scheduler:
     seq.state = state
     seq.finish_reason = reason
     self.alloc.Free(seq.id)   # idempotent
+    if self.state_pool is not None:
+      self.state_pool.Release(seq.id)   # idempotent
 
   # -- introspection ---------------------------------------------------------
 
@@ -268,4 +312,5 @@ class Scheduler:
         "finished": self.finished,
         "cancelled": self.cancelled,
         "rejected_overlong": self.rejected_overlong,
+        "needs_kv_pages": self.needs_kv_pages,
     }

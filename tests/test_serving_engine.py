@@ -505,8 +505,9 @@ class TestServingEngine:
     assert telem is not None
     assert set(telem) == {"prefill_s", "decode_s", "total_s",
                           "prompt_tokens", "decode_tokens",
-                          "tokens_per_sec"}
+                          "tokens_per_sec", "decode_state_bytes_per_seq"}
     assert telem["prompt_tokens"] == 7 and telem["decode_tokens"] == 12
+    assert telem["decode_state_bytes_per_seq"] > 0
     assert telem["tokens_per_sec"] > 0
     assert all(r["telemetry"] == telem for r in recs)
 

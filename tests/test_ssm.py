@@ -1,0 +1,631 @@
+"""O(1)-cache sequence mixers (ops/ssd_scan.py + core/ssm.py).
+
+Covers docs/sequence_mixers.md:
+- the four SsdScan lowerings agree: chunked XLA and associative-scan match
+  the sequential reference, and the Pallas twin is BIT-identical to the
+  chunked XLA path (outputs, final state, and every gradient) in interpret
+  mode — the flash_decode twin-lowering contract,
+- the masking contract: padded steps preserve the state bitwise, segment
+  resets isolate packed sequences,
+- GatedSSMLayer streaming equivalence: Prefill over the whole sequence is
+  bitwise FProp, an ExtendStep chain matches FProp, chunked prefill + decode
+  and PagedStep (with slot re-use reset) reproduce the same trajectory,
+- gradients flow through every scan lowering and every layer weight,
+- hybrid TransformerLm stacks (attention every Nth layer) decode through
+  GShardDecode and the continuous-batching engine token-identically to the
+  per-token ExtendStep reference; pure-SSM decode state is flat in max_len
+  while hybrid KV state grows,
+- pure-SSM stacks admit a full batch with a 1-page pool (pageless
+  admission) where the attention twin queues — the more-concurrent-
+  requests-at-fixed-HBM acceptance bar in miniature,
+- temperature/top_k sampling: temperature 0 is token-identical to greedy,
+  per-request seeds replay across batch contexts,
+- larger-shape soaks are marked slow.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import py_utils, sampling, ssm
+from lingvo_tpu.ops import ssd_scan
+
+KEY = jax.random.PRNGKey(11)
+B, T, N, H, S = 2, 13, 3, 8, 4   # deliberately ragged vs chunk sizes
+
+
+def _ScanInputs(key=KEY, b=B, t=T, n=N, h=H, s=S, seed_scale=0.5):
+  k1, k2, k3, k4 = jax.random.split(key, 4)
+  decay_log = -jax.nn.softplus(jax.random.normal(k1, (b, t, n)))
+  b_in = jax.random.normal(k2, (b, t, n, s)) * seed_scale
+  c_in = jax.random.normal(k3, (b, t, n, s)) * seed_scale
+  v = jax.random.normal(k4, (b, t, n, h)) * seed_scale
+  return decay_log, b_in, c_in, v
+
+
+class TestSsdScanOp:
+
+  @pytest.mark.parametrize("lowering", ["chunked", "associative", "pallas"])
+  @pytest.mark.parametrize("chunk", [4, 8])
+  def test_lowerings_match_sequential(self, lowering, chunk):
+    args = _ScanInputs()
+    y_ref, s_ref = ssd_scan.SsdScan(*args, lowering="sequential")
+    y, s_fin = ssd_scan.SsdScan(*args, chunk_size=chunk, lowering=lowering)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_fin), np.asarray(s_ref),
+                               atol=1e-5)
+
+  def test_chunked_equals_pallas_bitwise(self):
+    """The twin-lowering contract: same _ChunkBody floats, same bits."""
+    args = _ScanInputs()
+    s0 = jax.random.normal(jax.random.PRNGKey(5), (B, N, H, S)) * 0.2
+    y_x, s_x = ssd_scan.SsdScan(*args, s0=s0, chunk_size=4,
+                                lowering="chunked")
+    y_p, s_p = ssd_scan.SsdScan(*args, s0=s0, chunk_size=4,
+                                lowering="pallas", interpret=True)
+    np.testing.assert_array_equal(np.asarray(y_x), np.asarray(y_p))
+    np.testing.assert_array_equal(np.asarray(s_x), np.asarray(s_p))
+
+  def test_gradients_chunked_equals_pallas_bitwise(self):
+    """custom_vjp backward (VJP of the chunked XLA path) == chunked grads."""
+    args = _ScanInputs()
+    s0 = jax.random.normal(jax.random.PRNGKey(6), (B, N, H, S)) * 0.2
+
+    def loss(lowering):
+      def f(dl, bb, cc, vv, s0):
+        y, s_fin = ssd_scan.SsdScan(dl, bb, cc, vv, s0=s0, chunk_size=4,
+                                    lowering=lowering, interpret=True)
+        return jnp.sum(y * y) + jnp.sum(s_fin)
+      return jax.grad(f, argnums=(0, 1, 2, 3, 4))(*args, s0)
+
+    g_x = loss("chunked")
+    g_p = loss("pallas")
+    for gx, gp in zip(g_x, g_p):
+      np.testing.assert_array_equal(np.asarray(gx), np.asarray(gp))
+      assert np.isfinite(np.asarray(gx)).all()
+      assert np.abs(np.asarray(gx)).max() > 0
+
+  def test_initial_state_threading(self):
+    """Nonzero s0 rides every lowering identically."""
+    args = _ScanInputs()
+    s0 = jax.random.normal(jax.random.PRNGKey(8), (B, N, H, S))
+    y_ref, s_ref = ssd_scan.SsdScan(*args, s0=s0, lowering="sequential")
+    for lowering in ("chunked", "associative"):
+      y, s_fin = ssd_scan.SsdScan(*args, s0=s0, chunk_size=4,
+                                  lowering=lowering)
+      np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+      np.testing.assert_allclose(np.asarray(s_fin), np.asarray(s_ref),
+                                 atol=1e-5)
+
+  def test_padded_step_is_identity(self):
+    """decay_log = 0 AND v = 0 -> the state passes through bitwise."""
+    decay_log, b_in, c_in, v = _ScanInputs()
+    # make steps 5..8 of every row padding
+    pad = jnp.zeros((B, T, 1))
+    pad = pad.at[:, 5:9].set(1.0)
+    decay_log = decay_log * (1.0 - pad)
+    v = v * (1.0 - pad[..., None])
+    _, s_with = ssd_scan.SsdScan(decay_log[:, :9], b_in[:, :9], c_in[:, :9],
+                                 v[:, :9], lowering="sequential")
+    _, s_without = ssd_scan.SsdScan(decay_log[:, :5], b_in[:, :5],
+                                    c_in[:, :5], v[:, :5],
+                                    lowering="sequential")
+    np.testing.assert_array_equal(np.asarray(s_with), np.asarray(s_without))
+
+  def test_segment_reset_isolates(self):
+    """RESET_LOG at a boundary: the tail behaves like a fresh sequence."""
+    decay_log, b_in, c_in, v = _ScanInputs()
+    t0 = 6
+    decay_log = decay_log.at[:, t0].set(ssd_scan.RESET_LOG)
+    y_packed, s_packed = ssd_scan.SsdScan(decay_log, b_in, c_in, v,
+                                          chunk_size=4, lowering="chunked")
+    y_fresh, s_fresh = ssd_scan.SsdScan(
+        decay_log[:, t0:].at[:, 0].set(ssd_scan.RESET_LOG), b_in[:, t0:],
+        c_in[:, t0:], v[:, t0:], chunk_size=4, lowering="chunked")
+    np.testing.assert_allclose(np.asarray(y_packed[:, t0:]),
+                               np.asarray(y_fresh), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_packed), np.asarray(s_fresh),
+                               atol=1e-5)
+
+  def test_supported_on_tpu_gate(self):
+    assert ssd_scan.SupportedOnTpu(64, 128, 128)
+    assert not ssd_scan.SupportedOnTpu(63, 128, 128)   # chunk % 8
+    assert not ssd_scan.SupportedOnTpu(64, 96, 128)    # state % 128
+    assert not ssd_scan.SupportedOnTpu(64, 128, 96)    # head % 128
+
+  @pytest.mark.slow
+  def test_soak_long_sequence_bitwise_twins(self):
+    """T = 512 / chunk 64 at TPU-eligible dims: twins still bit-equal."""
+    args = _ScanInputs(key=jax.random.PRNGKey(3), b=1, t=512, n=2, h=128,
+                       s=128)
+    y_x, s_x = ssd_scan.SsdScan(*args, chunk_size=64, lowering="chunked")
+    y_p, s_p = ssd_scan.SsdScan(*args, chunk_size=64, lowering="pallas",
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(y_x), np.asarray(y_p))
+    np.testing.assert_array_equal(np.asarray(s_x), np.asarray(s_p))
+    y_ref, s_ref = ssd_scan.SsdScan(*args, lowering="sequential")
+    np.testing.assert_allclose(np.asarray(y_x), np.asarray(y_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s_x), np.asarray(s_ref), atol=1e-4)
+
+
+# -- GatedSSMLayer ------------------------------------------------------------
+
+D = 16
+
+
+def _SsmLayer(**kw):
+  p = ssm.GatedSSMLayer.Params().Set(
+      name="ssm", input_dim=D, hidden_dim=D, num_heads=N, state_dim=S,
+      chunk_size=4, **kw)
+  layer = p.Instantiate()
+  return layer, layer.InstantiateVariables(KEY)
+
+
+class TestGatedSSMLayer:
+
+  def test_prefill_matches_fprop_bitwise(self):
+    """One whole-sequence Prefill == FProp on valid positions, bitwise."""
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (B, T, D))
+    paddings = py_utils.PaddingsFromLengths(jnp.array([T, 9]), T)
+    offline, _ = layer.FProp(theta, x, paddings=paddings, causal=True)
+    states = layer.InitStates(theta, B, T)
+    prefill, states = layer.Prefill(theta, x, states, paddings=paddings)
+    valid = np.asarray(1.0 - paddings)[..., None]
+    np.testing.assert_array_equal(np.asarray(offline) * valid,
+                                  np.asarray(prefill) * valid)
+    assert int(states.time_step) == T
+
+  def test_extend_step_chain_matches_fprop(self):
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (B, T, D))
+    offline, _ = layer.FProp(theta, x, causal=True)
+    states = layer.InitStates(theta, B, T)
+    outs = []
+    for t in range(T):
+      out_t, states = layer.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(out_t)
+    streaming = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(offline), np.asarray(streaming),
+                               atol=1e-5)
+
+  def test_chunked_prefill_then_decode(self):
+    """Prefill in two chunks + ExtendStep tail == one FProp."""
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (B, T, D))
+    offline, _ = layer.FProp(theta, x, causal=True)
+    states = layer.InitStates(theta, B, T)
+    out1, states = layer.Prefill(theta, x[:, :5], states)
+    out2, states = layer.Prefill(theta, x[:, 5:10], states)
+    outs = [out1, out2]
+    for t in range(10, T):
+      out_t, states = layer.ExtendStep(theta, x[:, t:t + 1], states)
+      outs.append(out_t)
+    np.testing.assert_allclose(np.asarray(offline),
+                               np.asarray(jnp.concatenate(outs, axis=1)),
+                               atol=1e-5)
+
+  def test_packed_segments_match_separate(self):
+    """segment_ids reset the recurrence exactly at boundaries."""
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (1, 10, D))
+    seg = jnp.array([[0, 0, 0, 0, 1, 1, 1, 1, 1, 1]])
+    packed, _ = layer.FProp(theta, x, segment_ids=seg, causal=True)
+    first, _ = layer.FProp(theta, x[:, :4], causal=True)
+    second, _ = layer.FProp(theta, x[:, 4:], causal=True)
+    np.testing.assert_allclose(np.asarray(packed[:, :4]), np.asarray(first),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(packed[:, 4:]), np.asarray(second),
+                               atol=1e-5)
+
+  def test_paged_step_matches_extend_chain(self):
+    """PagedStep prefill chunk + decode steps == the ExtendStep trajectory;
+    q_pos == 0 resets a re-used slot even if its state is garbage."""
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (B, 8, D))
+    states = layer.InitStates(theta, B, 8)
+    ref = []
+    for t in range(8):
+      out_t, states = layer.ExtendStep(theta, x[:, t:t + 1], states)
+      ref.append(out_t)
+    ref = jnp.concatenate(ref, axis=1)
+
+    paged = layer.InitPagedStates(theta, num_pages=4, page_size=4,
+                                  num_slots=B)
+    # poison the slot states: the q_pos == 0 reset must erase this
+    paged.state = paged.state + 777.0
+    tables = jnp.zeros((B, 2), jnp.int32)
+    out_pre, paged = layer.PagedStep(
+        theta, x[:, :4], paged, tables, q_pos=jnp.zeros((B,), jnp.int32),
+        in_len=jnp.full((B,), 4, jnp.int32))
+    outs = [out_pre]
+    for t in range(4, 8):
+      out_t, paged = layer.PagedStep(
+          theta, x[:, t:t + 1], paged, tables,
+          q_pos=jnp.full((B,), t, jnp.int32),
+          in_len=jnp.ones((B,), jnp.int32))
+      outs.append(out_t)
+    np.testing.assert_allclose(np.asarray(ref),
+                               np.asarray(jnp.concatenate(outs, axis=1)),
+                               atol=1e-5)
+
+  def test_init_paged_states_requires_num_slots(self):
+    layer, theta = _SsmLayer()
+    with pytest.raises(AssertionError):
+      layer.InitPagedStates(theta, num_pages=4, page_size=4)
+
+  def test_gradients_flow_through_every_weight(self):
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (B, T, D))
+
+    def loss(theta):
+      out, _ = layer.FProp(theta, x, causal=True)
+      return jnp.sum(out * out)
+
+    grads = jax.grad(loss)(theta)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert len(leaves) == 13
+    for g in leaves:
+      assert np.isfinite(np.asarray(g)).all()
+      assert np.abs(np.asarray(g)).max() > 0
+
+  def test_unsupported_modes_raise(self):
+    layer, theta = _SsmLayer()
+    x = jax.random.normal(KEY, (B, T, D))
+    with pytest.raises(ValueError):
+      layer.FProp(theta, x, causal=False)
+    with pytest.raises(NotImplementedError):
+      layer.FProp(theta, x, atten_mask=jnp.zeros((1, 1, T, T)), causal=True)
+    with pytest.raises(NotImplementedError):
+      layer.FProp(theta, x, key_vec=x, value_vec=x, causal=True)
+
+  def test_state_bytes_per_slot(self):
+    layer, theta = _SsmLayer()
+    assert layer.StateBytesPerSlot() == N * (D // N) * S * 4
+    states = layer.InitStates(theta, B, max_len=4096)
+    # O(1): max_len never enters the state shape
+    assert states.state.nbytes == B * layer.StateBytesPerSlot()
+
+
+# -- hybrid TransformerLm stacks ----------------------------------------------
+
+
+def _HybridLmParams(every_n, use_repeat=True, num_layers=2):
+  from lingvo_tpu.models.lm import layers as lm_layers
+  p = lm_layers.TransformerLm.Params().Set(
+      name="lm", vocab_size=64, model_dim=32, num_layers=num_layers,
+      num_heads=2, hidden_dim=64, use_rotary=True,
+      use_repeat_layer=use_repeat,
+      mixer_tpl=ssm.GatedSSMLayer.Params().Set(state_dim=8, chunk_size=4),
+      mixer_atten_every_n=every_n)
+  return p
+
+
+@pytest.fixture(scope="module")
+def hybrid_lm():
+  task = _HybridLmParams(every_n=2).Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  return task, theta
+
+
+@pytest.fixture(scope="module")
+def pure_ssm_lm():
+  task = _HybridLmParams(every_n=0).Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  return task, theta
+
+
+class TestHybridTransformerLm:
+
+  @pytest.mark.parametrize("lm", ["hybrid_lm", "pure_ssm_lm"])
+  def test_extend_chain_matches_fprop(self, lm, request):
+    task, theta = request.getfixturevalue(lm)
+    ids = jax.random.randint(KEY, (B, 8), 0, 64)
+    batch = py_utils.NestedMap(
+        ids=ids, labels=jnp.roll(ids, -1, axis=1),
+        paddings=jnp.zeros((B, 8)), weights=jnp.ones((B, 8)))
+    offline = task.ComputePredictions(theta, batch).logits
+    states = task.InitDecodeState(theta, B, 8)
+    outs = []
+    for t in range(8):
+      logits_t, states = task.ExtendStep(theta, ids[:, t:t + 1], states)
+      outs.append(logits_t[:, None])
+    streaming = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(offline), np.asarray(streaming),
+                               atol=1e-4)
+
+  @pytest.mark.parametrize("lm", ["hybrid_lm", "pure_ssm_lm"])
+  def test_prefill_matches_extend_chain(self, lm, request):
+    task, theta = request.getfixturevalue(lm)
+    ids = jax.random.randint(KEY, (B, 8), 0, 64)
+    states = task.InitDecodeState(theta, B, 8)
+    ref = []
+    for t in range(8):
+      logits_t, states = task.ExtendStep(theta, ids[:, t:t + 1], states)
+      ref.append(logits_t[:, None])
+    ref = jnp.concatenate(ref, axis=1)
+    states2 = task.InitDecodeState(theta, B, 8)
+    logits, _ = task.Prefill(theta, ids, states2, live_len=8)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(logits),
+                               atol=1e-4)
+
+  def test_stacked_hybrid_matches_repeat_hybrid_shapes(self):
+    """The stacked branch builds the same layer pattern as repeat."""
+    task = _HybridLmParams(every_n=2, use_repeat=False).Instantiate()
+    task.FinalizePaths()
+    theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+    ids = jax.random.randint(KEY, (B, 8), 0, 64)
+    states = task.InitDecodeState(theta, B, 8)
+    logits, _ = task.Prefill(theta, ids, states, live_len=8)
+    assert logits.shape == (B, 8, 64)
+    # layer 0 is the SSM mixer, layer 1 the attention layer
+    stack = task.stack
+    assert hasattr(stack.x_layers[0].self_atten.atten, "StateBytesPerSlot")
+    assert not hasattr(stack.x_layers[1].self_atten.atten,
+                       "StateBytesPerSlot")
+
+  def test_decode_state_flat_for_ssm_grows_for_attention(self, hybrid_lm,
+                                                         pure_ssm_lm):
+    """The O(1) property, measured: pure-SSM decode state is max_len-
+    independent; the hybrid's growth is entirely the attention share."""
+    def state_bytes(task, theta, max_len):
+      states = jax.eval_shape(
+          lambda th: task.InitDecodeState(th, 4, max_len), theta)
+      return sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                 for x in jax.tree_util.tree_leaves(states))
+
+    task_h, theta_h = hybrid_lm
+    task_s, theta_s = pure_ssm_lm
+    assert state_bytes(task_s, theta_s, 64) == state_bytes(task_s, theta_s,
+                                                          1024)
+    h64, h1024 = state_bytes(task_h, theta_h, 64), state_bytes(
+        task_h, theta_h, 1024)
+    assert h1024 > h64
+    # the growth is exactly the attention KV share: 1 layer x K+V x
+    # [4, dT, 2, 16] f32
+    assert h1024 - h64 == 2 * 4 * (1024 - 64) * 32 * 4
+
+  def test_gradients_flow(self, hybrid_lm):
+    task, theta = hybrid_lm
+    ids = jax.random.randint(KEY, (B, 8), 0, 64)
+    batch = py_utils.NestedMap(
+        ids=ids, labels=jnp.roll(ids, -1, axis=1),
+        paddings=jnp.zeros((B, 8)), weights=jnp.ones((B, 8)))
+
+    def loss(theta):
+      logits = task.ComputePredictions(theta, batch).logits
+      return jnp.sum(jax.nn.logsumexp(logits, axis=-1))
+
+    grads = jax.grad(loss)(theta)
+    for g in jax.tree_util.tree_leaves(grads):
+      assert np.isfinite(np.asarray(g)).all()
+
+
+# -- GShardDecode + serving engine over hybrid stacks -------------------------
+
+
+class TestHybridDecodePaths:
+
+  def test_gshard_decode_matches_per_token_reference(self, hybrid_lm,
+                                                     tmp_path):
+    """The tentpole acceptance bar: the hybrid stack decodes through
+    GShardDecode UNCHANGED, token-identical to a hand-rolled per-token
+    greedy rollout."""
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+    from lingvo_tpu.runners import gshard_decode
+
+    task, theta = hybrid_lm
+    state = task.CreateTrainState(jax.random.PRNGKey(3))
+    train_dir = str(tmp_path / "train")
+    ckpt = checkpointer_lib.Checkpointer(train_dir)
+    ckpt.Save(1, state, force=True)
+    ckpt.Close()
+    prompts = np.array([[5, 6, 7, 8], [9, 10, 11, 12]], np.int32)
+    lens = np.array([4, 4], np.int32)
+    max_new = 5
+    driver = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "out.jsonl"),
+        max_decode_steps=max_new, len_buckets=(4,))
+    recs = driver.DecodeOnce(1, prompts, lens)
+
+    # per-token reference: teacher-force the prompt, then greedy argmax
+    states = task.InitDecodeState(state.theta, 2, 4 + max_new)
+    logits = None
+    for t in range(4):
+      logits, states = task.ExtendStep(state.theta, prompts[:, t:t + 1],
+                                       states)
+    out = []
+    for _ in range(max_new):
+      nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+      out.append(np.asarray(nxt))
+      logits, states = task.ExtendStep(state.theta, nxt[:, None], states)
+    ref = np.stack(out, axis=1)
+    for i, rec in enumerate(recs):
+      assert rec["output_ids"] == list(ref[i]), i
+    # the telemetry satellite rides the same call
+    assert driver._last_telemetry["decode_state_bytes_per_seq"] > 0
+
+  def test_engine_matches_gshard_decode(self, hybrid_lm, tmp_path):
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+    from lingvo_tpu.runners import gshard_decode
+    from lingvo_tpu.serving import engine as engine_lib
+
+    task, theta = hybrid_lm
+    state = task.CreateTrainState(jax.random.PRNGKey(3))
+    train_dir = str(tmp_path / "train")
+    ckpt = checkpointer_lib.Checkpointer(train_dir)
+    ckpt.Save(1, state, force=True)
+    ckpt.Close()
+    prompts = np.array([[5, 6, 7, 8], [9, 10, 0, 0], [11, 0, 0, 0]],
+                       np.int32)
+    lens = np.array([4, 2, 1], np.int32)
+    driver = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "out.jsonl"), max_decode_steps=4)
+    recs = driver.DecodeOnce(1, prompts, lens)
+    eng = engine_lib.ServingLoop(
+        task, state.theta, page_size=4, num_pages=8, max_batch=3,
+        max_seq_len=8, prefill_chunk=4, default_max_new=4)
+    assert eng.mixers == {"num_attention": 1, "num_ssm": 1,
+                          "decode_state_bytes_per_slot":
+                              eng.state_pool.bytes_per_slot}
+    out = eng.RunBatch(prompts, lens, 4)
+    for i, rec in enumerate(recs):
+      assert list(out[i]) == rec["output_ids"], f"row {i}"
+    stats = eng.Stats()
+    assert stats["scheduler"]["needs_kv_pages"] is True
+    assert stats["state_slots"]["peak_in_use"] == 3
+    assert stats["state_slots"]["in_use"] == 0   # released on retirement
+
+  def test_pure_ssm_pageless_admission(self, pure_ssm_lm):
+    """Fixed-HBM acceptance in miniature: with a pool that only fits ONE
+    attention sequence, the pure-SSM stack still runs the whole batch
+    concurrently — admission is slot-bound, the allocator never charged."""
+    from lingvo_tpu.serving import engine as engine_lib
+
+    task, theta = pure_ssm_lm
+    prompts = np.array([[5, 6, 7, 8], [9, 10, 11, 12], [13, 14, 15, 16]],
+                       np.int32)
+    lens = np.array([4, 4, 4], np.int32)
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=4, num_pages=2, max_batch=3,
+        max_seq_len=8, prefill_chunk=4, default_max_new=4)
+    assert eng.paged_path == "ssm"
+    for i in range(3):
+      eng.Submit(prompts[i], 4, eos_id=None)
+    eng.StepOnce()
+    stats = eng.Stats()
+    assert stats["scheduler"]["slots_live"] == 3       # all admitted at once
+    assert stats["kv_pages"]["peak_in_use"] == 0       # pool untouched
+    # the attention twin under the SAME pool admits only one at a time
+    atten_task = _HybridLmParams(every_n=1).Instantiate()
+    atten_task.FinalizePaths()
+    atten_theta = atten_task.InstantiateVariables(jax.random.PRNGKey(0))
+    eng_a = engine_lib.ServingLoop(
+        atten_task, atten_theta, page_size=4, num_pages=2, max_batch=3,
+        max_seq_len=8, prefill_chunk=4, default_max_new=4)
+    for i in range(3):
+      eng_a.Submit(prompts[i], 4, eos_id=None)
+    eng_a.StepOnce()
+    assert eng_a.Stats()["scheduler"]["slots_live"] == 1
+
+  def test_more_decode_tokens_per_pool(self, pure_ssm_lm):
+    """And it finishes: 6 requests through 3 slots on a 2-page pool."""
+    from lingvo_tpu.serving import engine as engine_lib
+
+    task, theta = pure_ssm_lm
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=4, num_pages=2, max_batch=3,
+        max_seq_len=8, prefill_chunk=4, default_max_new=3)
+    handles = [eng.Submit([3 + i, 4 + i], 3, eos_id=None) for i in range(6)]
+    while True:
+      with eng._lock:
+        if not eng.sched.HasWork():
+          break
+      eng.StepOnce()
+    for h in handles:
+      assert len(h.Result(timeout=0)) == 3
+    assert eng.Stats()["scheduler"]["finished"] == 6
+
+
+# -- sampling -----------------------------------------------------------------
+
+
+class TestSampling:
+
+  def test_temperature_zero_is_argmax(self):
+    logits = jax.random.normal(KEY, (4, 32))
+    got = sampling.SampleFromLogits(logits, KEY, temperature=0.0, top_k=3)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(jnp.argmax(logits, axis=-1)))
+
+  def test_top_k_one_is_argmax_at_any_temperature(self):
+    logits = jax.random.normal(KEY, (4, 32))
+    got = sampling.SampleFromLogits(logits, KEY, temperature=7.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(jnp.argmax(logits, axis=-1)))
+
+  def test_top_k_restricts_support(self):
+    logits = jax.random.normal(KEY, (4, 32))
+    top3 = np.asarray(jax.lax.top_k(logits, 3)[1])
+    for i in range(30):
+      got = np.asarray(sampling.SampleFromLogits(
+          logits, jax.random.PRNGKey(i), temperature=2.0, top_k=3))
+      for r in range(4):
+        assert got[r] in top3[r]
+
+  def test_row_seeds_make_rows_batch_independent(self):
+    logits = jax.random.normal(KEY, (4, 32))
+    seeds = jnp.array([7, 8, 9, 10], jnp.int32)
+    full = sampling.SampleFromLogits(logits, KEY, temperature=1.0,
+                                     row_seeds=seeds)
+    sub = sampling.SampleFromLogits(logits[1:3], KEY, temperature=1.0,
+                                    row_seeds=seeds[1:3])
+    np.testing.assert_array_equal(np.asarray(full)[1:3], np.asarray(sub))
+
+  def test_positions_vary_the_stream(self):
+    logits = jnp.zeros((2, 64))   # uniform: draws depend only on the key
+    seeds = jnp.array([5, 5], jnp.int32)
+    a = sampling.SampleFromLogits(logits, KEY, temperature=1.0,
+                                  row_seeds=seeds,
+                                  positions=jnp.array([0, 1], jnp.int32))
+    # same seed, different position -> (almost surely) different draw;
+    # same seed, same position -> identical draw
+    b = sampling.SampleFromLogits(logits, KEY, temperature=1.0,
+                                  row_seeds=seeds,
+                                  positions=jnp.array([0, 0], jnp.int32))
+    assert int(a[0]) == int(b[0]) == int(b[1])
+
+  def test_gshard_decode_temp0_with_topk_identical_to_greedy(self, tmp_path):
+    """The satellite bar: sampling params at temperature 0 are a no-op."""
+    from lingvo_tpu.core import checkpointer as checkpointer_lib
+    from lingvo_tpu.models.lm import layers as lm_layers
+    from lingvo_tpu.runners import gshard_decode
+
+    task = lm_layers.TransformerLm.Params().Set(
+        name="lm", vocab_size=64, model_dim=32, num_layers=1, num_heads=2,
+        hidden_dim=64, use_rotary=True).Instantiate()
+    task.FinalizePaths()
+    state = task.CreateTrainState(jax.random.PRNGKey(3))
+    train_dir = str(tmp_path / "train")
+    ckpt = checkpointer_lib.Checkpointer(train_dir)
+    ckpt.Save(1, state, force=True)
+    ckpt.Close()
+    prompts = np.array([[5, 6, 7, 8]], np.int32)
+    lens = np.array([4], np.int32)
+    greedy = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "g.jsonl"), max_decode_steps=4)
+    sampled = gshard_decode.GShardDecode(
+        task, train_dir, str(tmp_path / "s.jsonl"), max_decode_steps=4,
+        temperature=0.0, top_k=5)
+    r_g = greedy.DecodeOnce(1, prompts, lens)
+    r_s = sampled.DecodeOnce(1, prompts, lens)
+    assert r_g[0]["output_ids"] == r_s[0]["output_ids"]
+
+  def test_engine_seeded_sampling_replays_across_batches(self, hybrid_lm):
+    """Same per-request seed -> same continuation, alone or with
+    neighbors in flight (the per-request stream satellite)."""
+    from lingvo_tpu.serving import engine as engine_lib
+
+    task, theta = hybrid_lm
+
+    def drain(eng):
+      while True:
+        with eng._lock:
+          if not eng.sched.HasWork():
+            return
+        eng.StepOnce()
+
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=4, num_pages=8, max_batch=3, max_seq_len=8,
+        prefill_chunk=4, default_max_new=4, temperature=0.9, top_k=16)
+    h_alone = eng.Submit([5, 6, 7], 4, eos_id=None, seed=42)
+    drain(eng)
+    alone = h_alone.Result(timeout=0)
+    for i in range(2):   # neighbors with different seeds
+      eng.Submit([9 + i, 10 + i], 4, eos_id=None, seed=100 + i)
+    h_again = eng.Submit([5, 6, 7], 4, eos_id=None, seed=42)
+    drain(eng)
+    assert h_again.Result(timeout=0) == alone
