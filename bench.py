@@ -560,6 +560,168 @@ def _BenchServing(jax, jnp, model_registry, on_tpu):
   }
 
 
+def _BenchQuantServing(jax, jnp, model_registry, on_tpu):
+  """f32 vs int8-KV serving engines at the SAME HBM byte budget.
+
+  Both engines (serving/engine.py + quant/) get a page pool priced at the
+  bytes the f32 engine's pool costs; the int8 engine's smaller
+  kv_bytes_per_token (per-page-per-head scale sidecars included) buys it
+  ~3x the pages. The same seeded Poisson request stream is played against
+  each in real time. Acceptance keys: `kv_bytes_per_token_ratio` (the
+  compression the sidecars actually leave), `score_delta_mean_abs`
+  (teacher-forced next-token log-prob delta through the quantized decode
+  cache — plain ScoreSequences never reads the KV cache, so the delta is
+  measured through ExtendStep), `greedy_tokens_match` on fixed prompts,
+  and the int8 engine's tokens/sec, which must not fall below f32's.
+  """
+  from lingvo_tpu.quant import kv as kv_quant
+  from lingvo_tpu.serving import engine as engine_lib
+
+  rng = np.random.RandomState(0)
+  if on_tpu:
+    n_req, b_slots, page, max_seq = 32, 8, 128, 1024
+    p_lo, p_hi, o_lo, o_hi = 16, 256, 16, 256
+    mean_gap_s = 0.005
+  else:
+    n_req, b_slots, page, max_seq = 16, 4, 8, 64
+    p_lo, p_hi, o_lo, o_hi = 4, 32, 2, 32
+    mean_gap_s = 0.005
+
+  mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  mp.task.use_rotary = True
+  if on_tpu:
+    mp.task.model_dim = 512
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 1024
+  else:
+    mp.task.model_dim = 256
+    mp.task.num_layers = 4
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 512
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  vocab = task.p.vocab_size
+
+  prompts = [rng.randint(1, vocab, rng.randint(p_lo, p_hi + 1)).astype(
+      np.int32) for _ in range(n_req)]
+  max_news = rng.randint(o_lo, o_hi + 1, n_req)
+  arrivals = np.concatenate(
+      [[0.0], np.cumsum(rng.exponential(mean_gap_s, n_req - 1))])
+  total_useful = int(np.sum(max_news))
+
+  # equal-HBM sizing: the f32 engine's pool bytes are the budget; int8's
+  # smaller per-token footprint converts the same bytes into more pages
+  bpt_f32 = kv_quant.StackKvCensus(task)["kv_bytes_per_token"]
+  bpt_int8 = kv_quant.StackKvCensus(task, "int8")["kv_bytes_per_token"]
+  pages_per_seq = -(-max_seq // page)
+  pages_f32 = b_slots * pages_per_seq
+  budget_bytes = pages_f32 * page * bpt_f32
+  pages_int8 = int(budget_bytes // (page * bpt_int8))
+
+  fixed_rows = [[5, 9, 2, 33, 17], [7, 7, 7]]
+  fixed_prompts = np.zeros((2, 5), np.int32)
+  fixed_lens = np.array([5, 3], np.int32)
+  for i, r in enumerate(fixed_rows):
+    fixed_prompts[i, :len(r)] = r
+
+  def _Play(kv_cache_dtype, num_pages):
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=page, num_pages=num_pages,
+        max_batch=b_slots, max_seq_len=max_seq,
+        prefill_chunk=16 if on_tpu else 4,
+        kv_cache_dtype=kv_cache_dtype)
+    # fixed-prompt greedy streams (also compiles both step programs, so
+    # the timed stream below starts warm)
+    greedy = np.asarray(eng.RunBatch(fixed_prompts, fixed_lens, 8))
+    eng.Start()
+    t0 = time.perf_counter()
+    handles = []
+    for i in range(n_req):
+      dt = t0 + arrivals[i] - time.perf_counter()
+      if dt > 0:
+        time.sleep(dt)
+      handles.append(eng.Submit(prompts[i], int(max_news[i])))
+    for h in handles:
+      h.Result(timeout=1200)
+    wall = time.perf_counter() - t0
+    lat = np.array([h.finish_time - h.submit_time for h in handles])
+    stats = eng.Stats()
+    eng.Stop()
+    return greedy, wall, lat, stats
+
+  g_f, wall_f, lat_f, stats_f = _Play(None, pages_f32)
+  g_8, wall_8, lat_8, stats_8 = _Play("int8", pages_int8)
+
+  # teacher-forced decode-path log-prob delta (the numerics-contract
+  # number docs/quantized_serving.md bounds)
+  mp.task.kv_cache_dtype = "int8"
+  task8 = mp.task.Instantiate()
+  task8.FinalizePaths()
+  ids = jnp.asarray(rng.randint(1, vocab, size=(2, 24)), jnp.int32)
+
+  def _Score(tk):
+    @jax.jit
+    def run(theta, ids):
+      b, t = ids.shape
+      states = tk.InitDecodeState(theta, b, t)
+
+      def _Step(states, ids_t):
+        logits, states = tk.ExtendStep(theta, ids_t[:, None], states)
+        return states, jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+
+      _, logps = jax.lax.scan(_Step, states, ids.swapaxes(0, 1))
+      logps = logps.swapaxes(0, 1)
+      return jnp.take_along_axis(logps[:, :-1], ids[:, 1:, None],
+                                 axis=-1)[..., 0]
+
+    return np.asarray(run(theta, ids))
+
+  score_delta = float(np.mean(np.abs(_Score(task8) - _Score(task))))
+
+  def _Lat(lat):
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1),
+    }
+
+  tps_f = total_useful / wall_f
+  tps_8 = total_useful / wall_8
+  return {
+      "requests": n_req,
+      "useful_tokens": total_useful,
+      "slots": b_slots,
+      "page_size": page,
+      "budget_bytes": budget_bytes,
+      "kv_bytes_per_token": {"f32": bpt_f32, "int8": bpt_int8},
+      "kv_bytes_per_token_ratio": round(bpt_f32 / bpt_int8, 3),
+      "pages": {"f32": pages_f32, "int8": pages_int8},
+      "greedy_tokens_match": bool(np.array_equal(g_f, g_8)),
+      "score_delta_mean_abs": round(score_delta, 6),
+      "f32_engine": {
+          "paged_path": stats_f["paged_path"],
+          "wall_s": round(wall_f, 3),
+          "tokens_per_sec": round(tps_f, 1),
+          "latency": _Lat(lat_f),
+          "dense_fallback_steps": stats_f["dense_fallback_steps"],
+      },
+      "int8_engine": {
+          "paged_path": stats_8["paged_path"],
+          "wall_s": round(wall_8, 3),
+          "tokens_per_sec": round(tps_8, 1),
+          "latency": _Lat(lat_8),
+          "dense_fallback_steps": stats_8["dense_fallback_steps"],
+          "quantized_steps": stats_8["quantized_steps"],
+          "kv_page_peak_utilization": round(
+              stats_8["kv_pages"]["peak_in_use"]
+              / stats_8["kv_pages"]["num_pages"], 3),
+      },
+      "tokens_per_sec_ratio_int8_vs_f32": round(tps_8 / max(tps_f, 1e-9), 3),
+  }
+
+
 def _BenchFusedXent(jax, jnp, model_registry, on_tpu):
   """Dense vs fused blockwise LM-head xent (ops/fused_xent.py): full
   train-step time and peak memory at vocab 32k / 128k.
@@ -1229,6 +1391,8 @@ def main():
       ("flash_attention", lambda: _BenchFlashAttention(jax, jnp, on_tpu)),
       ("decode", lambda: _BenchDecode(jax, jnp, model_registry, on_tpu)),
       ("serving", lambda: _BenchServing(jax, jnp, model_registry, on_tpu)),
+      ("quant_serving",
+       lambda: _BenchQuantServing(jax, jnp, model_registry, on_tpu)),
       ("fused_xent",
        lambda: _BenchFusedXent(jax, jnp, model_registry, on_tpu)),
       ("input_pipeline",

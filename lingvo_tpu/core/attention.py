@@ -26,8 +26,10 @@ import jax.numpy as jnp
 from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import layers as layers_lib
 from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import quant_utils
 from lingvo_tpu.core.nested_map import NestedMap
 from lingvo_tpu.core.py_utils import WeightInit, WeightParams
+from lingvo_tpu.quant import kv as kv_quant
 
 _NEG_INF = -2.3819763e38  # lowest bf16-safe additive mask value / 100
 
@@ -112,6 +114,14 @@ class MultiHeadedAttention(base_layer.BaseLayer):
         "numerics). Requires max_len % decode_page_size == 0 and no "
         "rel-pos bias / logit cap / prob quantization; ineligible configs "
         "fall back to the dense path.")
+    p.Define(
+        "kv_cache_dtype", None,
+        "Storage dtype for the decode KV caches (dense ExtendStep cache "
+        "and the block-table page pool): None/'' = fprop dtype (bit-exact "
+        "legacy caches), 'float32'/'bfloat16' = plain storage cast, "
+        "'int8' = quantize-on-write with per-token-per-head f32 scale "
+        "sidecars and dequantize-on-read (lingvo_tpu/quant/kv.py). "
+        "Training FProp never touches this.")
     p.Define("rel_pos_emb_dim", 0,
              "If >0, learned relative position bias buckets (T5-style).")
     p.Define("rel_pos_max_distance", 128, "Relative bucket clip distance.")
@@ -187,16 +197,30 @@ class MultiHeadedAttention(base_layer.BaseLayer):
 
   def _HeadsProj(self, theta, name, x):
     th = self.CastTheta(theta)
-    out = jnp.einsum("BTD,DNH->BTNH", self.ToFPropDtype(x),
-                     self._QProjWeight(theta, th[f"w_{name}"]))
+    w = th[f"w_{name}"]
+    if isinstance(w, quant_utils.Int8Weight):
+      # int8-serving theta: [B,T,D] x int8 [D,N,H] on the MXU ('dv' layout,
+      # per-(N,H)-channel scales). Fake-quant domains don't compose with
+      # the real integer path.
+      assert self.p.qdomain_weight is None
+      out = w.Einsum(self.ToFPropDtype(x))
+    else:
+      out = jnp.einsum("BTD,DNH->BTNH", self.ToFPropDtype(x),
+                       self._QProjWeight(theta, w))
     if self.p.use_bias:
       out = out + th[f"b_{name}"]
     return out
 
   def _PostProj(self, theta, ctx):
     th = self.CastTheta(theta)
-    out = jnp.einsum("BTNH,DNH->BTD", ctx,
-                     self._QProjWeight(theta, th.w_post))
+    w = th.w_post
+    if isinstance(w, quant_utils.Int8Weight):
+      # [B,T,N,H] contracts (N, H) against int8 [D,N,H] ('vd' layout,
+      # per-D-channel scales).
+      assert self.p.qdomain_weight is None
+      out = w.Einsum(ctx)
+    else:
+      out = jnp.einsum("BTNH,DNH->BTD", ctx, self._QProjWeight(theta, w))
     if self.p.use_bias:
       out = out + th.b_post
     return out
@@ -383,13 +407,35 @@ class MultiHeadedAttention(base_layer.BaseLayer):
 
   # -- incremental decode ----------------------------------------------------
 
+  def _KvDtype(self, kv_cache_dtype=None):
+    """(cache storage dtype, quantized?) — an explicit override beats the
+    layer param; None/'' on both means the legacy fprop-dtype cache."""
+    return kv_quant.ResolveKvCacheDtype(
+        kv_cache_dtype or self.p.kv_cache_dtype, self.fprop_dtype)
+
+  def KvCacheDtype(self, kv_cache_dtype=None) -> str:
+    """The effective cache storage dtype name (telemetry)."""
+    return str(self._KvDtype(kv_cache_dtype)[0])
+
+  def KvBytesPerToken(self, kv_cache_dtype=None) -> int:
+    """K + V bytes per cached token in this layer, scale sidecars included."""
+    return kv_quant.KvBytesPerToken(self.p.num_heads, self._dim_per_head,
+                                    kv_cache_dtype or self.p.kv_cache_dtype,
+                                    self.fprop_dtype)
+
   def InitStates(self, theta, batch_size: int, max_len: int) -> NestedMap:
     n, h = self.p.num_heads, self._dim_per_head
-    dtype = self.fprop_dtype
-    return NestedMap(
+    dtype, quantized = self._KvDtype()
+    states = NestedMap(
         key=jnp.zeros((batch_size, max_len, n, h), dtype),
         value=jnp.zeros((batch_size, max_len, n, h), dtype),
         time_step=jnp.zeros((), jnp.int32))
+    if quantized:
+      # per-token-per-head f32 scales; unwritten slots stay (0, scale 0) ->
+      # dequantize to exact zeros, and are masked anyway.
+      states.key_scale = jnp.zeros((batch_size, max_len, n), jnp.float32)
+      states.value_scale = jnp.zeros((batch_size, max_len, n), jnp.float32)
+    return states
 
   def PagedDecodeEligible(self, max_len: int) -> bool:
     """The paged flash-decode kernel handles plain masked softmax attention
@@ -417,13 +463,21 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       q = self.rotary.FProp(rt, q, position=pos)
       k_new = self.rotary.FProp(rt, k_new, position=pos)
     q = self._ScaleQuery(theta, q)
+    quantized = "key_scale" in cached_states
+    if quantized:
+      k_new, k_s = kv_quant.QuantizeKv(k_new)              # int8, [B,1,N]
+      v_new, v_s = kv_quant.QuantizeKv(v_new)
+      key_scale = jax.lax.dynamic_update_slice_in_dim(
+          cached_states.key_scale, k_s, t, axis=1)
+      value_scale = jax.lax.dynamic_update_slice_in_dim(
+          cached_states.value_scale, v_s, t, axis=1)
     key_cache = jax.lax.dynamic_update_slice_in_dim(
         cached_states.key, k_new.astype(cached_states.key.dtype), t, axis=1)
     value_cache = jax.lax.dynamic_update_slice_in_dim(
         cached_states.value, v_new.astype(cached_states.value.dtype), t,
         axis=1)
     max_len = key_cache.shape[1]
-    if self.PagedDecodeEligible(max_len):
+    if self.PagedDecodeEligible(max_len) and not quantized:
       # length-aware paged read: only cache pages up to time_step are
       # touched (O(t) per step instead of O(max_len)); q carries the
       # learned scale already, the kernel applies none.
@@ -432,14 +486,24 @@ class MultiHeadedAttention(base_layer.BaseLayer):
           q, key_cache, value_cache, t,
           page_size=self.p.decode_page_size, cache_paddings=paddings)
     else:
-      # mask out future (and unwritten) positions
+      # mask out future (and unwritten) positions; quantized caches
+      # dequantize-on-read and run the dense einsum path (the contiguous
+      # flash_decode kernel has no scale plumbing — the block-table kernel
+      # in PagedStep is the quantized hot path).
+      k_read, v_read = key_cache, value_cache
+      if quantized:
+        k_read = kv_quant.DequantKv(key_cache, key_scale)
+        v_read = kv_quant.DequantKv(value_cache, value_scale)
       pos_ids = jnp.arange(max_len)[None, None, None, :]
       mask = jnp.where(pos_ids <= t, 0.0, _NEG_INF)
       if paddings is not None:
         mask = mask + PaddingsToMask(paddings)
-      ctx, _ = self._Atten(theta, q, key_cache, value_cache, mask)
+      ctx, _ = self._Atten(theta, q, k_read, v_read, mask)
     new_states = NestedMap(
         key=key_cache, value=value_cache, time_step=t + 1)
+    if quantized:
+      new_states.key_scale = key_scale
+      new_states.value_scale = value_scale
     return self._PostProj(theta, ctx), new_states
 
   def Prefill(self, theta, query_vec, cached_states: NestedMap,
@@ -473,12 +537,24 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       q = self.rotary.FProp(rt, q, position=pos)
       k_new = self.rotary.FProp(rt, k_new, position=pos)
     q = self._ScaleQuery(theta, q)
+    quantized = "key_scale" in cached_states
+    if quantized:
+      k_new, k_s = kv_quant.QuantizeKv(k_new)              # int8, [B,C,N]
+      v_new, v_s = kv_quant.QuantizeKv(v_new)
+      key_scale = jax.lax.dynamic_update_slice_in_dim(
+          cached_states.key_scale, k_s, t, axis=1)
+      value_scale = jax.lax.dynamic_update_slice_in_dim(
+          cached_states.value_scale, v_s, t, axis=1)
     key_cache = jax.lax.dynamic_update_slice_in_dim(
         cached_states.key, k_new.astype(cached_states.key.dtype), t, axis=1)
     value_cache = jax.lax.dynamic_update_slice_in_dim(
         cached_states.value, v_new.astype(cached_states.value.dtype), t,
         axis=1)
     live = key_cache.shape[1] if live_len is None else live_len
+    k_read, v_read = key_cache[:, :live], value_cache[:, :live]
+    if quantized:
+      k_read = kv_quant.DequantKv(k_read, key_scale[:, :live])
+      v_read = kv_quant.DequantKv(v_read, value_scale[:, :live])
     # query i (global slot t+i) sees slot s iff s <= t+i (causal within the
     # chunk + everything already cached); unwritten tail slots masked.
     slot = jnp.arange(live)[None, None, None, :]
@@ -486,16 +562,19 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     mask = jnp.where(slot <= qpos, 0.0, _NEG_INF)
     if paddings is not None:
       mask = mask + PaddingsToMask(paddings[:, :live])
-    ctx, _ = self._Atten(theta, q, key_cache[:, :live], value_cache[:, :live],
-                         mask)
+    ctx, _ = self._Atten(theta, q, k_read, v_read, mask)
     new_states = NestedMap(
         key=key_cache, value=value_cache, time_step=t + c)
+    if quantized:
+      new_states.key_scale = key_scale
+      new_states.value_scale = value_scale
     return self._PostProj(theta, ctx), new_states
 
   # -- block-table paged decode (serving engine) -----------------------------
 
   def InitPagedStates(self, theta, num_pages: int, page_size: int,
-                      num_slots: int = 0) -> NestedMap:
+                      num_slots: int = 0,
+                      kv_cache_dtype: str | None = None) -> NestedMap:
     """Global KV page pool [num_pages, page_size, N, H] shared by all
     sequences; which pages belong to whom lives host-side in the serving
     engine's block tables, so there is no time_step here (per-sequence
@@ -503,13 +582,20 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     the trash page that padding-token writes scatter into — allocate with
     one extra page and never hand page num_pages-1 to the allocator.
     num_slots is the engine slot count, consumed by O(1)-state mixers
-    (ssm.GatedSSMLayer) and ignored here."""
+    (ssm.GatedSSMLayer) and ignored here. kv_cache_dtype overrides the
+    layer's p.kv_cache_dtype; 'int8' adds the [num_pages, N, page_size]
+    f32 scale sidecars (transposed so the Pallas scale block's minor dim
+    is page_size — see lingvo_tpu/quant/kv.py)."""
     del theta, num_slots
     n, h = self.p.num_heads, self._dim_per_head
-    dtype = self.fprop_dtype
-    return NestedMap(
+    dtype, quantized = self._KvDtype(kv_cache_dtype)
+    states = NestedMap(
         key=jnp.zeros((num_pages, page_size, n, h), dtype),
         value=jnp.zeros((num_pages, page_size, n, h), dtype))
+    if quantized:
+      states.key_scale = jnp.zeros((num_pages, n, page_size), jnp.float32)
+      states.value_scale = jnp.zeros((num_pages, n, page_size), jnp.float32)
+    return states
 
   def BlockDecodeEligible(self, page_size: int) -> bool:
     """Same gate family as PagedDecodeEligible, for the block-table kernel:
@@ -520,6 +606,22 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     if jax.default_backend() == "tpu":
       from lingvo_tpu.ops import block_decode
       if not block_decode.SupportedOnTpu(page_size, self._dim_per_head):
+        return False
+    return (page_size > 0 and p.rel_pos_emb_dim == 0
+            and p.atten_logit_cap == 0 and p.atten_dropout_prob == 0.0
+            and p.qdomain_softmax is None)
+
+  def QuantizedDecodeEligible(self, page_size: int) -> bool:
+    """Whether the int8 block-table kernels can serve this layer: the
+    BlockDecodeEligible gate plus the int8-aware TPU tiling check. An
+    int8 pool that fails this gate still decodes correctly — PagedStep
+    gathers, dequantizes, and runs the dense einsum path — but the engine
+    reports the step as 'dense' so the fallback is never silent."""
+    p = self.p
+    if jax.default_backend() == "tpu":
+      from lingvo_tpu.ops import block_decode
+      if not block_decode.SupportedOnTpu(page_size, self._dim_per_head,
+                                         kv_dtype="int8"):
         return False
     return (page_size > 0 and p.rel_pos_emb_dim == 0
             and p.atten_logit_cap == 0 and p.atten_dropout_prob == 0.0
@@ -573,18 +675,35 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     phys = jnp.where(valid, phys, np_total - 1)
     off = jnp.where(valid, pos_i % page_size,
                     jnp.arange(c, dtype=jnp.int32)[None] % page_size)
+    quantized = "key_scale" in cached_states
+    k_scale = v_scale = None
+    if quantized:
+      # quantize-on-write: each token row gets its own per-head scale, so
+      # the scatter below is the ONLY write this token's page ever sees —
+      # no page-level re-quantization. Sidecar layout [NP, N, P]: the two
+      # advanced indices (phys, off) around the head slice broadcast to
+      # the front, so the update shape is [B, C, N] == the scale shape.
+      k_new, k_s = kv_quant.QuantizeKv(k_new)              # int8, [B,C,N]
+      v_new, v_s = kv_quant.QuantizeKv(v_new)
+      k_scale = cached_states.key_scale.at[phys, :, off].set(k_s)
+      v_scale = cached_states.value_scale.at[phys, :, off].set(v_s)
     k_pool = k_pool.at[phys, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[phys, off].set(v_new.astype(v_pool.dtype))
     new_states = NestedMap(key=k_pool, value=v_pool)
-    if self.BlockDecodeEligible(page_size):
+    if quantized:
+      new_states.key_scale = k_scale
+      new_states.value_scale = v_scale
+    eligible = (self.QuantizedDecodeEligible(page_size) if quantized
+                else self.BlockDecodeEligible(page_size))
+    if eligible:
       if c == 1:
         ctx = block_decode.BlockDecode(
             q, k_pool, v_pool, block_tables, q_pos + in_len,
-            page_size=page_size)
+            page_size=page_size, k_scale=k_scale, v_scale=v_scale)
       else:
         ctx = block_decode.BlockPrefill(
             q, k_pool, v_pool, block_tables, q_pos, in_len,
-            page_size=page_size)
+            page_size=page_size, k_scale=k_scale, v_scale=v_scale)
     else:
       # gather-dense fallback: materialize the row's logical cache view and
       # run the einsum path (handles logit cap / dropout / prob quant).
@@ -592,6 +711,11 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       # (owned pages); everything past is stale/foreign and masked.
       k_dense = block_decode.GatherPages(k_pool, block_tables)
       v_dense = block_decode.GatherPages(v_pool, block_tables)
+      if quantized:
+        k_dense = kv_quant.DequantKv(
+            k_dense, block_decode.GatherScales(k_scale, block_tables))
+        v_dense = kv_quant.DequantKv(
+            v_dense, block_decode.GatherScales(v_scale, block_tables))
       slot = jnp.arange(t_pages * page_size)[None, None, None, :]
       mask = jnp.where(slot <= pos_i[:, None, :, None], 0.0, _NEG_INF)
       ctx, _ = self._Atten(theta, q, k_dense, v_dense, mask)

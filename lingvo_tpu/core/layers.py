@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from lingvo_tpu.core import activations
 from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import py_utils
+from lingvo_tpu.core import quant_utils
 from lingvo_tpu.core.nested_map import NestedMap
 from lingvo_tpu.core.py_utils import WeightInit, WeightParams
 
@@ -88,13 +89,21 @@ class ProjectionLayer(base_layer.BaseLayer):
     th = self.CastTheta(theta)
     x = self.ToFPropDtype(inputs)
     w = th.w
-    if p.weight_norm:
-      w = jnp.reshape((1.0 + th.g) / jnp.linalg.norm(w, axis=0), (1, -1)) * w
-    if p.qdomain is not None:
-      # quantize the EFFECTIVE matmul weight (post weight-norm) — QAT must
-      # simulate the weight the int8 deployment actually uses
-      w = self.qdomain.QuantizeWeight(self.ChildTheta(theta, "qdomain"), w)
-    out = jnp.einsum("...i,io->...o", x, w)
+    if isinstance(w, quant_utils.Int8Weight):
+      # int8-serving theta: the matmul runs in int8 on the MXU. Weight-norm
+      # and fake-quant domains rewrite the float weight and cannot compose
+      # with the frozen integer grid.
+      assert not p.weight_norm and p.qdomain is None
+      out = w.Einsum(x)
+    else:
+      if p.weight_norm:
+        w = jnp.reshape((1.0 + th.g) / jnp.linalg.norm(w, axis=0),
+                        (1, -1)) * w
+      if p.qdomain is not None:
+        # quantize the EFFECTIVE matmul weight (post weight-norm) — QAT must
+        # simulate the weight the int8 deployment actually uses
+        w = self.qdomain.QuantizeWeight(self.ChildTheta(theta, "qdomain"), w)
+      out = jnp.einsum("...i,io->...o", x, w)
     if p.has_bias:
       out = out + th.b
     if p.batch_norm:
@@ -842,14 +851,27 @@ class SharedEmbeddingSoftmaxLayer(base_layer.BaseLayer):
   def EmbLookup(self, theta, ids):
     p = self.p
     th = self.CastTheta(theta)
-    out = jnp.take(th.emb, ids, axis=0)
+    emb = th.emb
+    if isinstance(emb, quant_utils.Int8Weight):
+      # gather int8 rows and dequantize by the per-row ('vd') scale — a
+      # lookup has no matmul to run in int8, so this is exact w.r.t. the
+      # frozen grid.
+      rows = jnp.take(emb.w_int8, ids, axis=0).astype(jnp.float32)
+      out = (rows * jnp.take(emb.scale.astype(jnp.float32), ids,
+                             axis=0)).astype(self.fprop_dtype)
+    else:
+      out = jnp.take(emb, ids, axis=0)
     if p.scale_sqrt_depth:
       out = out * math.sqrt(p.embedding_dim)
     return out
 
   def Logits(self, theta, inputs):
     th = self.CastTheta(theta)
-    logits = jnp.einsum("...d,vd->...v", self.ToFPropDtype(inputs), th.emb)
+    if isinstance(th.emb, quant_utils.Int8Weight):
+      # tied softmax over the int8 table: [..., D] x int8 [V, D] ('vd').
+      logits = th.emb.Einsum(self.ToFPropDtype(inputs))
+    else:
+      logits = jnp.einsum("...d,vd->...v", self.ToFPropDtype(inputs), th.emb)
     if self.p.logits_soft_max > 0:
       logits = self.p.logits_soft_max * jnp.tanh(logits / self.p.logits_soft_max)
     return logits
@@ -861,7 +883,10 @@ class SharedEmbeddingSoftmaxLayer(base_layer.BaseLayer):
 
   def FProp(self, theta, inputs, class_ids=None, class_probabilities=None,
             label_smoothing=0.0):
-    if FusedXentEligible(self.p, class_ids, class_probabilities):
+    if (FusedXentEligible(self.p, class_ids, class_probabilities)
+        and not isinstance(theta.emb, quant_utils.Int8Weight)):
+      # the fused blockwise kernel slices the float table; int8-serving
+      # thetas take the dense Logits path below (scoring, not training).
       th = self.CastTheta(theta)
       return _FusedXentFProp(
           self, self.ToFPropDtype(inputs), th.emb, class_ids,

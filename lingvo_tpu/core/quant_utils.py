@@ -217,15 +217,43 @@ class PerChannelSymmetricQDomain(SymmetricQDomain):
 # ---------------------------------------------------------------------------
 
 
-def Int8QuantizeWeight(w, per_channel: bool = True):
-  """[.., out] float weight -> (int8 weight, f32 scale) for serving.
+def _ContractAxes(ndim: int, layout: str, contract_ndim: int | None):
+  """Which weight axes are contracted for a given layout.
 
-  The returned pair feeds `Int8Einsum`; per_channel scales over the last
-  dim match PerChannelSymmetricQDomain's QAT simulation.
+  'dv': the contraction axes LEAD (w [in..., out...]) — per-channel scales
+  live on the trailing output axes. 'vd': the contraction axes TRAIL
+  (w [out..., in...]) — scales live on the leading output axes.
+  contract_ndim=None keeps the legacy 'dv' default of all-but-last (the
+  per-channel-over-last-dim recipe 2-D callers always got).
+  """
+  assert layout in ("dv", "vd"), layout
+  if contract_ndim is None:
+    contract_ndim = ndim - 1 if layout == "dv" else 1
+  assert 0 < contract_ndim < ndim, (contract_ndim, ndim)
+  if layout == "dv":
+    return tuple(range(contract_ndim)), contract_ndim
+  return tuple(range(ndim - contract_ndim, ndim)), contract_ndim
+
+
+def Int8QuantizeWeight(w, per_channel: bool = True, layout: str = "dv",
+                       contract_ndim: int | None = None):
+  """float weight -> (int8 weight, f32 scale) for serving.
+
+  The returned pair feeds `Int8Einsum` with the same layout/contract_ndim.
+  Per-channel scales reduce over the CONTRACTION axes only (one scale per
+  output channel — the only granularity an integer matmul can fold out of
+  the accumulator), keepdims so the scale broadcasts against w:
+
+    layout='dv'  w [in..., out...]  -> scale [1..., out...]
+    layout='vd'  w [out..., in...]  -> scale [out..., 1...]
+
+  The default (layout='dv', contract_ndim=None) reduces all-but-last axes —
+  bit-identical to the legacy per-channel-over-last-dim behavior (and to
+  PerChannelSymmetricQDomain's QAT simulation) for 2-D [in, out] weights.
   """
   w32 = w.astype(jnp.float32)
   if per_channel:
-    reduce_axes = tuple(range(w.ndim - 1))
+    reduce_axes, _ = _ContractAxes(w.ndim, layout, contract_ndim)
     amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
   else:
     amax = jnp.max(jnp.abs(w32))
@@ -234,22 +262,105 @@ def Int8QuantizeWeight(w, per_channel: bool = True):
   return w_int8, scale
 
 
-def Int8Einsum(x, w_int8, w_scale):
-  """y = x @ dequant(w) computed as int8 x int8 -> int32 on the MXU.
+def Int8Einsum(x, w_int8, w_scale, layout: str = "dv",
+               contract_ndim: int | None = None):
+  """y = x · dequant(w) computed as int8 x int8 -> int32 on the MXU.
 
   Activations are dynamically quantized per call (per-tensor symmetric).
-  x: [..., in]; w_int8: [in, out] int8; w_scale: f32 broadcastable to
-  [1, out]. Returns x.dtype.
+  x's trailing contract_ndim axes contract against the weight's
+  contraction axes (leading for 'dv', trailing for 'vd' — see
+  `Int8QuantizeWeight`); w_scale is the matching per-channel scale (or a
+  scalar). The legacy call `Int8Einsum(x, w8 [in, out], scale)` is the
+  layout='dv', contract_ndim=1 special case. Returns x.dtype with shape
+  [..., out...].
   """
-  x32 = x.astype(jnp.float32)
+  _, k = _ContractAxes(w_int8.ndim, layout, contract_ndim)
+  if layout == "dv":
+    in_dims, out_dims = w_int8.shape[:k], w_int8.shape[k:]
+  else:
+    out_dims, in_dims = w_int8.shape[:w_int8.ndim - k], w_int8.shape[
+        w_int8.ndim - k:]
+  kk = _Prod(in_dims)
+  assert tuple(x.shape[x.ndim - k:]) == tuple(in_dims), (x.shape, w_int8.shape)
+  batch_shape = x.shape[:x.ndim - k]
+  x32 = x.astype(jnp.float32).reshape(batch_shape + (kk,))
   x_scale = jnp.maximum(jnp.max(jnp.abs(x32)) / 127.0, 1e-8)
   x_int8 = jnp.clip(jnp.round(x32 / x_scale), -128, 127).astype(jnp.int8)
+  w2 = w_int8.reshape((kk, -1) if layout == "dv" else (-1, kk))
+  w_contract = 0 if layout == "dv" else 1
   acc = jax.lax.dot_general(
-      x_int8, w_int8,
-      dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
-      preferred_element_type=jnp.int32)
-  return (acc.astype(jnp.float32) * x_scale *
-          w_scale.reshape((1,) * (acc.ndim - 1) + (-1,))).astype(x.dtype)
+      x_int8, w2,
+      dimension_numbers=(((x_int8.ndim - 1,), (w_contract,)), ((), ())),
+      preferred_element_type=jnp.int32)                    # [..., M]
+  scale_vec = jnp.reshape(w_scale.astype(jnp.float32), (-1,))
+  y = acc.astype(jnp.float32) * x_scale
+  if scale_vec.size == 1:
+    y = y * scale_vec[0]
+  else:
+    y = y * scale_vec.reshape((1,) * (acc.ndim - 1) + (-1,))
+  return y.reshape(batch_shape + tuple(out_dims)).astype(x.dtype)
+
+
+def _Prod(dims) -> int:
+  out = 1
+  for d in dims:
+    out *= int(d)
+  return out
+
+
+@jax.tree_util.register_pytree_node_class
+class Int8Weight:
+  """A theta leaf served as int8: integer values + per-channel f32 scales.
+
+  Layers whose matmuls understand this leaf (ProjectionLayer,
+  MultiHeadedAttention projections, SharedEmbeddingSoftmaxLayer) route it
+  through `Int8Einsum` — the weight never re-materializes in float. It is
+  a registered pytree node, so it rides NestedMap theta through jit /
+  donation / CastTheta unchanged (w_int8 is non-floating and passes every
+  dtype cast untouched; the f32 scale follows the activation policy).
+
+  layout/contract_ndim describe which axes the consuming einsum contracts
+  (see `Int8QuantizeWeight`); they are static aux data, not traced.
+  """
+
+  def __init__(self, w_int8, scale, layout: str = "dv",
+               contract_ndim: int | None = None):
+    self.w_int8 = w_int8
+    self.scale = scale
+    self.layout = layout
+    self.contract_ndim = contract_ndim
+
+  @property
+  def shape(self):
+    return self.w_int8.shape
+
+  def Dequant(self):
+    """The exact float grid the export froze: w_int8 * scale, f32."""
+    return self.w_int8.astype(jnp.float32) * self.scale.astype(jnp.float32)
+
+  def Einsum(self, x):
+    """x [..., in...] -> [..., out...] via the integer matmul."""
+    return Int8Einsum(x, self.w_int8, self.scale, layout=self.layout,
+                      contract_ndim=self.contract_ndim)
+
+  @classmethod
+  def Quantize(cls, w, layout: str = "dv", contract_ndim: int | None = None):
+    w_int8, scale = Int8QuantizeWeight(w, per_channel=True, layout=layout,
+                                       contract_ndim=contract_ndim)
+    return cls(w_int8, scale, layout=layout, contract_ndim=contract_ndim)
+
+  def tree_flatten(self):
+    return (self.w_int8, self.scale), (self.layout, self.contract_ndim)
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    w_int8, scale = children
+    return cls(w_int8, scale, layout=aux[0], contract_ndim=aux[1])
+
+  def __repr__(self):
+    shape = tuple(getattr(self.w_int8, "shape", ()))
+    return (f"Int8Weight(shape={shape}, layout={self.layout!r}, "
+            f"contract_ndim={self.contract_ndim})")
 
 
 class QuantizableLayer(base_layer.BaseLayer):

@@ -254,13 +254,16 @@ class GatedSSMLayer(base_layer.BaseLayer):
   # -- continuous-batching serving -------------------------------------------
 
   def InitPagedStates(self, theta, num_pages: int, page_size: int,
-                      num_slots: int = 0) -> NestedMap:
+                      num_slots: int = 0,
+                      kv_cache_dtype: str | None = None) -> NestedMap:
     """One fixed [N, H, S] state per engine slot — no page pool share.
 
     The serving engine passes num_slots = its slot count; attention layers
     ignore it and SSM layers ignore the page-pool geometry. There is no
-    time_step: per-row positions ride each PagedStep call (q_pos)."""
-    del theta, num_pages, page_size
+    time_step: per-row positions ride each PagedStep call (q_pos).
+    kv_cache_dtype is accepted for stack-level threading and ignored —
+    quantized SSM state slots are a documented follow-on."""
+    del theta, num_pages, page_size, kv_cache_dtype
     assert num_slots > 0, (
         "GatedSSMLayer.InitPagedStates needs the engine slot count "
         "(InitPagedDecodeState(..., num_slots=max_slots))")

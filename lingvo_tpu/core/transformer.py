@@ -158,9 +158,11 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
         theta.atten, x, cached_states, paddings=cache_paddings, **kw)
     return query_vec + out, new_states
 
-  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0,
+                      kv_cache_dtype=None):
     return self.atten.InitPagedStates(theta.atten, num_pages, page_size,
-                                      num_slots=num_slots)
+                                      num_slots=num_slots,
+                                      kv_cache_dtype=kv_cache_dtype)
 
   def PagedStep(self, theta, query_vec, cached_states, block_tables, q_pos,
                 in_len):
@@ -260,11 +262,13 @@ class TransformerLayer(base_layer.BaseLayer):
     out = self.fflayer.FProp(theta.fflayer, x)
     return out, NestedMap(self_atten=new_sa)
 
-  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0,
+                      kv_cache_dtype=None):
     assert not self.p.has_aux_atten, (
         "continuous-batching serving is decoder-only (no cross-attention)")
     return NestedMap(self_atten=self.self_atten.InitPagedStates(
-        theta.self_atten, num_pages, page_size, num_slots=num_slots))
+        theta.self_atten, num_pages, page_size, num_slots=num_slots,
+        kv_cache_dtype=kv_cache_dtype))
 
   def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
                 in_len):
@@ -358,10 +362,11 @@ class StackedTransformerLayers(base_layer.BaseLayer):
       x = self.final_ln.FProp(theta.final_ln, x)
     return x, new_states
 
-  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0,
+                      kv_cache_dtype=None):
     return NestedMap(x_layers=[
         l.InitPagedStates(theta.x_layers[i], num_pages, page_size,
-                          num_slots=num_slots)
+                          num_slots=num_slots, kv_cache_dtype=kv_cache_dtype)
         for i, l in enumerate(self.x_layers)
     ])
 
@@ -489,10 +494,12 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
                                    (theta.body, cached_states.body))
     return out, NestedMap(body=new_states)
 
-  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0):
+  def InitPagedStates(self, theta, num_pages, page_size, num_slots=0,
+                      kv_cache_dtype=None):
     def _One(theta_i):
       return self.body.InitPagedStates(theta_i, num_pages, page_size,
-                                       num_slots=num_slots)
+                                       num_slots=num_slots,
+                                       kv_cache_dtype=kv_cache_dtype)
 
     return NestedMap(body=jax.vmap(_One)(theta.body))
 

@@ -58,6 +58,14 @@ class TransformerLm(base_model.BaseTask):
         "use_repeat_layer, num_layers must divide by n (the block is the "
         "scanned repeat body).")
     p.Define("use_rotary", True, "RoPE instead of absolute positions.")
+    p.Define(
+        "kv_cache_dtype", None,
+        "Decode KV-cache storage dtype for every attention layer in the "
+        "stack (see attention.MultiHeadedAttention.kv_cache_dtype): "
+        "None = fprop dtype (bit-exact legacy caches), 'bfloat16', or "
+        "'int8' (quantize-on-write with per-token-per-head scales). "
+        "Serving can also override per-engine via "
+        "InitPagedDecodeState(..., kv_cache_dtype=...).")
     p.Define("bidirectional", False,
              "No causal mask (BERT-style encoder; pair with an MLM task).")
     p.Define("label_smoothing", 0.0, "Label smoothing.")
@@ -119,6 +127,7 @@ class TransformerLm(base_model.BaseTask):
     if atten_tpl is not None:
       layer_body.tr_atten_tpl.atten_tpl = atten_tpl.Copy()
     layer_body.tr_atten_tpl.atten_tpl.use_rotary_position_emb = p.use_rotary
+    layer_body.tr_atten_tpl.atten_tpl.kv_cache_dtype = p.kv_cache_dtype
     layer_body.tr_atten_tpl.atten_tpl.atten_dropout_prob = p.atten_dropout_prob
     layer_body.tr_atten_tpl.atten_tpl.weight_split_dims_mapping = (
         None, "model", None)
@@ -399,7 +408,8 @@ class TransformerLm(base_model.BaseTask):
     return logits, new_states
 
   def InitPagedDecodeState(self, theta, num_pages: int, page_size: int,
-                           num_slots: int = 0):
+                           num_slots: int = 0,
+                           kv_cache_dtype: str | None = None):
     """Global KV page pool for the continuous-batching serving engine.
 
     Unlike InitDecodeState there is no batch/max_len shape — capacity is
@@ -408,9 +418,13 @@ class TransformerLm(base_model.BaseTask):
     it passes allocator pages + 1 so the last page is the trash page).
     num_slots: the engine's slot count, required by O(1)-state mixer
     layers (one fixed [N, H, S] state per slot); attention layers ignore
-    it."""
+    it. kv_cache_dtype overrides p.kv_cache_dtype for this pool (a static
+    string — engines pass it as a jit static arg); PagedStep needs no
+    matching flag, it detects the quantized pool from the scale sidecars
+    in the state."""
     return self.stack.InitPagedStates(theta.stack, num_pages, page_size,
-                                      num_slots=num_slots)
+                                      num_slots=num_slots,
+                                      kv_cache_dtype=kv_cache_dtype)
 
   def PagedStep(self, theta, ids, states, block_tables, q_pos, in_len):
     """Continuous-batching step: ids [b, c] -> (logits [b, c, vocab],

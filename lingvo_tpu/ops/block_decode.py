@@ -36,6 +36,20 @@ Two lowerings of the single-query decode op, asserted bit-identical:
 causal within the chunk) used for chunked prefill interleaved with decode;
 it is an XLA-only lowering — the single-query kernel is the steady-state
 hot op, prefill happens once per admitted request.
+
+Quantized pools: when the pool stores int8 (see `lingvo_tpu/quant/kv.py`),
+callers pass the f32 scale sidecars `k_scale`/`v_scale` of shape
+[num_pages, N, page_size] — transposed so the Pallas scale block's minor
+dimension is page_size (a multiple of 128 lanes whenever `SupportedOnTpu`
+admits the kernel at all). Both lowerings dequantize through the SAME
+`_DequantPages` helper right before `_PageAttend`, which is what keeps the
+int8 twins bitwise-identical just like the float pair. In the Pallas
+lowering the scales ride VMEM blocks whose index map resolves through the
+scalar-prefetched block table — dead logical pages clamp to the row's last
+live page, so scale DMAs are elided exactly like the K/V page DMAs. (The
+full per-slot sidecar is too large for SMEM at serving sizes, so the
+scales are NOT themselves scalar-prefetch operands — only the table and
+lengths are.)
 """
 
 from __future__ import annotations
@@ -65,16 +79,42 @@ def GatherPages(pool, block_tables):
   return pages.reshape(b, t_pages * page, n, h)
 
 
+def GatherScales(scales, block_tables):
+  """sidecar [NP, N, P] + tables [B, T] -> dense [B, T*P, N].
+
+  The `GatherPages` sibling for scale sidecars: per-slot-per-head scales in
+  logical-slot order, aligned with the [B, T*P, N, H] gathered pages, for
+  the dense-fallback dequantization in `MultiHeadedAttention.PagedStep`."""
+  b, t_pages = block_tables.shape
+  np_total, n, page = scales.shape
+  s = scales[jnp.clip(block_tables, 0, np_total - 1)]     # [B, T, N, P]
+  return jnp.swapaxes(s, 2, 3).reshape(b, t_pages * page, n)
+
+
+def _DequantPages(pages, scales):
+  """pages [..., P, N, H] int8 + scales [..., N, P] f32 -> f32 pages.
+
+  THE shared dequantize-on-read: both the Pallas kernel and the XLA twin
+  (and `BlockPrefill`) funnel quantized pages through this exact sequence
+  of float ops before `_PageAttend`, so the int8 lowerings stay
+  bitwise-identical for the same reason the float ones do."""
+  s = jnp.swapaxes(scales.astype(jnp.float32), -1, -2)[..., None]
+  return pages.astype(jnp.float32) * s
+
+
 # -- XLA twin (the CPU serving path) -----------------------------------------
 
 
 def _XlaBlockDecode(q, k_pool, v_pool, block_tables, seq_lens,
-                    page_size: int):
+                    page_size: int, k_scale=None, v_scale=None):
   """q: [B, N, H]; pools [NP, P, N, H]; tables [B, T] int32; seq_lens [B]
   int32 (live slots per row; the query attends slots < seq_len). -> [B, N, H].
 
   Dynamic trip count over the batch-max live page — per decode step the
-  work is O(max live length over the batch), not O(T * page_size)."""
+  work is O(max live length over the batch), not O(T * page_size).
+  k_scale/v_scale [NP, N, P] switch on the int8 path: pages dequantize
+  through `_DequantPages` right before `_PageAttend` (scales None leaves
+  the float path untouched, op for op)."""
   b = q.shape[0]
   np_total, page, n, h = k_pool.shape
   assert page == page_size, (page, page_size)
@@ -92,6 +132,9 @@ def _XlaBlockDecode(q, k_pool, v_pool, block_tables, seq_lens,
     pid = jax.lax.dynamic_index_in_dim(tables, j, axis=1, keepdims=False)
     k_page = k_pool[pid]                                   # [B, P, N, H]
     v_page = v_pool[pid]
+    if k_scale is not None:
+      k_page = _DequantPages(k_page, k_scale[pid])
+      v_page = _DequantPages(v_page, v_scale[pid])
     slot = j * page_size + jnp.arange(page_size, dtype=jnp.int32)  # [P]
     keep = (slot[None, :] < lens[:, None]).astype(jnp.float32)[:, None, :]
     return batched_attend(q, k_page, v_page, keep, m, l, acc)
@@ -106,10 +149,19 @@ def _XlaBlockDecode(q, k_pool, v_pool, block_tables, seq_lens,
 # -- Pallas TPU kernel -------------------------------------------------------
 
 
-def _BlockDecodeKernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, out_ref,
-                       m_scr, l_scr, acc_scr, *, page_size: int,
-                       t_pages: int):
-  """One (batch, logical page) program step; scratch carried over pages."""
+def _BlockDecodeKernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                       page_size: int, t_pages: int):
+  """One (batch, logical page) program step; scratch carried over pages.
+
+  One body serves both storage modes so the control flow cannot drift:
+  the float call passes (out_ref, scratch...), the int8 call additionally
+  threads the scale blocks (ks_ref, vs_ref, out_ref, scratch...) and
+  dequantizes via the shared `_DequantPages` before `_PageAttend`."""
+  if len(rest) == 6:
+    ks_ref, vs_ref, out_ref, m_scr, l_scr, acc_scr = rest
+  else:
+    ks_ref = vs_ref = None
+    out_ref, m_scr, l_scr, acc_scr = rest
   bi = pl.program_id(0)
   j = pl.program_id(1)
   ln = lens_ref[bi]
@@ -125,7 +177,11 @@ def _BlockDecodeKernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, out_ref,
     slot = j * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (1, page_size), 1)                       # [1, P]
     keep = (slot < ln).astype(jnp.float32)                  # [1, P]
-    m, l, acc = _PageAttend(q_ref[0], k_ref[0], v_ref[0], keep, m_scr[:, :1],
+    k_page, v_page = k_ref[0], v_ref[0]
+    if ks_ref is not None:
+      k_page = _DequantPages(k_page, ks_ref[0])
+      v_page = _DequantPages(v_page, vs_ref[0])
+    m, l, acc = _PageAttend(q_ref[0], k_page, v_page, keep, m_scr[:, :1],
                             l_scr[:, :1], acc_scr[:])
     m_scr[:] = jnp.broadcast_to(m, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(l, l_scr.shape)
@@ -137,7 +193,8 @@ def _BlockDecodeKernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, out_ref,
 
 
 def _PallasBlockDecode(q, k_pool, v_pool, block_tables, seq_lens,
-                       page_size: int, interpret: bool = False):
+                       page_size: int, interpret: bool = False,
+                       k_scale=None, v_scale=None):
   """Pallas lowering of _XlaBlockDecode. q: [B, N, H] -> [B, N, H]."""
   b, n, h = q.shape
   np_total, page, _, _ = k_pool.shape
@@ -155,14 +212,28 @@ def _PallasBlockDecode(q, k_pool, v_pool, block_tables, seq_lens,
     last = jnp.minimum(last, t_pages - 1)
     return (tables_ref[bi, jnp.minimum(j, last)], 0, 0, 0)
 
+  # Scale sidecar blocks resolve their page through the same prefetched
+  # table lookup, so their DMAs are elided for dead pages exactly like k/v.
+  def _ScaleIdx(bi, j, tables_ref, lens_ref):
+    return _PageIdx(bi, j, tables_ref, lens_ref)[:3]
+
+  in_specs = [
+      pl.BlockSpec((1, n, h), lambda bi, j, t_ref, l_ref: (bi, 0, 0)),
+      pl.BlockSpec((1, page_size, n, h), _PageIdx),
+      pl.BlockSpec((1, page_size, n, h), _PageIdx),
+  ]
+  operands = [tables, lens, q, k_pool, v_pool]
+  if k_scale is not None:
+    in_specs += [
+        pl.BlockSpec((1, n, page_size), _ScaleIdx),
+        pl.BlockSpec((1, n, page_size), _ScaleIdx),
+    ]
+    operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
   grid_spec = pltpu.PrefetchScalarGridSpec(
       num_scalar_prefetch=2,
       grid=(b, t_pages),
-      in_specs=[
-          pl.BlockSpec((1, n, h), lambda bi, j, t_ref, l_ref: (bi, 0, 0)),
-          pl.BlockSpec((1, page_size, n, h), _PageIdx),
-          pl.BlockSpec((1, page_size, n, h), _PageIdx),
-      ],
+      in_specs=in_specs,
       out_specs=pl.BlockSpec((1, n, h),
                              lambda bi, j, t_ref, l_ref: (bi, 0, 0)),
       scratch_shapes=[
@@ -180,14 +251,15 @@ def _PallasBlockDecode(q, k_pool, v_pool, block_tables, seq_lens,
       compiler_params=_CompilerParams(
           dimension_semantics=("parallel", "arbitrary")),
       interpret=interpret,
-  )(tables, lens, q, k_pool, v_pool)
+  )(*operands)
 
 
 # -- public entries ----------------------------------------------------------
 
 
 def BlockDecode(q, k_pool, v_pool, block_tables, seq_lens, *, page_size: int,
-                lowering: str = "auto", interpret: bool | None = None):
+                k_scale=None, v_scale=None, lowering: str = "auto",
+                interpret: bool | None = None):
   """Single-query block-table paged decode attention.
 
   q: [B, 1, N, H] — the newest query per sequence, ALREADY scaled (the
@@ -197,29 +269,36 @@ def BlockDecode(q, k_pool, v_pool, block_tables, seq_lens, *, page_size: int,
   row's live pages are arbitrary and never influence the output.
   seq_lens: [B] int32 live-slot counts (the query attends slots
   [0, seq_len)); 0 marks an inactive row, whose output is 0.
+  k_scale/v_scale: [num_pages, N, page_size] f32 sidecars for int8 pools
+  (both or neither); pages dequantize in-kernel via `_DequantPages`.
   lowering: 'auto' (Pallas on real TPU, XLA twin elsewhere) | 'pallas' |
   'xla'. Returns [B, 1, N, H].
   """
   assert q.ndim == 4 and q.shape[1] == 1, q.shape
   assert lowering in ("auto", "pallas", "xla"), lowering
+  assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
+  if k_scale is not None:
+    assert k_pool.dtype == jnp.int8, k_pool.dtype
   q3 = q[:, 0]
   on_tpu = jax.default_backend() == "tpu"
   if lowering == "auto":
     lowering = "pallas" if on_tpu else "xla"
   if lowering == "xla":
     out = _XlaBlockDecode(q3, k_pool, v_pool, block_tables,
-                          jnp.asarray(seq_lens), page_size)
+                          jnp.asarray(seq_lens), page_size,
+                          k_scale=k_scale, v_scale=v_scale)
   else:
     if interpret is None:
       interpret = not on_tpu
     out = _PallasBlockDecode(q3, k_pool, v_pool, block_tables,
                              jnp.asarray(seq_lens), page_size,
-                             interpret=interpret)
+                             interpret=interpret,
+                             k_scale=k_scale, v_scale=v_scale)
   return out[:, None]
 
 
 def BlockPrefill(q, k_pool, v_pool, block_tables, q_pos, in_len, *,
-                 page_size: int):
+                 page_size: int, k_scale=None, v_scale=None):
   """Ragged multi-query paged attention for chunked prefill steps.
 
   q: [B, C, N, H] pre-scaled chunk queries; query c of row b sits at global
@@ -227,12 +306,14 @@ def BlockPrefill(q, k_pool, v_pool, block_tables, q_pos, in_len, *,
   (causal within the chunk; the chunk's K/V were written to the pool before
   this call). in_len: [B] int32 valid-query counts — queries `c >= in_len[b]`
   (decode rows' dead tail, inactive rows) return 0 and never contribute.
+  k_scale/v_scale [NP, N, P] f32 sidecars dequantize int8 pools on read.
   XLA-only lowering (one fori_loop over live pages, online softmax); the
   single-query BlockDecode kernel is the steady-state path. -> [B, C, N, H].
   """
   b, c, n, h = q.shape
   np_total, page, _, _ = k_pool.shape
   assert page == page_size, (page, page_size)
+  assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
   t_pages = block_tables.shape[1]
   q_pos = q_pos.astype(jnp.int32)
   in_len = in_len.astype(jnp.int32)
@@ -247,6 +328,9 @@ def BlockPrefill(q, k_pool, v_pool, block_tables, q_pos, in_len, *,
     pid = jax.lax.dynamic_index_in_dim(tables, j, axis=1, keepdims=False)
     k_page = k_pool[pid]                                   # [B, P, N, H]
     v_page = v_pool[pid]
+    if k_scale is not None:
+      k_page = _DequantPages(k_page, k_scale[pid])
+      v_page = _DequantPages(v_page, v_scale[pid])
     slot = j * page_size + jnp.arange(page_size, dtype=jnp.int32)  # [P]
     keep = ((slot[None, None, :] <= pos[:, :, None])
             & valid[:, :, None])                           # [B, C, P]
@@ -273,10 +357,15 @@ def BlockPrefill(q, k_pool, v_pool, block_tables, q_pos, in_len, *,
   return _Finish(l, acc, q.dtype)
 
 
-def SupportedOnTpu(page_size: int, h: int) -> bool:
+def SupportedOnTpu(page_size: int, h: int,
+                   kv_dtype: str = "float32") -> bool:
   """Whether the Pallas block-decode lowering can run on real TPU hardware.
 
   Same Mosaic tiling constraint as flash_decode: page_size rides the
   128-lane minor axis of the in-kernel keep tiles and h the minor axis of
-  the k/v page blocks. The XLA twin has no such constraint."""
+  the k/v page blocks. int8 pools add no NEW constraint: the int8 minimum
+  tile is (32, 128) sublanes x lanes, subsumed by the %128 gates, and the
+  f32 scale sidecar's minor axis is page_size, already a lane multiple
+  here. The XLA twin has no such constraint."""
+  del kv_dtype  # int8 needs nothing extra today; fp8 may.
   return page_size % LANES == 0 and h % LANES == 0

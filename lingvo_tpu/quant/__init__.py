@@ -1,0 +1,16 @@
+"""Quantized serving: low-bit KV caches and integer-matmul weight serving.
+
+The subsystem spans the stack — `quant/kv.py` owns the KV-cache numerics
+(quantize-on-write / dequantize-on-read, byte accounting, stack census),
+`quant/weights.py` owns the serving-theta rewrite that turns exported
+`theta_int8` artifacts (or a live float theta) into `Int8Weight` leaves the
+layers consume via integer matmuls. Entry points are the `kv_cache_dtype`
+and `serve_int8_weights` knobs on `ServingLoop` / `GShardDecode` /
+`TransformerLm.Params`. See docs/quantized_serving.md for the numerics
+contract.
+"""
+
+from lingvo_tpu.quant import kv
+from lingvo_tpu.quant import weights
+
+__all__ = ["kv", "weights"]

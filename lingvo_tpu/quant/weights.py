@@ -1,0 +1,143 @@
+"""Int8 weight serving: rewrite a theta so decode matmuls run in int8.
+
+The export path (`serving/export.py`) freezes every eligible float leaf to
+its int8 dequantization grid and saves the (w_int8, scale) pairs as the
+`theta_int8` artifact. This module is the consumer side: it rewrites a
+theta — either a live float theta or a restored frozen one — so that the
+leaves the decode projections touch become `quant_utils.Int8Weight` nodes,
+which ProjectionLayer / MultiHeadedAttention / SharedEmbeddingSoftmaxLayer
+route through `Int8Einsum` integer matmuls.
+
+Layouts: an integer matmul can only fold a scale out of the accumulator if
+the scale is constant along the CONTRACTION axes, so each leaf's layout is
+keyed by how its einsum contracts it (the export walk used to assume the
+2-D 'dv' [in, out] layout for everything — wrong for `w_post` and `emb`,
+whose per-channel axes lead). MoE expert weights (wi/wo/wm/pw_in/pw_out)
+stay float in the serving theta: their einsums carry an expert dimension
+the integer path does not thread yet (they are still frozen/quantized in
+the export artifact, with legacy per-last-dim scales).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.core import quant_utils
+
+# Leaf name -> (layout, contract_ndim) for serving-eligible weights, keyed
+# by how each consuming einsum contracts the weight:
+#   w        [in, out]   "...i,io->...o"    contract in      -> dv, 1
+#   w_query/ [D, N, H]   "BTD,DNH->BTNH"    contract D       -> dv, 1
+#   w_key/w_value
+#   w_post   [D, N, H]   "BTNH,DNH->BTD"    contract (N, H)  -> vd, 2
+#   emb      [V, D]      "...d,vd->...v"    contract D       -> vd, 1
+#                        (EmbLookup gathers int8 rows and dequantizes by
+#                         the per-row scale instead of a matmul)
+SERVING_WEIGHT_LAYOUTS = {
+    "w": ("dv", 1),
+    "w_query": ("dv", 1),
+    "w_key": ("dv", 1),
+    "w_value": ("dv", 1),
+    "w_post": ("vd", 2),
+    "emb": ("vd", 1),
+}
+
+
+def WeightLayoutFor(name: str):
+  """(layout, contract_ndim) for a leaf name; legacy all-but-last-dim
+  reduction (dv, None) for artifact-only names like MoE experts."""
+  return SERVING_WEIGHT_LAYOUTS.get(name, ("dv", None))
+
+
+def _LeafName(path: str) -> str:
+  return path.rsplit(".", 1)[-1]
+
+
+def IsStackedPath(path: str) -> bool:
+  """Repeated stacks (transformer.RepeatedTransformerLayer) store the whole
+  body theta with a leading repeat axis that lax.scan / vmap slice off
+  before any einsum sees the weight — quantization must treat axis 0 as a
+  batch axis (one scale set PER REPEAT), never as a contraction axis."""
+  return ".body." in f".{path}."
+
+
+def QuantizeLeafInt8(leaf, layout, contract_ndim, stacked):
+  """float leaf -> Int8Weight under the given layout; stacked leaves get
+  per-repeat scales via a vmap over the leading repeat axis."""
+  if not stacked:
+    return quant_utils.Int8Weight.Quantize(leaf, layout=layout,
+                                           contract_ndim=contract_ndim)
+  w_int8, scale = jax.vmap(lambda w: quant_utils.Int8QuantizeWeight(
+      w, per_channel=True, layout=layout, contract_ndim=contract_ndim))(leaf)
+  # the sliced-per-repeat view an einsum actually consumes has the declared
+  # layout; the full stacked node only ever Dequant()s (which broadcasts)
+  return quant_utils.Int8Weight(w_int8, scale, layout=layout,
+                                contract_ndim=contract_ndim)
+
+
+def Int8ServingTheta(theta, mode: str = "int8"):
+  """Rewrite serving-eligible leaves of `theta` -> (new_theta, paths).
+
+  mode='int8' replaces each eligible float leaf with an `Int8Weight`
+  pytree node (integer matmuls at serve time). mode='dequant' replaces it
+  with the plain float dequantization grid `w_int8 * scale` — bitwise
+  identical to what `Export(..., quantize_int8=True)` freezes, useful for
+  asserting the freeze contract without changing any matmul.
+  """
+  assert mode in ("int8", "dequant"), mode
+  new_theta = theta.DeepCopy()
+  paths = []
+  for path, leaf in theta.FlattenItems():
+    name = _LeafName(path)
+    if name not in SERVING_WEIGHT_LAYOUTS:
+      continue
+    stacked = IsStackedPath(path)
+    if not hasattr(leaf, "ndim") or leaf.ndim < (3 if stacked else 2):
+      continue
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+      continue
+    layout, k = SERVING_WEIGHT_LAYOUTS[name]
+    w8 = QuantizeLeafInt8(leaf, layout, k, stacked)
+    if mode == "dequant":
+      new_theta.Set(path, w8.Dequant().astype(leaf.dtype))
+    else:
+      new_theta.Set(path, w8)
+    paths.append(path)
+  if not paths:
+    raise ValueError("Int8ServingTheta: no serving-eligible leaves found")
+  return new_theta, paths
+
+
+def Int8ServingThetaFromArtifact(theta, int8_tree, mode: str = "int8"):
+  """Build a serving theta from an exported `theta_int8` artifact.
+
+  `theta` is the restored frozen theta (every eligible leaf already equals
+  its dequantization grid — the export freeze contract); `int8_tree` is
+  `Predictor.Int8Weights()`: {path: {"w_int8", "scale"}}. Only paths whose
+  leaf name has a serving layout are rewritten; artifact-only paths (MoE
+  experts, w_proj, ...) stay as their frozen floats.
+  """
+  assert mode in ("int8", "dequant"), mode
+  new_theta = theta.DeepCopy()
+  paths = []
+  for path, pair in int8_tree.items():
+    name = _LeafName(path)
+    if name not in SERVING_WEIGHT_LAYOUTS:
+      continue
+    layout, k = SERVING_WEIGHT_LAYOUTS[name]
+    w8 = quant_utils.Int8Weight(
+        jnp.asarray(pair["w_int8"], dtype=jnp.int8),
+        jnp.asarray(pair["scale"], dtype=jnp.float32),
+        layout=layout, contract_ndim=k)
+    if mode == "dequant":
+      frozen = theta.Get(path)
+      new_theta.Set(path, w8.Dequant().astype(frozen.dtype))
+    else:
+      new_theta.Set(path, w8)
+    paths.append(path)
+  if not paths:
+    raise ValueError(
+        "Int8ServingThetaFromArtifact: artifact has no serving-eligible "
+        "paths")
+  return new_theta, paths

@@ -41,6 +41,7 @@ from lingvo_tpu.core import checkpointer as checkpointer_lib
 from lingvo_tpu.core import py_utils
 from lingvo_tpu.core import sampling
 from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.quant import kv as kv_quant
 
 # Decode-program shape buckets (slots, ascending). Lengths beyond the last
 # bucket run at their exact size (a compile per distinct length).
@@ -58,6 +59,7 @@ class GShardDecode:
                init_seed: int = 1234,
                prefill_chunk_size: int = 0,
                use_legacy_prime: bool = False,
+               serve_int8_weights: bool = False,
                len_buckets=DEFAULT_LEN_BUCKETS):
     """task: a TransformerLm-style task exposing InitDecodeState/ExtendStep.
 
@@ -69,7 +71,9 @@ class GShardDecode:
     prefill_chunk_size: prompt tokens per prefill attention pass (0 = the
     whole prompt in one pass). use_legacy_prime: prime the cache with the
     per-token ExtendStep scan instead of chunked prefill (slow; kept as
-    the A/B reference). len_buckets: prompt-width buckets.
+    the A/B reference). serve_int8_weights: rewrite each restored theta so
+    decode projections run int8 integer matmuls (quant.weights — rewritten
+    once per checkpoint, cached). len_buckets: prompt-width buckets.
     """
     self._task = task
     self._train_dir = train_dir
@@ -83,6 +87,10 @@ class GShardDecode:
     self._last_step = -1
     self._prefill_chunk = prefill_chunk_size
     self._use_legacy_prime = use_legacy_prime
+    self._serve_int8_weights = bool(serve_int8_weights)
+    # (checkpoint step, rewritten theta) — int8 rewrite runs once per
+    # restored checkpoint, not once per DecodeOnce call
+    self._int8_theta = None
     self._len_buckets = tuple(len_buckets)
     self._template = jax.eval_shape(
         self._task.CreateTrainState, jax.random.PRNGKey(init_seed))
@@ -215,6 +223,13 @@ class GShardDecode:
   def DecodeOnce(self, step: int, prompts: np.ndarray,
                  prompt_lens: np.ndarray) -> list:
     state, restored = self._checkpointer.Restore(self._template, step=step)
+    theta = state.theta
+    if self._serve_int8_weights:
+      if self._int8_theta is None or self._int8_theta[0] != restored:
+        from lingvo_tpu.quant import weights as quant_weights
+        self._int8_theta = (
+            restored, quant_weights.Int8ServingTheta(theta)[0])
+      theta = self._int8_theta[1]
     if prompts.shape[1] == 0:
       raise ValueError("prompts must have width >= 1 (got [B, 0]); the "
                        "prefill loop needs at least one chunk")
@@ -223,7 +238,7 @@ class GShardDecode:
     p_len = py_utils.RoundUpToBucket(prompts.shape[1], self._len_buckets)
     init_fn, prefill_fn, sample_fn = self._GetDecodeFn(p_len, self._max_steps)
     aligned = self._RightAlign(prompts, prompt_lens, width=p_len)
-    states = init_fn(state.theta, prompts.shape[0])
+    states = init_fn(theta, prompts.shape[0])
     jax.block_until_ready(states)
     # measured BEFORE donation (shape metadata only): total decode-state
     # HBM per sequence — KV caches grow with p_len + max_steps, O(1) SSM
@@ -235,17 +250,21 @@ class GShardDecode:
     # per-phase wall timing (block_until_ready fences async dispatch so
     # each phase's time is its own, not its predecessor's flush)
     t0 = time.perf_counter()
-    last_logits, states = prefill_fn(state.theta, jnp.asarray(aligned),
+    last_logits, states = prefill_fn(theta, jnp.asarray(aligned),
                                      lens_dev, states)
     jax.block_until_ready(last_logits)
     t1 = time.perf_counter()
-    out = sample_fn(state.theta, last_logits, lens_dev,
+    out = sample_fn(theta, last_logits, lens_dev,
                     jax.random.PRNGKey(restored), states)
     out = jax.block_until_ready(out)
     t2 = time.perf_counter()
     self._last_step = restored
     b = prompts.shape[0]
     decode_s = t2 - t1
+    # KV-cache telemetry: the same visibility contract the serving engine's
+    # Stats() carries — a quantized (or non-default-dtype) cache is never
+    # silent. Non-LM tasks without a recognizable stack report None/0.
+    census = kv_quant.StackKvCensus(self._task) or {}
     telemetry = {
         "prefill_s": t1 - t0,
         "decode_s": decode_s,
@@ -255,6 +274,9 @@ class GShardDecode:
         "tokens_per_sec": (b * self._max_steps / decode_s
                            if decode_s > 0 else 0.0),
         "decode_state_bytes_per_seq": state_bytes // b,
+        "kv_cache_dtype": census.get("kv_cache_dtype"),
+        "kv_bytes_per_token": census.get("kv_bytes_per_token", 0),
+        "serve_int8_weights": self._serve_int8_weights,
     }
     self._last_telemetry = telemetry
     results = []

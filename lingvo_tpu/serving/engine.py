@@ -53,6 +53,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from lingvo_tpu.core import sampling
+from lingvo_tpu.quant import kv as kv_quant
+from lingvo_tpu.quant import weights as quant_weights
 from lingvo_tpu.serving import kv_cache
 from lingvo_tpu.serving import scheduler as scheduler_lib
 
@@ -116,17 +118,27 @@ class ServingLoop:
                max_batch: int, max_seq_len: int, prefill_chunk: int = 8,
                default_max_new: int = 32, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
-               sample_seed: int = 0):
+               sample_seed: int = 0, kv_cache_dtype: Optional[str] = None,
+               serve_int8_weights: bool = False):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
     (block-table width = ceil(max_seq_len / page_size)).
     temperature/top_k/sample_seed: sampling controls (module docstring);
     temperature <= 0 compiles to the pre-sampling argmax program.
+    kv_cache_dtype: overrides the task's layer-level kv_cache_dtype for
+    this engine's page pool (None keeps it; see quant/kv.py) — 'int8'
+    turns on quantize-on-write KV pages with scale sidecars.
+    serve_int8_weights: rewrite the served theta so decode projections run
+    as `Int8Einsum` integer matmuls (quant/weights.py); the float theta is
+    untouched, only this engine's copy is rewritten.
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
     self._task = task
+    self.serve_int8_weights = bool(serve_int8_weights)
+    if serve_int8_weights:
+      theta, _ = quant_weights.Int8ServingTheta(theta)
     self._theta = theta
     self.page_size = page_size
     self.num_pages = num_pages
@@ -137,7 +149,15 @@ class ServingLoop:
     self.temperature = float(temperature)
     self.top_k = int(top_k)
     self.sample_seed = int(sample_seed)
-    self.alloc = kv_cache.PageAllocator(num_pages, page_size)
+    # KV census BEFORE allocating: the effective cache dtype prices a page
+    kv_census = kv_quant.StackKvCensus(task, kv_cache_dtype) or {}
+    self.kv_cache_dtype = kv_census.get("kv_cache_dtype")
+    self.kv_bytes_per_token = kv_census.get("kv_bytes_per_token", 0)
+    self._kv_quantized = self.kv_cache_dtype == "int8"
+    self._kv_override = kv_cache_dtype
+    self.alloc = kv_cache.PageAllocator(
+        num_pages, page_size,
+        page_bytes=page_size * self.kv_bytes_per_token)
     table_pages = self.alloc.PagesFor(max_seq_len)
     # mixer census: which resource(s) this stack's decode state occupies
     self.mixers = self._MixerCensus()
@@ -150,9 +170,11 @@ class ServingLoop:
         needs_kv_pages=self.mixers["num_attention"] > 0,
         state_pool=self.state_pool)
     # pool page num_pages (the +1) is the trash page padding writes hit;
-    # num_slots sizes the per-slot O(1) mixer states (attention ignores it)
-    init_fn = jax.jit(task.InitPagedDecodeState, static_argnums=(1, 2, 3))
-    self._states = init_fn(theta, num_pages + 1, page_size, max_batch)
+    # num_slots sizes the per-slot O(1) mixer states (attention ignores it);
+    # the kv dtype override is a static string arg (hashable)
+    init_fn = jax.jit(task.InitPagedDecodeState, static_argnums=(1, 2, 3, 4))
+    self._states = init_fn(theta, num_pages + 1, page_size, max_batch,
+                           kv_cache_dtype)
     # donate the pool into each step off-cpu (XLA:CPU can't alias + warns)
     donate = (1,) if jax.default_backend() != "cpu" else ()
     temp, topk = self.temperature, self.top_k
@@ -182,7 +204,7 @@ class ServingLoop:
     self._counters = {
         "steps": 0, "decode_steps": 0, "mixed_steps": 0,
         "tokens_emitted": 0, "prompt_tokens": 0,
-        "dense_fallback_steps": 0,
+        "dense_fallback_steps": 0, "quantized_steps": 0,
     }
     self._lock = threading.RLock()
     self._work = threading.Condition(self._lock)
@@ -227,19 +249,29 @@ class ServingLoop:
     }
 
   def _ClassifyPath(self) -> str:
-    """'pallas' | 'xla' | 'dense' | 'ssm' — what PagedStep lowers to.
+    """'pallas[-int8]' | 'xla[-int8]' | 'dense' | 'ssm' — what PagedStep
+    lowers to.
 
     A dense fallback (ineligible attention config) is CORRECT but not
-    paged-fast; it must be visible, never silent (ISSUE satellite).
-    'ssm' = no attention layer at all: the page pool is never read and
-    classification is about the recurrent-state path instead."""
+    paged-fast; it must be visible, never silent (ISSUE satellite). With
+    an int8 pool the fallback still reads quantized pages (gather +
+    dequantize), but loses the in-kernel dequant — equally worth
+    surfacing. 'ssm' = no attention layer at all: the page pool is never
+    read and classification is about the recurrent-state path instead."""
     attens = [m for m, _ in self._MixerLayers()
               if not hasattr(m, "StateBytesPerSlot")]
     if not attens:
       return "ssm"
-    if not all(a.BlockDecodeEligible(self.page_size) for a in attens):
-      return "dense"
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if self._kv_quantized:
+      if not all(a.QuantizedDecodeEligible(self.page_size) for a in attens):
+        return "dense"
+      suffix = "-int8"
+    else:
+      if not all(a.BlockDecodeEligible(self.page_size) for a in attens):
+        return "dense"
+      suffix = ""
+    base = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return base + suffix
 
   # -- async API -------------------------------------------------------------
 
@@ -347,6 +379,8 @@ class ServingLoop:
       self._counters["prompt_tokens"] += batch.prompt_tokens
       if self.paged_path == "dense":
         self._counters["dense_fallback_steps"] += 1
+      if self._kv_quantized:
+        self._counters["quantized_steps"] += 1
       for req_id, tok, finished in events:
         self._counters["tokens_emitted"] += 1
         h = self._handles.get(req_id)
@@ -391,6 +425,9 @@ class ServingLoop:
     with self._lock:
       stats = dict(self._counters)
       stats["paged_path"] = self.paged_path
+      stats["kv_cache_dtype"] = self.kv_cache_dtype
+      stats["kv_bytes_per_token"] = self.kv_bytes_per_token
+      stats["serve_int8_weights"] = self.serve_int8_weights
       stats["scheduler"] = self.sched.Stats()
       stats["kv_pages"] = self.alloc.Stats()
       stats["mixers"] = dict(self.mixers)

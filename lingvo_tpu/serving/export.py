@@ -51,23 +51,33 @@ def QuantizeThetaInt8(theta: NestedMap):
   simulation; ref inference_graph_exporter's dtype-override rewrites).
   int8_tree: {path: {"w_int8", "scale"}} — the actual low-bit artifact for
   integer-math consumers (pairs with quant_utils.Int8Einsum).
+
+  Each leaf is quantized under its serving layout (quant.weights table):
+  per-channel scales reduce over the axes the consuming einsum contracts,
+  so `Predictor.Int8ServingTheta()` can mount the same pairs as Int8Weight
+  nodes for real integer matmuls. Artifact-only names (MoE experts, ...)
+  keep the legacy all-but-last-dim reduction; weights under a Repeated
+  stack's `.body.` get per-repeat scales (the repeat axis is batch, not
+  contraction).
   """
-  from lingvo_tpu.core import quant_utils
+  from lingvo_tpu.quant import weights as quant_weights
   frozen = theta.DeepCopy()
   int8_tree = {}
   for path, leaf in theta.FlattenItems():
     name = path.rsplit(".", 1)[-1]
     arr = np.asarray(leaf)
+    stacked = quant_weights.IsStackedPath(path)
     # jnp.issubdtype: np's returns False for bfloat16 (ml_dtypes), which
     # would silently skip every bf16-trained weight
-    if name not in _INT8_WEIGHT_NAMES or arr.ndim < 2 or (
+    if name not in _INT8_WEIGHT_NAMES or arr.ndim < (3 if stacked else 2) or (
         not jnp.issubdtype(arr.dtype, jnp.floating)):
       continue
-    w_int8, scale = quant_utils.Int8QuantizeWeight(
-        jnp.asarray(arr, jnp.float32), per_channel=True)
-    int8_tree[path] = {"w_int8": np.asarray(w_int8),
-                       "scale": np.asarray(scale)}
-    frozen.Set(path, (w_int8.astype(jnp.float32) * scale).astype(leaf.dtype))
+    layout, k = quant_weights.WeightLayoutFor(name)
+    w8 = quant_weights.QuantizeLeafInt8(
+        jnp.asarray(arr, jnp.float32), layout, k, stacked)
+    int8_tree[path] = {"w_int8": np.asarray(w8.w_int8),
+                       "scale": np.asarray(w8.scale)}
+    frozen.Set(path, w8.Dequant().astype(leaf.dtype))
   return frozen, int8_tree
 
 
@@ -124,6 +134,18 @@ class InferenceGraphExporter:
       ckptr.wait_until_finished()
       manifest["int8_artifact"] = "theta_int8"
       manifest["int8_weights"] = sorted(int8_tree)
+      from lingvo_tpu.quant import weights as quant_weights
+      layouts = {}
+      for path in sorted(int8_tree):
+        leaf_name = path.rsplit(".", 1)[-1]
+        layout, k = quant_weights.WeightLayoutFor(leaf_name)
+        layouts[path] = {
+            "layout": layout, "contract_ndim": k,
+            "stacked": quant_weights.IsStackedPath(path),
+            "serving_eligible":
+                leaf_name in quant_weights.SERVING_WEIGHT_LAYOUTS,
+        }
+      manifest["int8_layouts"] = layouts
     with open(os.path.join(export_dir, "inference_graph.json"), "w") as f:
       json.dump(manifest, f, indent=2)
     return manifest
@@ -167,3 +189,23 @@ class Predictor:
     restored = ckptr.restore(
         os.path.join(self._dir, self._manifest["int8_artifact"]))
     return restored["int8"]
+
+  def Int8ServingTheta(self, mode: str = "int8") -> NestedMap:
+    """The restored theta with serving-eligible leaves mounted from the
+    int8 artifact.
+
+    mode='int8': Int8Weight nodes — decode projections run integer
+    matmuls (quant_utils.Int8Einsum) with a bounded, reported numeric
+    delta vs the frozen export. mode='dequant': the float dequantization
+    grid `w_int8 * scale` — bitwise identical to the frozen theta the
+    export saved (the freeze contract), so ScoreSequences through it
+    matches the exported graph exactly.
+    """
+    int8_tree = self.Int8Weights()
+    if int8_tree is None:
+      raise ValueError(
+          "Int8ServingTheta requires an export made with quantize_int8=True")
+    from lingvo_tpu.quant import weights as quant_weights
+    theta, _ = quant_weights.Int8ServingThetaFromArtifact(
+        self._theta, int8_tree, mode=mode)
+    return theta

@@ -40,10 +40,14 @@ class PageAllocator:
   device pool is outside [0, num_pages) and never managed here.
   """
 
-  def __init__(self, num_pages: int, page_size: int):
+  def __init__(self, num_pages: int, page_size: int, page_bytes: int = 0):
     assert num_pages > 0 and page_size > 0, (num_pages, page_size)
     self.num_pages = num_pages
     self.page_size = page_size
+    # device bytes one logical page costs across EVERY layer's pool, scale
+    # sidecars included (metadata only — the engine prices it from its KV
+    # census so quantized pools report honest HBM numbers)
+    self.page_bytes = int(page_bytes)
     self._free = list(range(num_pages))  # already a valid min-heap
     self._owned: dict[object, list[int]] = {}
     self.peak_in_use = 0
@@ -70,7 +74,7 @@ class PageAllocator:
     return list(self._owned[seq_id])
 
   def Stats(self) -> dict:
-    return {
+    out = {
         "num_pages": self.num_pages,
         "page_size": self.page_size,
         "in_use": self.num_in_use,
@@ -79,6 +83,10 @@ class PageAllocator:
         "peak_in_use": self.peak_in_use,
         "num_sequences": len(self._owned),
     }
+    if self.page_bytes:
+      out["page_bytes"] = self.page_bytes
+      out["pool_bytes"] = self.page_bytes * self.num_pages
+    return out
 
   # -- mutations -------------------------------------------------------------
 

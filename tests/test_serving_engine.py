@@ -505,10 +505,15 @@ class TestServingEngine:
     assert telem is not None
     assert set(telem) == {"prefill_s", "decode_s", "total_s",
                           "prompt_tokens", "decode_tokens",
-                          "tokens_per_sec", "decode_state_bytes_per_seq"}
+                          "tokens_per_sec", "decode_state_bytes_per_seq",
+                          "kv_cache_dtype", "kv_bytes_per_token",
+                          "serve_int8_weights"}
     assert telem["prompt_tokens"] == 7 and telem["decode_tokens"] == 12
     assert telem["decode_state_bytes_per_seq"] > 0
     assert telem["tokens_per_sec"] > 0
+    assert telem["kv_cache_dtype"] == "float32"
+    assert telem["kv_bytes_per_token"] > 0
+    assert telem["serve_int8_weights"] is False
     assert all(r["telemetry"] == telem for r in recs)
 
     eng = engine_lib.ServingLoop(
