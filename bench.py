@@ -560,6 +560,166 @@ def _BenchServing(jax, jnp, model_registry, on_tpu):
   }
 
 
+def _BenchSpecDecode(jax, jnp, model_registry, on_tpu, variants=None):
+  """Draft-and-verify speculative decoding vs the plain serving engine.
+
+  The same seeded Poisson request stream (mixed prompt/output lengths,
+  greedy sampling) is played in real time against the plain ServingLoop
+  and against spec-decode engines (serving/spec_decode.py). Both decode
+  greedily, so the spec engine's output streams must be BYTE-IDENTICAL
+  to the baseline's — asserted here; speculation may only change wall
+  clock, never tokens. Reports tokens_per_sec_speedup, the acceptance
+  rate/histogram (the whole game: a rejected draft token is wasted
+  draft+verify compute), p50/p99 latency, and rollback accounting.
+
+  variants: [(draft_source, k)] with draft_source in {"self", "model"};
+  default [("self", 8)] — the sweep tool ladders the full grid.
+  """
+  from lingvo_tpu.serving import engine as engine_lib
+  from lingvo_tpu.serving import spec_decode
+
+  rng = np.random.RandomState(0)
+  if on_tpu:
+    n_req, b_slots, page, max_seq = 48, 8, 128, 1024
+    p_lo, p_hi, o_lo, o_hi = 16, 256, 16, 256
+    mean_gap_s = 0.005
+  else:
+    # decode-heavy output range: speculation only engages on pure-decode
+    # iterations (mixed steps take the legacy path), so a prefill-bound
+    # stream would measure Amdahl's law, not the verify machinery
+    n_req, b_slots, page, max_seq = 24, 4, 8, 128
+    p_lo, p_hi, o_lo, o_hi = 4, 32, 16, 64
+    mean_gap_s = 0.005
+
+  mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  mp.task.use_rotary = True
+  if on_tpu:
+    mp.task.model_dim = 512
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 1024
+  else:
+    # same sizing rationale as _BenchServing: per-token model compute must
+    # dominate host dispatch or the comparison measures the Python loop
+    mp.task.model_dim = 256
+    mp.task.num_layers = 4
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 512
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  vocab = task.p.vocab_size
+  depth = task.p.num_layers
+
+  prompts = [rng.randint(1, vocab, rng.randint(p_lo, p_hi + 1)).astype(
+      np.int32) for _ in range(n_req)]
+  max_news = rng.randint(o_lo, o_hi + 1, n_req)
+  arrivals = np.concatenate(
+      [[0.0], np.cumsum(rng.exponential(mean_gap_s, n_req - 1))])
+  total_useful = int(np.sum(max_news))
+  pages_per_seq = -(-max_seq // page)
+
+  # independent draft model (the "model" variants): a much smaller pure
+  # O(1)-state stack over the SAME vocab — pageless, so its decode rows
+  # cost zero KV pages. Acceptance between two random-init models is NOT
+  # predictive of a real distilled draft (both collapse to last-token
+  # echo, so it skews high); the variant prices the catch-up/propose
+  # machinery, and byte-identity holds at any acceptance.
+  from lingvo_tpu.core import ssm as ssm_lib
+  from lingvo_tpu.models.lm import layers as lm_layers
+  dp = lm_layers.TransformerLm.Params().Set(
+      name="draft", vocab_size=vocab, model_dim=64, num_layers=2,
+      num_heads=2, hidden_dim=128, use_rotary=True,
+      mixer_tpl=ssm_lib.GatedSSMLayer.Params().Set(state_dim=8,
+                                                   chunk_size=4),
+      mixer_atten_every_n=0)
+  draft_task = dp.Instantiate()
+  draft_task.FinalizePaths()
+  draft_theta = draft_task.InstantiateVariables(jax.random.PRNGKey(7))
+
+  def _MakeSpec(source, k):
+    if source == "self":
+      return spec_decode.SelfDraft(k=k, num_layers=1)
+    return spec_decode.ModelDraft(draft_task, draft_theta, k=k)
+
+  def _Play(spec):
+    """Plays the stream in real time; returns (outputs, wall, lat, stats)."""
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=page, num_pages=b_slots * pages_per_seq,
+        max_batch=b_slots, max_seq_len=max_seq,
+        prefill_chunk=16 if on_tpu else 4, spec=spec)
+    eng.Start()
+    # warmup compiles every step program this engine owns (mixed, decode,
+    # and — when spec — the draft + verify programs)
+    eng.Submit([1, 2, 3], 8).Result(timeout=1200)
+    t0 = time.perf_counter()
+    handles = []
+    for i in range(n_req):
+      dt = t0 + arrivals[i] - time.perf_counter()
+      if dt > 0:
+        time.sleep(dt)
+      handles.append(eng.Submit(prompts[i], int(max_news[i])))
+    outs = [h.Result(timeout=1200) for h in handles]
+    wall = time.perf_counter() - t0
+    lat = np.array([h.finish_time - h.submit_time for h in handles])
+    stats = eng.Stats()
+    eng.Stop()
+    return outs, wall, lat, stats
+
+  def _LatStats(lat):
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1),
+        "mean_ms": round(float(np.mean(lat)) * 1e3, 1),
+    }
+
+  base_outs, base_wall, base_lat, base_stats = _Play(None)
+  base_tps = total_useful / base_wall
+  result = {
+      "requests": n_req,
+      "useful_tokens": total_useful,
+      "prompt_len_range": [p_lo, p_hi],
+      "output_len_range": [o_lo, o_hi],
+      "mean_interarrival_ms": round(mean_gap_s * 1e3, 1),
+      "slots": b_slots,
+      "target_layers": depth,
+      "paged_path": base_stats["paged_path"],
+      "baseline": {
+          "wall_s": round(base_wall, 3),
+          "tokens_per_sec": round(base_tps, 1),
+          "latency": _LatStats(base_lat),
+          "steps": base_stats["steps"],
+      },
+      "variants": [],
+  }
+  for source, k in (variants or [("self", 8)]):
+    outs, wall, lat, stats = _Play(_MakeSpec(source, k))
+    # the bar that makes the speedup honest: byte-identical greedy streams
+    assert outs == base_outs, f"spec({source}, k={k}) diverged from greedy"
+    tps = total_useful / wall
+    drafted = stats["draft_tokens"]
+    result["variants"].append({
+        "draft": source,
+        "k": k,
+        "draft_layers": 1 if source == "self" else draft_task.p.num_layers,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(tps, 1),
+        "tokens_per_sec_speedup": round(tps / max(base_tps, 1e-9), 3),
+        "latency": _LatStats(lat),
+        "output_streams_identical": True,
+        "steps": stats["steps"],
+        "spec_cycles": stats["spec_cycles"],
+        "acceptance_rate": round(
+            stats["accepted_tokens"] / max(drafted, 1), 3),
+        "accepted_len_hist": stats["accepted_len_hist"],
+        "rolled_back_tokens": stats["kv_pages"]["rolled_back_tokens"],
+    })
+  best = max(v["tokens_per_sec_speedup"] for v in result["variants"])
+  result["tokens_per_sec_speedup"] = best
+  return result
+
+
 def _BenchQuantServing(jax, jnp, model_registry, on_tpu):
   """f32 vs int8-KV serving engines at the SAME HBM byte budget.
 
@@ -1391,6 +1551,8 @@ def main():
       ("flash_attention", lambda: _BenchFlashAttention(jax, jnp, on_tpu)),
       ("decode", lambda: _BenchDecode(jax, jnp, model_registry, on_tpu)),
       ("serving", lambda: _BenchServing(jax, jnp, model_registry, on_tpu)),
+      ("spec_decode",
+       lambda: _BenchSpecDecode(jax, jnp, model_registry, on_tpu)),
       ("quant_serving",
        lambda: _BenchQuantServing(jax, jnp, model_registry, on_tpu)),
       ("fused_xent",

@@ -22,6 +22,15 @@ import jax
 import jax.numpy as jnp
 
 
+def _TransformLogits(logits, temperature: float, top_k: int):
+  """Temperature + top-k mask, exactly as SampleFromLogits applies them."""
+  logits = logits.astype(jnp.float32) / float(temperature)
+  if top_k > 0 and top_k < logits.shape[-1]:
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+  return logits
+
+
 def SampleFromLogits(logits, key, temperature: float = 0.0,
                      top_k: int = 0, row_seeds=None, positions=None):
   """Draws one token id per row from `logits`.
@@ -50,12 +59,9 @@ def SampleFromLogits(logits, key, temperature: float = 0.0,
   """
   if temperature <= 0.0:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-  logits = logits.astype(jnp.float32) / float(temperature)
-  if top_k > 0 and top_k < logits.shape[-1]:
-    # kth-largest per row; ties at the threshold all stay live, which
-    # only widens the candidate set and keeps the mask monotone in k
-    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-    logits = jnp.where(logits < kth, -jnp.inf, logits)
+  # ties at the top-k threshold all stay live, which only widens the
+  # candidate set and keeps the mask monotone in k
+  logits = _TransformLogits(logits, temperature, top_k)
   if row_seeds is None:
     assert positions is None, "positions requires row_seeds"
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
@@ -73,3 +79,114 @@ def SampleFromLogits(logits, key, temperature: float = 0.0,
   return jax.vmap(
       lambda k, l: jax.random.categorical(k, l, axis=-1))(
           row_keys, logits).astype(jnp.int32)
+
+
+def SpecVerifyTokens(target_logits, draft_tokens, draft_logits, key,
+                     temperature: float = 0.0, top_k: int = 0,
+                     row_seeds=None, row_pos=None, draft_valid=None):
+  """Draft-and-verify acceptance over one ragged verify step.
+
+  The verify step fed each row its last emitted token t0 followed by K
+  draft proposals d_1..d_K, so `target_logits[:, j]` is the target
+  distribution for the token AFTER verify input j (col 0 predicts the
+  token after t0, i.e. what the non-speculative engine would emit next).
+  `draft_tokens[:, j]` (= d_{j+1}) is checked against col j.
+
+  Acceptance rules:
+  - `temperature <= 0`: greedy — accept the longest prefix of proposals
+    that match the target argmax chain. The emitted tokens are the target
+    argmaxes themselves, so the output stream is bitwise identical to the
+    non-speculative greedy engine no matter what the draft proposed.
+  - `temperature > 0`: standard residual speculative sampling. Proposal j
+    is accepted iff u_j < p_j(d)/q_j(d) with p/q the temperature/top-k
+    transformed target/draft distributions; on first rejection the token
+    is drawn from the normalized residual max(p - q, 0) — accept-or-
+    residual together emit exactly p, so any draft leaves each request's
+    output law unchanged. When every valid proposal is accepted, the
+    bonus token at the next column is drawn with the SAME (key, row seed,
+    output position) categorical call the non-speculative engine would
+    have used at that stream position (bitwise).
+
+  Args:
+    target_logits: [B, C, V] verify-step logits (C = K+1 columns).
+    draft_tokens: [B, K] int32 proposals.
+    draft_logits: [B, K, V] draft logits at each proposal (ignored when
+      temperature <= 0; must be given otherwise).
+    key: engine PRNGKey (as SampleFromLogits).
+    temperature/top_k: static sampling controls (as SampleFromLogits).
+    row_seeds: [B] int32 per-request seeds (required at temperature > 0).
+    row_pos: [B] int32 output index of col 0's token per row — the draw at
+      col j uses stream position row_pos + j, composing with the
+      per-request replayable streams.
+    draft_valid: optional [B, K] bool — proposals beyond a row's ragged
+      in_len are marked invalid and can never be accepted.
+
+  Returns:
+    (out_tokens [B, C] int32, accept_len [B] int32). The caller emits
+    out_tokens[i, :accept_len[i] + 1]; entries past that are unconsumed.
+  """
+  b, c, _ = target_logits.shape
+  k = c - 1
+  assert draft_tokens.shape[1] == k, (draft_tokens.shape, c)
+  if draft_valid is None:
+    draft_valid = jnp.ones((b, k), bool)
+  if temperature <= 0.0:
+    g = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)   # [B, C]
+    match = (g[:, :k] == draft_tokens) & draft_valid
+    accept_len = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                         axis=1)
+    return g, accept_len.astype(jnp.int32)
+
+  assert row_seeds is not None and row_pos is not None, (
+      "speculative sampling at temperature > 0 needs per-request streams")
+  tl = _TransformLogits(target_logits, temperature, top_k)      # [B, C, V]
+  ql = _TransformLogits(draft_logits, temperature, top_k)       # [B, K, V]
+  p = jax.nn.softmax(tl, axis=-1)
+  q = jax.nn.softmax(ql, axis=-1)
+  pos = (row_pos.astype(jnp.uint32)[:, None]
+         + jnp.arange(c, dtype=jnp.uint32)[None])               # [B, C]
+
+  def _PosKey(seed, pp):
+    return jax.random.fold_in(jax.random.fold_in(key, seed), pp)
+
+  keys = jax.vmap(jax.vmap(_PosKey, (None, 0)))(
+      row_seeds.astype(jnp.uint32), pos)                        # [B, C] keys
+  # acceptance coin per proposal column: u_j q_j(d) < p_j(d)
+  u = jax.vmap(jax.vmap(lambda kk: jax.random.uniform(
+      jax.random.fold_in(kk, 1))))(keys[:, :k])                 # [B, K]
+  d_idx = draft_tokens[..., None].astype(jnp.int32)
+  p_d = jnp.take_along_axis(p[:, :k], d_idx, axis=-1)[..., 0]
+  q_d = jnp.take_along_axis(q[:, :k], d_idx, axis=-1)[..., 0]
+  accept = (u * q_d < p_d) & draft_valid
+  accept_len = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                       axis=1).astype(jnp.int32)
+  # the non-speculative draw at every column (bitwise the SampleFromLogits
+  # call the legacy engine makes at that stream position) — used as the
+  # bonus token when all valid proposals were accepted
+  bonus = jax.vmap(jax.vmap(
+      lambda kk, ll: jax.random.categorical(kk, ll, axis=-1)))(
+          keys, tl).astype(jnp.int32)                           # [B, C]
+  # residual token per proposal column: sample norm(max(p - q, 0)); if the
+  # residual is identically zero (p == q) any draw from p is lawful
+  resid = jnp.maximum(p[:, :k] - q, 0.0)
+  degenerate = jnp.sum(resid, axis=-1, keepdims=True) <= 0.0
+  resid_logits = jnp.where(degenerate, tl[:, :k],
+                           jnp.log(jnp.maximum(resid, 1e-30)))
+  rej = jax.vmap(jax.vmap(
+      lambda kk, ll: jax.random.categorical(
+          jax.random.fold_in(kk, 2), ll, axis=-1)))(
+              keys[:, :k], resid_logits).astype(jnp.int32)      # [B, K]
+  # col accept_len is a REJECTION when a valid proposal exists there,
+  # else the all-accepted bonus position
+  n_valid = jnp.sum(jnp.cumprod(draft_valid.astype(jnp.int32), axis=1),
+                    axis=1)
+  rejected = accept_len < n_valid                               # [B]
+  rej_pad = jnp.concatenate([rej, bonus[:, -1:]], axis=1)       # [B, C]
+  at_cut = jnp.where(rejected[:, None], rej_pad, bonus)
+  d_pad = jnp.concatenate(
+      [draft_tokens.astype(jnp.int32),
+       jnp.zeros((b, 1), jnp.int32)], axis=1)
+  cols = jnp.arange(c, dtype=jnp.int32)[None]
+  out = jnp.where(cols < accept_len[:, None], d_pad,
+                  jnp.where(cols == accept_len[:, None], at_cut, bonus))
+  return out.astype(jnp.int32), accept_len

@@ -271,7 +271,7 @@ class GatedSSMLayer(base_layer.BaseLayer):
     return NestedMap(state=jnp.zeros((num_slots, n, h, s), jnp.float32))
 
   def PagedStep(self, theta, query_vec, cached_states: NestedMap,
-                block_tables, q_pos, in_len):
+                block_tables, q_pos, in_len, collect_col_states: bool = False):
     """One continuous-batching step; query_vec [B, C, D], B = engine slots.
 
     block_tables is ignored — the O(1) state needs no pages. Slot re-use is
@@ -279,6 +279,16 @@ class GatedSSMLayer(base_layer.BaseLayer):
     q_pos == 0 and its state resets to zero, so stale state from an evicted
     or finished occupant can never leak (the attention analogue is the
     engine masking via block tables). Rows past in_len are identity steps.
+
+    collect_col_states (speculative-decoding verify steps): additionally
+    return the state AFTER every column as `col_states` [B, C, N, H, S], so
+    the engine can roll the slot back to the last ACCEPTED column when a
+    draft suffix is rejected — the snapshot-and-restore half of KV-cursor
+    rollback, for state that (unlike KV pages) is destructively folded.
+    The columns are advanced through ssd_scan.SequentialStep, the exact
+    float ops of the C == 1 decode path, so a verify step's per-column
+    state trajectory (and output) is bitwise identical to feeding the same
+    tokens one step at a time — the greedy-identity bar of spec decoding.
     """
     del block_tables
     b, c_len, _ = query_vec.shape
@@ -291,6 +301,19 @@ class GatedSSMLayer(base_layer.BaseLayer):
     invalid = (jnp.arange(c_len, dtype=jnp.int32)[None]
                >= in_len[:, None]).astype(jnp.float32)
     decay_log, v = self._MaskScanInputs(decay_log, v, invalid)
+    if collect_col_states:
+      def _Col(s, xs):
+        dl, bb, cc, vv = xs
+        s_next, y_t = ssd_scan.SequentialStep(s, dl, bb, cc, vv)
+        return s_next, (y_t, s_next)
+
+      xs = tuple(jnp.moveaxis(t, 1, 0)
+                 for t in (decay_log, b_proj, c_proj, v))
+      s_new, (ys, cols) = jax.lax.scan(_Col, state, xs)
+      y = jnp.moveaxis(ys, 0, 1)
+      out = self._Finish(theta, y, v, gate)
+      return out, NestedMap(state=s_new,
+                            col_states=jnp.moveaxis(cols, 0, 1))
     if c_len == 1:
       s_new, y = ssd_scan.SequentialStep(
           state, decay_log[:, 0], b_proj[:, 0], c_proj[:, 0], v[:, 0])

@@ -165,11 +165,22 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
                                       kv_cache_dtype=kv_cache_dtype)
 
   def PagedStep(self, theta, query_vec, cached_states, block_tables, q_pos,
-                in_len):
-    """Block-table continuous-batching step (see attention.PagedStep)."""
+                in_len, ssm_col_states: bool = False):
+    """Block-table continuous-batching step (see attention.PagedStep).
+
+    ssm_col_states: speculative-verify mode — O(1)-state mixers also
+    return their per-column state trajectory for rejection rollback
+    (ssm.GatedSSMLayer.PagedStep); attention mixers ignore it (KV-page
+    rollback is free — the write cursor is host-side and reads never
+    pass q_pos + in_len)."""
     x = self.ln.FProp(theta.ln, query_vec)
-    out, new_states = self.atten.PagedStep(
-        theta.atten, x, cached_states, block_tables, q_pos, in_len)
+    if ssm_col_states and hasattr(self.atten, "StateBytesPerSlot"):
+      out, new_states = self.atten.PagedStep(
+          theta.atten, x, cached_states, block_tables, q_pos, in_len,
+          collect_col_states=True)
+    else:
+      out, new_states = self.atten.PagedStep(
+          theta.atten, x, cached_states, block_tables, q_pos, in_len)
     return query_vec + out, new_states
 
 
@@ -271,10 +282,10 @@ class TransformerLayer(base_layer.BaseLayer):
         kv_cache_dtype=kv_cache_dtype))
 
   def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
-                in_len):
+                in_len, ssm_col_states: bool = False):
     x, new_sa = self.self_atten.PagedStep(
         theta.self_atten, inputs, cached_states.self_atten, block_tables,
-        q_pos, in_len)
+        q_pos, in_len, ssm_col_states=ssm_col_states)
     out = self.fflayer.FProp(theta.fflayer, x)
     return out, NestedMap(self_atten=new_sa)
 
@@ -371,13 +382,38 @@ class StackedTransformerLayers(base_layer.BaseLayer):
     ])
 
   def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
-                in_len):
+                in_len, ssm_col_states: bool = False):
+    # forward the spec-verify flag only when set, so layer bodies that
+    # predate it (no serving contract) are never handed a surprise kwarg
+    kw = {"ssm_col_states": True} if ssm_col_states else {}
     x = inputs
     new_states = NestedMap(x_layers=[])
     for i, layer in enumerate(self.x_layers):
       x, ns = layer.PagedStep(theta.x_layers[i], x,
                               cached_states.x_layers[i], block_tables, q_pos,
-                              in_len)
+                              in_len, **kw)
+      new_states.x_layers.append(ns)
+    if self.p.final_ln:
+      x = self.final_ln.FProp(theta.final_ln, x)
+    return x, new_states
+
+  def PagedStepPrefix(self, theta, inputs, cached_states, block_tables,
+                      q_pos, in_len, num_layers: int):
+    """First num_layers layers only — the early-exit draft pass for
+    self-speculative decoding. States of the untouched suffix layers pass
+    through unchanged so the returned pytree matches PagedStep's (the
+    draft loop threads it as a transient carry and discards it)."""
+    assert 1 <= num_layers <= len(self.x_layers), (
+        num_layers, len(self.x_layers))
+    x = inputs
+    new_states = NestedMap(x_layers=[])
+    for i, layer in enumerate(self.x_layers):
+      if i < num_layers:
+        x, ns = layer.PagedStep(theta.x_layers[i], x,
+                                cached_states.x_layers[i], block_tables,
+                                q_pos, in_len)
+      else:
+        ns = cached_states.x_layers[i]
       new_states.x_layers.append(ns)
     if self.p.final_ln:
       x = self.final_ln.FProp(theta.final_ln, x)
@@ -504,13 +540,46 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
     return NestedMap(body=jax.vmap(_One)(theta.body))
 
   def PagedStep(self, theta, inputs, cached_states, block_tables, q_pos,
-                in_len):
+                in_len, ssm_col_states: bool = False):
+    kw = {"ssm_col_states": True} if ssm_col_states else {}
+
+    def _Body(carry, per_layer):
+      theta_i, states_i = per_layer
+      x, new_states = self.body.PagedStep(theta_i, carry, states_i,
+                                          block_tables, q_pos, in_len, **kw)
+      return x, new_states
+
+    out, new_states = jax.lax.scan(_Body, inputs,
+                                   (theta.body, cached_states.body))
+    return out, NestedMap(body=new_states)
+
+  def PagedStepPrefix(self, theta, inputs, cached_states, block_tables,
+                      q_pos, in_len, num_layers: int):
+    """First num_layers FLAT layers — the early-exit draft pass.
+
+    num_layers counts flat transformer layers from the bottom, so it must
+    be a multiple of the scanned body's depth (1 for a plain repeat, the
+    block depth for hybrid repeat-of-stacked bodies); the scan runs over
+    the sliced leading repeats and the suffix repeats' states pass
+    through untouched (pytree matches PagedStep's)."""
+    body_depth = (len(self.body.x_layers)
+                  if hasattr(self.body, "x_layers") else 1)
+    assert num_layers % body_depth == 0, (num_layers, body_depth)
+    reps = num_layers // body_depth
+    assert 1 <= reps <= self.p.num_layers, (reps, self.p.num_layers)
+    prefix_theta = jax.tree_util.tree_map(lambda t: t[:reps], theta.body)
+    prefix_states = jax.tree_util.tree_map(lambda s: s[:reps],
+                                           cached_states.body)
+
     def _Body(carry, per_layer):
       theta_i, states_i = per_layer
       x, new_states = self.body.PagedStep(theta_i, carry, states_i,
                                           block_tables, q_pos, in_len)
       return x, new_states
 
-    out, new_states = jax.lax.scan(_Body, inputs,
-                                   (theta.body, cached_states.body))
-    return out, NestedMap(body=new_states)
+    out, new_prefix = jax.lax.scan(_Body, inputs,
+                                   (prefix_theta, prefix_states))
+    new_body = jax.tree_util.tree_map(
+        lambda new, old: jnp.concatenate([new, old[reps:]], axis=0),
+        new_prefix, cached_states.body)
+    return out, NestedMap(body=new_body)

@@ -426,7 +426,8 @@ class TransformerLm(base_model.BaseTask):
                                       num_slots=num_slots,
                                       kv_cache_dtype=kv_cache_dtype)
 
-  def PagedStep(self, theta, ids, states, block_tables, q_pos, in_len):
+  def PagedStep(self, theta, ids, states, block_tables, q_pos, in_len,
+                ssm_col_states: bool = False):
     """Continuous-batching step: ids [b, c] -> (logits [b, c, vocab],
     states).
 
@@ -437,10 +438,37 @@ class TransformerLm(base_model.BaseTask):
     discarded by the engine). Same position policy as Prefill: rotary
     positions are the global slot indices, no absolute pos_emb (serve
     rotary models).
+
+    ssm_col_states: speculative-verify mode — every O(1)-state mixer in
+    the stack also returns its per-column state trajectory (`col_states`)
+    so the serving engine can roll rejected draft suffixes back
+    (serving/spec_decode.py selects the accepted column and strips the
+    extra leaf before the states re-enter the engine).
     """
     x = self.emb.EmbLookup(theta.emb, ids)
     x, new_states = self.stack.PagedStep(theta.stack, x, states,
-                                         block_tables, q_pos, in_len)
+                                         block_tables, q_pos, in_len,
+                                         ssm_col_states=ssm_col_states)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    if self.p.softmax_num_sampled > 0:
+      logits = self.sampled_softmax.Logits(
+          self.ChildTheta(theta, "sampled_softmax"), x)
+    else:
+      logits = self.emb.Logits(theta.emb, x)
+    return logits, new_states
+
+  def PagedStepPrefix(self, theta, ids, states, block_tables, q_pos, in_len,
+                      num_layers: int):
+    """Early-exit PagedStep: run only the first num_layers of the stack,
+    then the full final_ln + logits head — the self-speculation draft
+    pass (serving/spec_decode.py). The returned states carry the prefix
+    layers' writes with the suffix passed through (same pytree as
+    PagedStep); callers treat them as TRANSIENT — draft steps are never
+    committed, the verify step re-writes every position it keeps."""
+    x = self.emb.EmbLookup(theta.emb, ids)
+    x, new_states = self.stack.PagedStepPrefix(theta.stack, x, states,
+                                               block_tables, q_pos, in_len,
+                                               num_layers)
     x = self.final_ln.FProp(theta.final_ln, x)
     if self.p.softmax_num_sampled > 0:
       logits = self.sampled_softmax.Logits(

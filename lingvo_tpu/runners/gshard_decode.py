@@ -277,6 +277,12 @@ class GShardDecode:
         "kv_cache_dtype": census.get("kv_cache_dtype"),
         "kv_bytes_per_token": census.get("kv_bytes_per_token", 0),
         "serve_int8_weights": self._serve_int8_weights,
+        # speculative-decoding acceptance telemetry, mirrored with the
+        # serving engine's Stats() key-set so bench comparisons line up;
+        # batch-synchronous decode never drafts, so always zeros here
+        "draft_tokens": 0,
+        "accepted_tokens": 0,
+        "accepted_len_hist": [],
     }
     self._last_telemetry = telemetry
     results = []

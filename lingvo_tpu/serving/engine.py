@@ -15,6 +15,19 @@ with zero recompilation — the property that lets short requests overtake
 long ones instead of idling behind them (the batch-synchronous
 `GShardDecode` failure mode this engine replaces).
 
+Speculative decoding (serving/spec_decode.py) adds a THIRD compiled step
+program when a draft source is configured (`spec=SelfDraft(...)` or
+`spec=ModelDraft(...)`): on pure-decode iterations the engine runs a
+draft pass proposing k tokens per row, then ONE ragged `[B, k+1]` VERIFY
+step — the mixed-step machinery re-used as "k+1 causal queries against a
+paged prefix" — and commits each row's accepted prefix plus a
+bonus/correction token, rolling write cursors back over rejected tails.
+At temperature 0 the output streams are token-identical to the non-spec
+engine (greedy acceptance keeps exactly the argmax prefix); at
+temperature > 0 residual speculative sampling preserves each request's
+seeded output distribution. Per-request `spec_k` on Submit() opts
+individual requests out (0) or caps their draft length.
+
 Sampling: temperature 0 (default) is pure argmax — token-identical to
 batch-synchronous `GShardDecode`, the parity bar asserted in tests. With
 temperature > 0 (optional top_k) each request samples from its OWN
@@ -57,6 +70,7 @@ from lingvo_tpu.quant import kv as kv_quant
 from lingvo_tpu.quant import weights as quant_weights
 from lingvo_tpu.serving import kv_cache
 from lingvo_tpu.serving import scheduler as scheduler_lib
+from lingvo_tpu.serving import spec_decode
 
 _END = object()   # stream sentinel
 
@@ -119,7 +133,7 @@ class ServingLoop:
                default_max_new: int = 32, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                sample_seed: int = 0, kv_cache_dtype: Optional[str] = None,
-               serve_int8_weights: bool = False):
+               serve_int8_weights: bool = False, spec=None):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
@@ -132,6 +146,10 @@ class ServingLoop:
     serve_int8_weights: rewrite the served theta so decode projections run
     as `Int8Einsum` integer matmuls (quant/weights.py); the float theta is
     untouched, only this engine's copy is rewritten.
+    spec: optional speculative-decoding draft source —
+    `spec_decode.SelfDraft` (early-exit over the same theta) or
+    `spec_decode.ModelDraft` (independent pageless draft model). None
+    keeps the exact two-program legacy engine.
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -197,6 +215,15 @@ class ServingLoop:
       return jnp.stack(cols, axis=1), states
 
     self._step_fn = jax.jit(_Step, donate_argnums=donate)
+    # speculative decoding: the runner owns the draft + verify programs
+    # and (for ModelDraft) the draft model's recurrent state
+    self.spec = None
+    if spec is not None:
+      self.spec = spec_decode.SpecRunner(
+          spec, task=task, theta=theta, max_batch=max_batch,
+          page_size=page_size, prefill_chunk=prefill_chunk,
+          temperature=self.temperature, top_k=self.top_k,
+          sample_seed=self.sample_seed)
     # silent-fallback visibility: classify ONCE which attention path the
     # compiled step will take, and count ineligible (dense-fallback) steps
     self.paged_path = self._ClassifyPath()
@@ -205,6 +232,7 @@ class ServingLoop:
         "steps": 0, "decode_steps": 0, "mixed_steps": 0,
         "tokens_emitted": 0, "prompt_tokens": 0,
         "dense_fallback_steps": 0, "quantized_steps": 0,
+        "spec_cycles": 0, "draft_tokens": 0, "accepted_tokens": 0,
     }
     self._lock = threading.RLock()
     self._work = threading.Condition(self._lock)
@@ -215,38 +243,12 @@ class ServingLoop:
   # -- path classification ---------------------------------------------------
 
   def _MixerLayers(self):
-    """[(mixer_layer, multiplicity)] over the whole stack.
-
-    Handles all four stack shapes: plain Stacked (x_layers), plain
-    Repeated (body = one TransformerLayer, xN), and the hybrid Repeated
-    whose body is itself a StackedTransformerLayers block (body.x_layers,
-    each xN)."""
-    stack = self._task.stack
-    body = getattr(stack, "body", None)
-    if body is not None:
-      reps = stack.p.num_layers
-      inner = body.x_layers if hasattr(body, "x_layers") else [body]
-      return [(l.self_atten.atten, reps) for l in inner]
-    return [(l.self_atten.atten, 1) for l in stack.x_layers]
+    """[(mixer_layer, multiplicity)] — see spec_decode.MixerLayers."""
+    return spec_decode.MixerLayers(self._task)
 
   def _MixerCensus(self) -> dict:
-    """Counts attention vs O(1)-state mixers; prices the per-slot state.
-
-    A mixer is 'O(1)-state' iff it exposes StateBytesPerSlot (the
-    core/ssm.py contract); everything else is a paged-KV attention layer.
-    """
-    num_attention = num_ssm = state_bytes = 0
-    for mixer, reps in self._MixerLayers():
-      if hasattr(mixer, "StateBytesPerSlot"):
-        num_ssm += reps
-        state_bytes += reps * mixer.StateBytesPerSlot()
-      else:
-        num_attention += reps
-    return {
-        "num_attention": num_attention,
-        "num_ssm": num_ssm,
-        "decode_state_bytes_per_slot": state_bytes,
-    }
+    """Attention vs O(1)-state census — see spec_decode.MixerCensus."""
+    return spec_decode.MixerCensus(self._task)
 
   def _ClassifyPath(self) -> str:
     """'pallas[-int8]' | 'xla[-int8]' | 'dense' | 'ssm' — what PagedStep
@@ -312,17 +314,23 @@ class ServingLoop:
       self._thread = None
 
   def Submit(self, prompt, max_new_tokens: Optional[int] = None,
-             eos_id=_END, seed: Optional[int] = None) -> StreamHandle:
+             eos_id=_END, seed: Optional[int] = None,
+             spec_k: Optional[int] = None) -> StreamHandle:
     """Queues a request; returns its streaming handle immediately.
 
     seed: per-request sampling seed (defaults to the request id) — only
-    observable at temperature > 0; same seed = same continuation."""
+    observable at temperature > 0; same seed = same continuation.
+    spec_k: per-request speculative-decoding knob — None defers to the
+    engine (full draft length when a draft source is configured, exact
+    legacy behavior otherwise), 0 opts out, n > 0 caps the draft length
+    at min(n, engine k)."""
     max_new = max_new_tokens or self.default_max_new
     eos = self.eos_id if eos_id is _END else eos_id
     with self._lock:
       self._seq_counter += 1
       req_id = self._seq_counter
-      req = scheduler_lib.Request(req_id, prompt, max_new, eos, seed=seed)
+      req = scheduler_lib.Request(req_id, prompt, max_new, eos, seed=seed,
+                                  spec_k=spec_k)
       total = len(req.prompt) + req.max_new
       if self.sched.needs_kv_pages and (
           self.alloc.PagesFor(total) > self.alloc.num_pages):
@@ -357,14 +365,23 @@ class ServingLoop:
   # -- core step (shared by sync and async modes) ----------------------------
 
   def StepOnce(self) -> int:
-    """One admit → device step → commit iteration; returns #events."""
+    """One admit → device step → commit iteration; returns #events.
+
+    With a draft source configured, pure-decode iterations where at least
+    one row speculates become draft → verify → commit cycles; mixed steps
+    (and all-opted-out batches) take the unchanged legacy path."""
     with self._lock:
       self.sched.EvictCancelled()
       self.sched.Admit()
-      batch = self.sched.BuildStep()
-      if batch is None:
+      vbatch = None
+      if self.spec is not None:
+        vbatch = self.sched.BuildVerifyStep(self.spec.k)
+      batch = None if vbatch is not None else self.sched.BuildStep()
+      if vbatch is None and batch is None:
         return 0
       tables = np.array(self.sched.block_tables)  # freeze under the lock
+    if vbatch is not None:
+      return self._SpecCycle(vbatch, tables)
     sampled, new_states = self._step_fn(
         self._theta, self._states, jnp.asarray(batch.ids),
         jnp.asarray(batch.q_pos), jnp.asarray(batch.in_len),
@@ -372,6 +389,14 @@ class ServingLoop:
         jnp.asarray(batch.row_pos))
     self._states = new_states
     sampled = np.asarray(sampled)
+    if self.spec is not None and batch.mixed:
+      # independent-draft ride-along: the draft state consumes the same
+      # prompt chunks the target just cached (before CommitStep mutates
+      # the rows' state/cursors)
+      prefill_rows = np.array([
+          s is not None and s.state is scheduler_lib.SeqState.PREFILL
+          for s in batch.rows])
+      self.spec.ConsumeStep(batch, prefill_rows)
     with self._lock:
       events = self.sched.CommitStep(batch, sampled)
       self._counters["steps"] += 1
@@ -381,15 +406,51 @@ class ServingLoop:
         self._counters["dense_fallback_steps"] += 1
       if self._kv_quantized:
         self._counters["quantized_steps"] += 1
-      for req_id, tok, finished in events:
-        self._counters["tokens_emitted"] += 1
-        h = self._handles.get(req_id)
-        if h is None:
-          continue
-        h._Push(tok)
-        if finished:
-          h._Finish(self.sched._by_id[req_id].finish_reason)
+      self._PushEvents(events)
     return len(events)
+
+  def _SpecCycle(self, vbatch, tables) -> int:
+    """Draft k tokens per row → ragged [B, k+1] verify → commit prefix."""
+    spec = self.spec
+    d_toks, q_logits = spec.Draft(self._theta, self._states, vbatch, tables)
+    ids = np.array(vbatch.ids)
+    ids[:, 1:] = d_toks
+    vbatch.ids = ids
+    out, alen, new_states = spec.Verify(
+        self._theta, self._states, ids, vbatch, tables, q_logits)
+    self._states = new_states
+    out, alen = np.asarray(out), np.asarray(alen)
+    with self._lock:
+      events = self.sched.CommitVerifyStep(vbatch, out, alen)
+      self._counters["steps"] += 1
+      self._counters["decode_steps"] += 1
+      self._counters["spec_cycles"] += 1
+      if self.paged_path == "dense":
+        self._counters["dense_fallback_steps"] += 1
+      if self._kv_quantized:
+        self._counters["quantized_steps"] += 1
+      for i, seq in enumerate(vbatch.rows):
+        rk = int(vbatch.row_k[i])
+        if (seq is None or rk == 0
+            or seq.state is scheduler_lib.SeqState.CANCELLED):
+          continue
+        m = min(int(alen[i]), rk)
+        self._counters["draft_tokens"] += rk
+        self._counters["accepted_tokens"] += m
+        spec.accepted_len_hist[m] += 1
+      self._PushEvents(events)
+    return len(events)
+
+  def _PushEvents(self, events):
+    """Streams committed tokens to their handles (caller holds the lock)."""
+    for req_id, tok, finished in events:
+      self._counters["tokens_emitted"] += 1
+      h = self._handles.get(req_id)
+      if h is None:
+        continue
+      h._Push(tok)
+      if finished:
+        h._Finish(self.sched._by_id[req_id].finish_reason)
 
   # -- sync GShardDecode-parity mode ----------------------------------------
 
@@ -433,4 +494,10 @@ class ServingLoop:
       stats["mixers"] = dict(self.mixers)
       if self.state_pool is not None:
         stats["state_slots"] = self.state_pool.Stats()
+      # acceptance telemetry: hist[m] = verify rows whose accepted draft
+      # prefix had length m ([] for engines without a draft source)
+      stats["accepted_len_hist"] = (
+          self.spec.accepted_len_hist.tolist() if self.spec else [])
+      if self.spec is not None:
+        stats["spec"] = self.spec.Describe()
     return stats

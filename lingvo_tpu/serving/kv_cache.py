@@ -51,6 +51,13 @@ class PageAllocator:
     self._free = list(range(num_pages))  # already a valid min-heap
     self._owned: dict[object, list[int]] = {}
     self.peak_in_use = 0
+    # speculative-decoding rollback accounting: token slots that were
+    # written by a verify step and then rejected. Rollback is pure cursor
+    # arithmetic — the scheduler simply doesn't advance `seq.pos` past the
+    # accepted prefix, and the next cycle re-writes the same slots (reads
+    # are bounded by q_pos + in_len, so stale K/V past the cursor is never
+    # attended). No page ever moves; this counter is the only trace.
+    self.rolled_back_tokens = 0
 
   # -- queries ---------------------------------------------------------------
 
@@ -82,6 +89,7 @@ class PageAllocator:
         "utilization": self.num_in_use / self.num_pages,
         "peak_in_use": self.peak_in_use,
         "num_sequences": len(self._owned),
+        "rolled_back_tokens": self.rolled_back_tokens,
     }
     if self.page_bytes:
       out["page_bytes"] = self.page_bytes
@@ -102,6 +110,11 @@ class PageAllocator:
     self._owned.setdefault(seq_id, []).extend(got)
     self.peak_in_use = max(self.peak_in_use, self.num_in_use)
     return got
+
+  def NoteRollback(self, num_tokens: int):
+    """Records num_tokens rejected verify-step writes (cursor rollback)."""
+    assert num_tokens >= 0, num_tokens
+    self.rolled_back_tokens += int(num_tokens)
 
   def Free(self, seq_id) -> int:
     """Returns every page owned by seq_id to the pool; returns the count.

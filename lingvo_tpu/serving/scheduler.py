@@ -55,17 +55,26 @@ class Request:
   even when the caller doesn't pick one: resubmitting with the same seed
   under the same checkpoint yields the same continuation regardless of
   which slot or batch neighbors it is scheduled with.
+
+  spec_k: per-request speculative-decoding knob. None (default) defers to
+  the engine — full draft length k when the engine speculates, the exact
+  legacy single-token path otherwise. 0 opts this request out of
+  speculation entirely; n > 0 caps its draft length at min(n, engine k).
+  Only consulted by engines with a draft source configured.
   """
 
   def __init__(self, req_id, prompt, max_new_tokens: int,
-               eos_id: Optional[int] = None, seed: Optional[int] = None):
+               eos_id: Optional[int] = None, seed: Optional[int] = None,
+               spec_k: Optional[int] = None):
     prompt = [int(t) for t in prompt]
     assert len(prompt) >= 1, "empty prompt"
     assert max_new_tokens >= 1, max_new_tokens
+    assert spec_k is None or spec_k >= 0, spec_k
     self.id = req_id
     self.prompt = prompt
     self.max_new = int(max_new_tokens)
     self.eos_id = eos_id
+    self.spec_k = spec_k
     if seed is None:
       seed = req_id if isinstance(req_id, int) else abs(hash(req_id))
     self.seed = int(seed) % (2**31)
@@ -80,6 +89,9 @@ class Sequence:
     self.pos = 0          # tokens WRITTEN to the KV cache so far
     self.out = []         # generated tokens (out[-1] may not be cached yet)
     self.finish_reason = None
+    # committed tokens an independent draft model's recurrent state has
+    # consumed so far (speculative decoding only; engine-maintained)
+    self.draft_pos = 0
 
   @property
   def id(self):
@@ -94,7 +106,8 @@ class StepBatch:
   """One flattened device step (numpy; the engine jits over it)."""
 
   def __init__(self, ids, q_pos, in_len, rows, mixed: bool,
-               prompt_tokens: int, row_seeds=None, row_pos=None):
+               prompt_tokens: int, row_seeds=None, row_pos=None,
+               row_k=None):
     self.ids = ids          # [B, C] int32
     self.q_pos = q_pos      # [B] int32
     self.in_len = in_len    # [B] int32 (0 = inactive row)
@@ -106,6 +119,9 @@ class StepBatch:
     # (engine seed, request seed, output position), never of scheduling
     self.row_seeds = row_seeds  # [B] int32
     self.row_pos = row_pos      # [B] int32
+    # verify steps only: per-row draft length (in_len = row_k + 1); the
+    # engine fills ids[:, 1:] with the draft's proposals before launch
+    self.row_k = row_k          # [B] int32 or None
 
 
 class Scheduler:
@@ -290,6 +306,98 @@ class Scheduler:
         events.append((seq.id, tok, True))
       else:
         events.append((seq.id, tok, False))
+    return events
+
+  # -- speculative decoding (draft-and-verify) -------------------------------
+
+  def BuildVerifyStep(self, k: int) -> Optional[StepBatch]:
+    """Flattens live DECODE slots into one ragged [B, k+1] VERIFY step.
+
+    Row i carries its last emitted token at column 0 (exactly the token a
+    plain decode step would feed) plus row_k[i] draft slots the engine
+    fills after running the draft source; in_len = row_k + 1 makes the
+    step ragged through the SAME masking the mixed prefill path uses, so
+    rows that opt out (spec_k = 0) ride along with in_len == 1 — their
+    column-0 logits are the legacy decode logits.
+
+    row_k is clamped to the request's remaining token budget, which also
+    bounds every KV write to the pages reserved at admission (positions
+    written are q_pos .. q_pos + row_k <= prompt + max_new - 1).
+
+    Returns None when any live row is still prefilling (the caller takes
+    a normal mixed step) or when no row speculates this cycle (the caller
+    falls back to BuildStep)."""
+    assert k >= 1, k
+    rows = list(self.slots)
+    live = [s for s in rows if s is not None]
+    if not live or any(s.state is SeqState.PREFILL for s in live):
+      return None
+    b, c = self.max_slots, k + 1
+    ids = np.zeros((b, c), np.int32)
+    q_pos = np.zeros((b,), np.int32)
+    in_len = np.zeros((b,), np.int32)
+    row_seeds = np.zeros((b,), np.int32)
+    row_pos = np.zeros((b,), np.int32)
+    row_k = np.zeros((b,), np.int32)
+    any_spec = False
+    for i, seq in enumerate(rows):
+      if seq is None or seq.state is not SeqState.DECODE:
+        continue
+      q_pos[i] = seq.pos
+      row_seeds[i] = seq.req.seed
+      row_pos[i] = len(seq.out)
+      ids[i, 0] = seq.out[-1]
+      rk = k if seq.req.spec_k is None else min(seq.req.spec_k, k)
+      rk = min(rk, seq.req.max_new - len(seq.out))
+      row_k[i] = max(rk, 0)
+      in_len[i] = row_k[i] + 1
+      any_spec = any_spec or row_k[i] > 0
+    if not any_spec:
+      return None
+    return StepBatch(ids, q_pos, in_len, rows, mixed=False, prompt_tokens=0,
+                     row_seeds=row_seeds, row_pos=row_pos, row_k=row_k)
+
+  def CommitVerifyStep(self, batch: StepBatch, out_tokens: np.ndarray,
+                       accept_len: np.ndarray) -> list:
+    """Folds a verify step back in: emits each row's accepted prefix plus
+    the correction/bonus token, rolls the KV cursor back over the
+    rejected tail (pure accounting — rejected slots are re-written next
+    cycle, and reads never pass q_pos + in_len), and retires on
+    eos/max_new exactly like CommitStep.
+
+    out_tokens [B, k+1], accept_len [B] from the verify program. Returns
+    the same [(request_id, token, finished)] event list as CommitStep,
+    possibly several events per row."""
+    events = []
+    for i, seq in enumerate(batch.rows):
+      if seq is None or seq.state is not SeqState.DECODE:
+        continue   # cancelled mid-step: drop the tokens, evict at boundary
+      rk = int(batch.row_k[i])
+      m = min(int(accept_len[i]), rk)
+      # drafted-but-rejected tail: cursor rollback, counted on the pool
+      self.alloc.NoteRollback(rk - m)
+      committed = 0
+      for j in range(m + 1):
+        tok = int(out_tokens[i, j])
+        seq.pos += 1            # verify wrote this column's K/V already
+        seq.out.append(tok)
+        committed += 1
+        done_eos = (seq.req.eos_id is not None and tok == seq.req.eos_id)
+        done_len = len(seq.out) >= seq.req.max_new
+        if done_eos or done_len:
+          self.slots[i] = None
+          self.alloc.Free(seq.id)
+          if self.state_pool is not None:
+            self.state_pool.Release(seq.id)
+          self.finished += 1
+          self._Retire(seq, SeqState.FINISHED,
+                       "eos" if done_eos else "length")
+          events.append((seq.id, tok, True))
+          break
+        events.append((seq.id, tok, False))
+      if committed < m + 1:
+        # accepted tokens truncated by an early eos are rolled back too
+        self.alloc.NoteRollback(m + 1 - committed)
     return events
 
   def _Retire(self, seq: Sequence, state: SeqState, reason: str):

@@ -507,7 +507,13 @@ class TestServingEngine:
                           "prompt_tokens", "decode_tokens",
                           "tokens_per_sec", "decode_state_bytes_per_seq",
                           "kv_cache_dtype", "kv_bytes_per_token",
-                          "serve_int8_weights"}
+                          "serve_int8_weights", "draft_tokens",
+                          "accepted_tokens", "accepted_len_hist"}
+    # batch-synchronous decode never speculates: the spec keys exist (the
+    # engine-Stats mirror contract) but stay at their zero values
+    assert telem["draft_tokens"] == 0
+    assert telem["accepted_tokens"] == 0
+    assert telem["accepted_len_hist"] == []
     assert telem["prompt_tokens"] == 7 and telem["decode_tokens"] == 12
     assert telem["decode_state_bytes_per_seq"] > 0
     assert telem["tokens_per_sec"] > 0
