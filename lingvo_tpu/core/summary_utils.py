@@ -56,6 +56,24 @@ class SummaryWriter:
       if isinstance(v, (int, float, np.floating, np.integer)):
         self.Scalar(f"{prefix}{k}" if prefix else k, v, step)
 
+  def FromRegistry(self, registry, step: int, prefix: str = ""):
+    """Writes an observe.MetricsRegistry snapshot as scalar summaries.
+
+    The bridge from the metrics registry (observe/metrics.py) to event
+    files: numeric counters/gauges/section values go through Scalars'
+    numeric filter unchanged; histogram snapshots (dict-valued) flatten
+    to `<name>/count|sum|mean`. Returns the snapshot it wrote from."""
+    snap = registry.Snapshot()
+    flat = {}
+    for k, v in snap.items():
+      if isinstance(v, dict) and "counts" in v and "bounds" in v:
+        for field in ("count", "sum", "mean"):
+          flat[f"{k}/{field}"] = v[field]
+      else:
+        flat[k] = v
+    self.Scalars(flat, step, prefix=prefix)
+    return snap
+
   def Histogram(self, tag: str, values, step: int):
     with self._lock:
       if self._writer is not None:
@@ -117,13 +135,22 @@ def AddAttentionSummary(writer: SummaryWriter, name: str, probs, step: int,
 
 
 class StepRateTracker:
-  """steps/sec + examples/sec with decaying window (ref StepRateTracker:393)."""
+  """steps/sec + examples/sec with decaying window (ref StepRateTracker:393).
 
-  def __init__(self):
+  registry: optional observe.MetricsRegistry — each Update publishes the
+  smoothed rates as `train/<name>_steps_per_second` /
+  `_examples_per_second` gauges, so the cross-Run rate is readable from
+  the registry between summary writes."""
+
+  def __init__(self, registry=None, name: str = "train"):
     self._start = None
     self._last_step = 0
     self._rate = 0.0
     self._example_rate = 0.0
+    self._g_steps = self._g_examples = None
+    if registry is not None:
+      self._g_steps = registry.Gauge(f"train/{name}_steps_per_second")
+      self._g_examples = registry.Gauge(f"train/{name}_examples_per_second")
 
   def Update(self, step: int, examples_per_step: float = 0.0):
     now = time.time()
@@ -140,6 +167,9 @@ class StepRateTracker:
     self._example_rate = self._rate * examples_per_step
     self._start = now
     self._last_step = step
+    if self._g_steps is not None:
+      self._g_steps.Set(self._rate)
+      self._g_examples.Set(self._example_rate)
     return self._rate
 
   @property

@@ -1,0 +1,27 @@
+"""lingvo_tpu.observe: the framework-wide observability layer (ISSUE 12).
+
+Three pillars, one import:
+
+- `MetricsRegistry` / `Default()` (observe/metrics.py): counters, gauges,
+  histograms with atomic snapshots and monotonic-delta semantics. Serving
+  engines own per-instance registries; train/eval programs and infeeds
+  publish to the process-global default.
+- `TraceRecorder` (observe/trace.py): per-request serving lifecycle traces
+  in a lock-cheap ring buffer, derived per-request metrics, and Chrome
+  trace-event JSON export (Perfetto-openable; one row per decode slot).
+- `ProfileWindow` / `CompileLog` (observe/profile.py): on-demand
+  jax.profiler trace windows (no-op when unsupported) and one-shot
+  per-compiled-program records (compile wall time, XLA memory plan,
+  donation set).
+
+`observe.schema` declares every telemetry key set once — engine `Stats()`
+and GShardDecode telemetry are views generated from it.
+"""
+
+from lingvo_tpu.observe import schema  # noqa: F401
+from lingvo_tpu.observe.metrics import (  # noqa: F401
+    DEFAULT_BOUNDS, Default, MetricsRegistry)
+from lingvo_tpu.observe.profile import (  # noqa: F401
+    CompileInfo, CompileLog, ProfileWindow, ProfilerSupported)
+from lingvo_tpu.observe.trace import (  # noqa: F401
+    RequestTrace, TraceRecorder)

@@ -1,0 +1,229 @@
+"""MetricsRegistry: counters / gauges / histograms with snapshot+delta.
+
+A deliberately tiny, dependency-free registry the whole framework publishes
+through (ISSUE 12 pillar 2). Design points:
+
+- **Kinds.** `Counter` is monotonic (`Inc`), `Gauge` holds the latest value
+  (`Set` — numeric by convention, but config facts like a dtype string are
+  allowed; consumers that need numbers filter, see
+  `SummaryWriter.FromRegistry`). `GaugeFn` registers a zero-arg callback
+  evaluated lazily at snapshot time; re-registering the same name REPLACES
+  the callback, so per-Run throwaway objects (eval infeeds) don't leak
+  stale providers. `SectionFn` is a GaugeFn returning a whole dict, spliced
+  into the snapshot as `section/key` — one callback per stats provider
+  (scheduler, allocator) instead of one lambda per field. `Histogram`
+  buckets observations against fixed bounds.
+- **Snapshot + delta.** `Snapshot()` returns one flat plain-python dict —
+  an atomic, consistent read under the registry lock. `Delta(prev)`
+  subtracts a previous snapshot: counters and histogram counts are
+  monotonic so deltas are rates over the interval; gauges report their
+  current value (a delta of a level is meaningless).
+- **Locking.** One lock per registry; every mutation is a few Python ops
+  under it, cheap enough for per-token increments on the serving hot path
+  (the bench's tracing-overhead criterion covers this).
+
+Engines default to their OWN registry instance (test isolation, and a
+multi-engine process keeps replicas separate); train-side programs publish
+to the process-global `Default()` registry.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+# Default histogram bounds: latency-ish seconds, log-spaced. Callers with
+# different units pass their own bounds.
+DEFAULT_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                  0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+  """Monotonic counter. Mutate via Inc(); read via .value."""
+
+  __slots__ = ("name", "value", "_lock")
+
+  def __init__(self, name: str, lock):
+    self.name = name
+    self.value = 0
+    self._lock = lock
+
+  def Inc(self, n: int = 1):
+    assert n >= 0, f"counter {self.name} is monotonic (Inc({n}))"
+    with self._lock:
+      self.value += n
+
+
+class Gauge:
+  """Latest-value gauge (numeric by convention; config facts allowed)."""
+
+  __slots__ = ("name", "value", "_lock")
+
+  def __init__(self, name: str, lock):
+    self.name = name
+    self.value = None
+    self._lock = lock
+
+  def Set(self, value):
+    with self._lock:
+      self.value = value
+
+
+class Histogram:
+  """Fixed-bounds histogram: counts[i] = observations <= bounds[i];
+  counts[-1] = overflow. Snapshot form: {count, sum, mean, bounds,
+  counts}."""
+
+  __slots__ = ("name", "bounds", "counts", "total", "sum", "_lock")
+
+  def __init__(self, name: str, lock, bounds=DEFAULT_BOUNDS):
+    assert list(bounds) == sorted(bounds), bounds
+    self.name = name
+    self.bounds = tuple(float(b) for b in bounds)
+    self.counts = [0] * (len(self.bounds) + 1)
+    self.total = 0
+    self.sum = 0.0
+    self._lock = lock
+
+  def Observe(self, value):
+    v = float(value)
+    with self._lock:
+      self.counts[bisect.bisect_left(self.bounds, v)] += 1
+      self.total += 1
+      self.sum += v
+
+  def _SnapshotLocked(self) -> dict:
+    return {
+        "count": self.total,
+        "sum": self.sum,
+        "mean": self.sum / self.total if self.total else 0.0,
+        "bounds": list(self.bounds),
+        "counts": list(self.counts),
+    }
+
+
+class MetricsRegistry:
+  """Named metrics + atomic flat snapshots (module docstring)."""
+
+  def __init__(self, name: str = ""):
+    self.name = name
+    self._lock = threading.RLock()
+    self._counters: dict[str, Counter] = {}
+    self._gauges: dict[str, Gauge] = {}
+    self._gauge_fns: dict[str, object] = {}
+    self._section_fns: dict[str, object] = {}
+    self._histograms: dict[str, Histogram] = {}
+
+  # -- registration (get-or-create; re-registration replaces callbacks) ----
+
+  def Counter(self, name: str) -> Counter:
+    with self._lock:
+      if name not in self._counters:
+        self._counters[name] = Counter(name, self._lock)
+      return self._counters[name]
+
+  def Gauge(self, name: str) -> Gauge:
+    with self._lock:
+      if name not in self._gauges:
+        self._gauges[name] = Gauge(name, self._lock)
+      return self._gauges[name]
+
+  def GaugeFn(self, name: str, fn):
+    """Lazy gauge: `fn()` evaluated at snapshot time. Replaces by name."""
+    with self._lock:
+      self._gauge_fns[name] = fn
+
+  def SectionFn(self, section: str, fn):
+    """Lazy dict provider: `fn()` items land as `section/key`. Replaces
+    by name — a new provider instance (fresh engine run, throwaway eval
+    infeed) simply takes the section over."""
+    with self._lock:
+      self._section_fns[section] = fn
+
+  def Histogram(self, name: str, bounds=DEFAULT_BOUNDS) -> Histogram:
+    with self._lock:
+      if name not in self._histograms:
+        self._histograms[name] = Histogram(name, self._lock, bounds)
+      return self._histograms[name]
+
+  def Describe(self) -> dict:
+    """{name: kind} for every registered metric (sections as declared)."""
+    with self._lock:
+      out = {n: "counter" for n in self._counters}
+      out.update({n: "gauge" for n in self._gauges})
+      out.update({n: "gauge_fn" for n in self._gauge_fns})
+      out.update({n: "section" for n in self._section_fns})
+      out.update({n: "histogram" for n in self._histograms})
+      return out
+
+  # -- reads ----------------------------------------------------------------
+
+  def Snapshot(self) -> dict:
+    """One flat, mutually-consistent dict of every metric's current value.
+
+    Callback (GaugeFn/SectionFn) errors surface as the exception string
+    rather than killing the snapshot — stats must never take down a serving
+    loop."""
+    with self._lock:
+      out = {}
+      for n, c in self._counters.items():
+        out[n] = c.value
+      for n, g in self._gauges.items():
+        out[n] = g.value
+      for n, fn in self._gauge_fns.items():
+        try:
+          out[n] = fn()
+        except Exception as e:  # noqa: BLE001
+          out[n] = f"<error: {e}>"
+      for section, fn in self._section_fns.items():
+        try:
+          for k, v in fn().items():
+            out[f"{section}/{k}"] = v
+        except Exception as e:  # noqa: BLE001
+          out[section] = f"<error: {e}>"
+      for n, h in self._histograms.items():
+        out[n] = h._SnapshotLocked()
+      return out
+
+  def Delta(self, prev: dict) -> dict:
+    """Current snapshot minus `prev` (a previous Snapshot() return).
+
+    Counters subtract (monotonic ⇒ the interval's increment); histograms
+    subtract count/sum/bucket-counts; gauges/sections report their current
+    value. Metrics absent from `prev` report their full current value."""
+    cur = self.Snapshot()
+    with self._lock:
+      counter_names = set(self._counters)
+      hist_names = set(self._histograms)
+    out = {}
+    for n, v in cur.items():
+      if n in counter_names and isinstance(prev.get(n), (int, float)):
+        out[n] = v - prev[n]
+      elif n in hist_names and isinstance(prev.get(n), dict):
+        p = prev[n]
+        out[n] = {
+            "count": v["count"] - p.get("count", 0),
+            "sum": v["sum"] - p.get("sum", 0.0),
+            "bounds": v["bounds"],
+            "counts": [a - b for a, b in
+                       zip(v["counts"], p.get("counts", [0] * len(
+                           v["counts"])))],
+        }
+        out[n]["mean"] = (out[n]["sum"] / out[n]["count"]
+                          if out[n]["count"] else 0.0)
+      else:
+        out[n] = v
+    return out
+
+
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: MetricsRegistry | None = None
+
+
+def Default() -> MetricsRegistry:
+  """The process-global registry (train/eval programs, infeeds)."""
+  global _DEFAULT
+  with _DEFAULT_LOCK:
+    if _DEFAULT is None:
+      _DEFAULT = MetricsRegistry("default")
+    return _DEFAULT

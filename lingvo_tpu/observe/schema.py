@@ -1,0 +1,128 @@
+"""Shared telemetry schema: the single source of truth for metric keys.
+
+Before this module, the stack had grown parallel telemetry dialects —
+engine `ServingLoop.Stats()`, `GShardDecode`'s ad-hoc telemetry dict,
+per-program `infeed_wait_s` timers — whose key sets drifted apart as each
+PR added its keys to whichever surface it touched (the kv/paged-path keys
+landed twice, once per surface, in PRs 10-11). Every key set is now
+declared HERE, constructors validate against it, and the key-set tests
+assert both runtime surfaces against these constants, so the next key
+either lands everywhere or fails a test.
+
+Conventions:
+- Registry metric names are `namespace/key` with namespaces `serving/*`,
+  `scheduler/*`, `kv_pages/*`, `state_slots/*`, `infeed/*`, `train/*`.
+- A *surface* (Stats() dict, telemetry dict) is a plain-key view derived
+  from registry values; the schema maps between the two.
+"""
+
+from __future__ import annotations
+
+# -- serving engine Stats() --------------------------------------------------
+
+# Monotonic counters the engine increments per step/commit; Stats() carries
+# them under these exact plain keys, the registry under "serving/<key>".
+ENGINE_COUNTER_KEYS = (
+    "steps", "decode_steps", "mixed_steps",
+    "tokens_emitted", "prompt_tokens",
+    "dense_fallback_steps", "quantized_steps",
+    "spec_cycles", "draft_tokens", "accepted_tokens",
+)
+
+# Static engine configuration facts (set once at construction).
+ENGINE_INFO_KEYS = (
+    "paged_path", "kv_cache_dtype", "kv_bytes_per_token",
+    "serve_int8_weights",
+)
+
+# Nested sub-dict sections always present in Stats().
+ENGINE_SECTION_KEYS = ("scheduler", "kv_pages", "mixers")
+
+# Keys every engine Stats() dict must carry.
+ENGINE_STATS_REQUIRED = frozenset(
+    ENGINE_COUNTER_KEYS + ENGINE_INFO_KEYS + ENGINE_SECTION_KEYS
+    + ("accepted_len_hist",))
+
+# Keys present only under specific configurations:
+#   state_slots — stacks with O(1)-state mixers
+#   spec        — engines with a draft source
+#   trace       — engines with tracing enabled (the default)
+#   compile     — per-compiled-program records (observe/profile.py)
+ENGINE_STATS_OPTIONAL = frozenset(
+    {"state_slots", "spec", "trace", "compile"})
+
+
+def ValidateEngineStats(stats: dict) -> dict:
+  """Asserts a Stats() dict matches the schema; returns it unchanged."""
+  keys = set(stats)
+  missing = ENGINE_STATS_REQUIRED - keys
+  assert not missing, f"engine Stats() missing schema keys: {sorted(missing)}"
+  unknown = keys - ENGINE_STATS_REQUIRED - ENGINE_STATS_OPTIONAL
+  assert not unknown, f"engine Stats() keys not in schema: {sorted(unknown)}"
+  return stats
+
+
+# -- GShardDecode telemetry --------------------------------------------------
+
+# The batch-synchronous decode driver's per-DecodeOnce telemetry dict —
+# also attached to every result record under "telemetry". Shared keys
+# (below) mirror the engine surface so bench comparisons line up.
+GSHARD_TELEMETRY_KEYS = (
+    "prefill_s", "decode_s", "total_s",
+    "prompt_tokens", "decode_tokens", "tokens_per_sec",
+    "decode_state_bytes_per_seq",
+    "kv_cache_dtype", "kv_bytes_per_token", "serve_int8_weights",
+    "draft_tokens", "accepted_tokens", "accepted_len_hist",
+)
+
+# Keys both serving surfaces advertise (values must mean the same thing).
+SHARED_SERVING_KEYS = frozenset(GSHARD_TELEMETRY_KEYS) & (
+    ENGINE_STATS_REQUIRED)
+
+
+def GShardTelemetry(**values) -> dict:
+  """Builds a telemetry dict, validating the exact schema key set."""
+  keys = set(values)
+  missing = set(GSHARD_TELEMETRY_KEYS) - keys
+  assert not missing, f"telemetry missing schema keys: {sorted(missing)}"
+  unknown = keys - set(GSHARD_TELEMETRY_KEYS)
+  assert not unknown, f"telemetry keys not in schema: {sorted(unknown)}"
+  return {k: values[k] for k in GSHARD_TELEMETRY_KEYS}
+
+
+def PublishTelemetry(registry, values: dict, prefix: str = "serving/"):
+  """Publishes a telemetry dict into a registry as gauges."""
+  for k, v in values.items():
+    registry.Gauge(prefix + k).Set(v)
+
+
+def TelemetryFromRegistry(registry, prefix: str = "serving/") -> dict:
+  """The telemetry dict as a VIEW over registry gauges (inverse of
+  PublishTelemetry) — the single-source-of-truth path GShardDecode uses."""
+  snap = registry.Snapshot()
+  return GShardTelemetry(
+      **{k: snap[prefix + k] for k in GSHARD_TELEMETRY_KEYS})
+
+
+# -- sub-surface key sets ----------------------------------------------------
+
+# serving/scheduler.py Scheduler.Stats()
+SCHEDULER_STATS_KEYS = frozenset({
+    "slots", "slots_live", "slots_prefill", "queue_depth",
+    "admitted", "finished", "cancelled", "rejected_overlong",
+    "needs_kv_pages",
+})
+
+# serving/kv_cache.py PageAllocator.Stats() (page_bytes/pool_bytes only
+# when the engine priced the pool via its KV census)
+KV_PAGES_REQUIRED = frozenset({
+    "num_pages", "page_size", "in_use", "free", "utilization",
+    "peak_in_use", "num_sequences", "rolled_back_tokens",
+})
+KV_PAGES_OPTIONAL = frozenset({"page_bytes", "pool_bytes"})
+
+# observe/trace.py TraceRecorder.Stats()
+TRACE_STATS_KEYS = frozenset({
+    "events_emitted", "events_buffered", "events_dropped",
+    "requests_open", "requests_completed",
+})

@@ -37,10 +37,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lingvo_tpu import observe
 from lingvo_tpu.core import checkpointer as checkpointer_lib
 from lingvo_tpu.core import py_utils
 from lingvo_tpu.core import sampling
 from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.observe import schema as observe_schema
 from lingvo_tpu.quant import kv as kv_quant
 
 # Decode-program shape buckets (slots, ascending). Lengths beyond the last
@@ -100,7 +102,11 @@ class GShardDecode:
     # per-call timing of the last DecodeOnce (also attached to every
     # result rec under "telemetry"): prefill_s / decode_s / total_s /
     # tokens_per_sec — the apples-to-apples numbers the serving-engine
-    # bench compares against
+    # bench compares against. The dict itself is a VIEW over this driver's
+    # metrics registry, generated from observe.schema.GSHARD_TELEMETRY_KEYS
+    # so the two serving surfaces cannot drift apart again.
+    self.metrics = observe.MetricsRegistry("gshard_decode")
+    self._decodes = self.metrics.Counter("serving/decodes")
     self._last_telemetry = None
 
   def _GetDecodeFn(self, p_len: int, t_max: int):
@@ -265,25 +271,29 @@ class GShardDecode:
     # Stats() carries — a quantized (or non-default-dtype) cache is never
     # silent. Non-LM tasks without a recognizable stack report None/0.
     census = kv_quant.StackKvCensus(self._task) or {}
-    telemetry = {
-        "prefill_s": t1 - t0,
-        "decode_s": decode_s,
-        "total_s": t2 - t0,
-        "prompt_tokens": int(np.sum(prompt_lens)),
-        "decode_tokens": b * self._max_steps,
-        "tokens_per_sec": (b * self._max_steps / decode_s
-                           if decode_s > 0 else 0.0),
-        "decode_state_bytes_per_seq": state_bytes // b,
-        "kv_cache_dtype": census.get("kv_cache_dtype"),
-        "kv_bytes_per_token": census.get("kv_bytes_per_token", 0),
-        "serve_int8_weights": self._serve_int8_weights,
+    observe_schema.PublishTelemetry(self.metrics, observe_schema.GShardTelemetry(
+        prefill_s=t1 - t0,
+        decode_s=decode_s,
+        total_s=t2 - t0,
+        prompt_tokens=int(np.sum(prompt_lens)),
+        decode_tokens=b * self._max_steps,
+        tokens_per_sec=(b * self._max_steps / decode_s
+                        if decode_s > 0 else 0.0),
+        decode_state_bytes_per_seq=state_bytes // b,
+        kv_cache_dtype=census.get("kv_cache_dtype"),
+        kv_bytes_per_token=census.get("kv_bytes_per_token", 0),
+        serve_int8_weights=self._serve_int8_weights,
         # speculative-decoding acceptance telemetry, mirrored with the
         # serving engine's Stats() key-set so bench comparisons line up;
         # batch-synchronous decode never drafts, so always zeros here
-        "draft_tokens": 0,
-        "accepted_tokens": 0,
-        "accepted_len_hist": [],
-    }
+        draft_tokens=0,
+        accepted_tokens=0,
+        accepted_len_hist=[],
+    ))
+    self._decodes.Inc()
+    # the dict every result record carries is rebuilt FROM the registry —
+    # the registry is the source of truth, the dict is the view
+    telemetry = observe_schema.TelemetryFromRegistry(self.metrics)
     self._last_telemetry = telemetry
     results = []
     with open(self._output_path, "a") as f:

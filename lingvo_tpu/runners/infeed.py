@@ -59,6 +59,10 @@ class DeviceInfeed:
       `id(generator)`). Serializes producers across DeviceInfeed
       *instances* sharing one stream — see _LINGERING. Defaults to this
       instance (per-instance protection only).
+    registry: optional observe.MetricsRegistry — registers an
+      `infeed/<name>` section (wait_s / batches / queue_depth / healthy)
+      so every live infeed is visible in one snapshot; re-registering
+      under the same name replaces the section (throwaway eval infeeds).
 
   Batch ORDER is the iterator's order: one producer thread and one FIFO
   queue, so the consumed sequence is bit-identical to the synchronous path.
@@ -67,7 +71,8 @@ class DeviceInfeed:
   def __init__(self, make_iter: Callable[[], Iterator[Any]],
                place_fn: Callable[[Any], Any] | None = None,
                depth: int = 2, place_in_producer: bool = True,
-               name: str = "infeed", stream_key: Any = None):
+               name: str = "infeed", stream_key: Any = None,
+               registry: Any = None):
     self._stream_key = stream_key if stream_key is not None else id(self)
     self._make_iter = make_iter
     self._place_fn = place_fn
@@ -82,6 +87,17 @@ class DeviceInfeed:
     self._done = False
     self.wait_s = 0.0  # cumulative consumer blocking time (starvation)
     self.batches = 0   # batches handed to the consumer
+    if registry is not None:
+      registry.SectionFn(f"infeed/{name}", self.Stats)
+
+  def Stats(self) -> dict:
+    """Live counters for the registry's `infeed/<name>` section."""
+    return {
+        "wait_s": self.wait_s,
+        "batches": self.batches,
+        "queue_depth": self.QueueDepth(),
+        "healthy": self.healthy,
+    }
 
   @property
   def places_batches(self) -> bool:
@@ -223,14 +239,19 @@ class DeferredTelemetry:
   trial reporting, early-stop — lag dispatch by at most one loop.
   """
 
-  def __init__(self, name: str = "telemetry"):
+  def __init__(self, name: str = "telemetry", registry: Any = None):
     self._name = name
     self._pool: ThreadPoolExecutor | None = None
+    # optional job counter: how many deferred fetch/write jobs ran
+    self._jobs = (registry.Counter(f"infeed/{name}_jobs")
+                  if registry is not None else None)
 
   def Submit(self, fn: Callable[[], Any]) -> Future:
     if self._pool is None:
       self._pool = ThreadPoolExecutor(max_workers=1,
                                       thread_name_prefix=self._name)
+    if self._jobs is not None:
+      self._jobs.Inc()
     return self._pool.submit(fn)
 
   def Shutdown(self) -> None:

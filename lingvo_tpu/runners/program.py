@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lingvo_tpu import observe
 from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import hyperparams
 from lingvo_tpu.core import metrics as metrics_lib
@@ -109,7 +110,20 @@ class BaseProgram:
     from lingvo_tpu.core import summary_utils
     self._tb = summary_utils.SummaryWriter(
         self._program_dir, enabled=self.p.write_tensorboard)
-    self._rate_tracker = summary_utils.StepRateTracker()
+    # train-side observability publishes to the process-global registry
+    # (one trainer per process; serving engines use per-instance ones)
+    self.metrics = observe.Default()
+    self._rate_tracker = summary_utils.StepRateTracker(
+        registry=self.metrics, name=self.p.name or "train")
+    # {program_name: compile record} — wall time + XLA memory plan of each
+    # AOT Compile() (observe.CompileInfo); also published as train gauges
+    self.compile_records: dict = {}
+    # live generator-side counters (SequenceBatcher stats, prefetch depth);
+    # lazy via self._input so the snapshot never instantiates the generator
+    self.metrics.SectionFn(
+        f"infeed/{self.p.name or type(self).__name__}_input",
+        lambda: (self._InputStatsOf(self._input)
+                 if self._input is not None else {}))
 
   @property
   def task(self):
@@ -164,7 +178,26 @@ class BaseProgram:
     fn = self._GetStepFn(state)
     if hasattr(fn, "lower"):
       with self._MeshScope():
-        fn.lower(state, batch).compile()
+        self._RecordCompile("step", fn, state, batch)
+
+  def _RecordCompile(self, name: str, fn, *args) -> None:
+    """AOT-compiles `fn(*args)` once, recording wall time + the XLA memory
+    plan into `self.compile_records[name]` and the registry (ISSUE 12
+    pillar 3: per-compiled-program records for train/eval programs).
+    Dispatch behavior is unchanged: like the previous Compile(), the
+    executable is discarded and Run keeps calling the jit wrapper."""
+    t0 = time.perf_counter()
+    compiled = fn.lower(*args).compile()
+    rec = {"name": name,
+           "compile_wall_s": round(time.perf_counter() - t0, 6)}
+    rec.update(observe.CompileInfo(compiled))
+    self.compile_records[name] = rec
+    ns = self.p.name or type(self).__name__
+    self.metrics.Gauge(
+        f"{ns}/compile/{name}_wall_s").Set(rec["compile_wall_s"])
+    if "temp_bytes" in rec:
+      self.metrics.Gauge(
+          f"{ns}/compile/{name}_temp_bytes").Set(rec["temp_bytes"])
 
   def _GetStepFn(self, state: NestedMap | None = None):
     raise NotImplementedError
@@ -179,6 +212,7 @@ class BaseProgram:
     pass
 
   def WriteSummaries(self, step: int, values: dict[str, float]) -> None:
+    self._PublishRunMetrics(values)  # every process: registry is local
     if jax.process_index() != 0:
       return  # one writer per logdir (ref cluster.add_summary job gating)
     path = os.path.join(self._program_dir, "summaries.jsonl")
@@ -187,14 +221,38 @@ class BaseProgram:
     self._tb.Scalars(values, step)
     self._tb.Flush()
 
+  def _PublishRunMetrics(self, values: dict) -> None:
+    """Mirrors a Run's result dict into the process registry as gauges.
+
+    WriteSummaries is the single result sink for every program kind, so
+    hooking here covers train/eval/decode/input-benchmark uniformly.
+    Namespacing: input-pipeline keys (`input_*`, `infeed_*`) land under
+    `infeed/*` (the schema's pipeline namespace); everything else under
+    `<program name>/*`. Non-numeric values are skipped — they belong to
+    the JSONL record, not the metric surface."""
+    ns = self.p.name or type(self).__name__
+    for k, v in values.items():
+      if isinstance(v, bool) or not isinstance(v, (int, float)):
+        continue
+      if k.startswith("input_"):
+        name = f"infeed/{k}"
+      elif k.startswith("infeed_"):
+        name = f"infeed/{ns}_{k[len('infeed_'):]}"
+      else:
+        name = f"{ns}/{k}"
+      self.metrics.Gauge(name).Set(v)
+
   def _ProfilerScope(self):
-    """jax.profiler trace around every Nth Run (program option)."""
+    """jax.profiler trace around every Nth Run (program option), via
+    observe.ProfileWindow — same `<program_dir>/plugins/profile/<ts>`
+    layout jax.profiler.trace wrote, but degrades to a no-op instead of
+    raising on backends without profiler support."""
     import contextlib
     n = self.p.profiler_capture_every_n_runs
     self._run_count += 1
     self._profiling_run = n > 0 and self._run_count % n == 0
     if self._profiling_run:
-      return jax.profiler.trace(self._program_dir)
+      return observe.ProfileWindow(self._program_dir)
     return contextlib.nullcontext()
 
   # -- async infeed / deferred telemetry lifecycle ---------------------------
@@ -321,7 +379,7 @@ class TrainProgram(BaseProgram):
         lambda x: jnp.broadcast_to(
             jnp.asarray(x)[None], (self.p.steps_per_loop,) + np.shape(x)))
     with self._MeshScope():
-      self._GetLoopFn(state).lower(state, stacked).compile()
+      self._RecordCompile("loop", self._GetLoopFn(state), state, stacked)
 
   def _GetLoopFn(self, state: NestedMap | None = None):
     """steps_per_loop TrainSteps as ONE jitted lax.scan over stacked batches
@@ -413,14 +471,15 @@ class TrainProgram(BaseProgram):
           self._MakeTrainIter, place_fn=place, depth=p.infeed_depth,
           place_in_producer=self._PlaceInProducer(),
           name=f"{p.name or 'train'}-infeed",
-          stream_key=id(self.input_generator))
+          stream_key=id(self.input_generator), registry=self.metrics)
     return self._infeed
 
   def _GetTelemetry(self):
     if self._telemetry is None:
       from lingvo_tpu.runners import infeed as infeed_lib
       self._telemetry = infeed_lib.DeferredTelemetry(
-          name=f"{self.p.name or 'train'}-telemetry")
+          name=f"{self.p.name or 'train'}-telemetry",
+          registry=self.metrics)
     return self._telemetry
 
   def _RefreshHostSchedules(self) -> None:
@@ -666,7 +725,8 @@ class EvalProgram(BaseProgram):
       infeed = infeed_lib.DeviceInfeed(
           lambda: raw, place_fn=self._PutBatch, depth=self.p.infeed_depth,
           place_in_producer=self._PlaceInProducer(),
-          name=f"{self.p.name or 'eval'}-infeed", stream_key=id(gen))
+          name=f"{self.p.name or 'eval'}-infeed", stream_key=id(gen),
+          registry=self.metrics)
     batches = _CoordinateFiniteStream(
         infeed.Iter() if infeed is not None else raw)
     n = 0

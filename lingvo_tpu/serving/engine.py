@@ -65,7 +65,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lingvo_tpu import observe
 from lingvo_tpu.core import sampling
+from lingvo_tpu.observe import schema as observe_schema
 from lingvo_tpu.quant import kv as kv_quant
 from lingvo_tpu.quant import weights as quant_weights
 from lingvo_tpu.serving import kv_cache
@@ -86,6 +88,7 @@ class StreamHandle:
     self._done = threading.Event()
     self.finish_reason: Optional[str] = None
     self.submit_time = submit_time
+    self.admit_time: Optional[float] = None
     self.first_token_time: Optional[float] = None
     self.finish_time: Optional[float] = None
 
@@ -133,7 +136,8 @@ class ServingLoop:
                default_max_new: int = 32, eos_id: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                sample_seed: int = 0, kv_cache_dtype: Optional[str] = None,
-               serve_int8_weights: bool = False, spec=None):
+               serve_int8_weights: bool = False, spec=None,
+               trace=True, metrics_registry=None):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
@@ -150,6 +154,12 @@ class ServingLoop:
     `spec_decode.SelfDraft` (early-exit over the same theta) or
     `spec_decode.ModelDraft` (independent pageless draft model). None
     keeps the exact two-program legacy engine.
+    trace: per-request lifecycle tracing (observe/trace.py) — True (the
+    default; overhead is bounded by the bench's observability section)
+    builds a fresh TraceRecorder, False disables, or pass a TraceRecorder
+    to share/configure one. metrics_registry: the observe.MetricsRegistry
+    this engine publishes through (None = a fresh per-engine registry, so
+    replicas and tests stay isolated).
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -215,6 +225,14 @@ class ServingLoop:
       return jnp.stack(cols, axis=1), states
 
     self._step_fn = jax.jit(_Step, donate_argnums=donate)
+    # observability (observe/): per-engine metrics registry, per-request
+    # lifecycle trace, and one-shot compile records for the step programs
+    self.metrics = (metrics_registry if metrics_registry is not None
+                    else observe.MetricsRegistry("serving"))
+    self.trace = (trace if isinstance(trace, observe.TraceRecorder)
+                  else (observe.TraceRecorder() if trace else None))
+    self._compile_log = observe.CompileLog(
+        registry=self.metrics, namespace="serving/compile", donate=donate)
     # speculative decoding: the runner owns the draft + verify programs
     # and (for ModelDraft) the draft model's recurrent state
     self.spec = None
@@ -223,17 +241,40 @@ class ServingLoop:
           spec, task=task, theta=theta, max_batch=max_batch,
           page_size=page_size, prefill_chunk=prefill_chunk,
           temperature=self.temperature, top_k=self.top_k,
-          sample_seed=self.sample_seed)
+          sample_seed=self.sample_seed, compile_log=self._compile_log)
     # silent-fallback visibility: classify ONCE which attention path the
     # compiled step will take, and count ineligible (dense-fallback) steps
     self.paged_path = self._ClassifyPath()
     self._handles: dict = {}
+    # counters live in the registry under serving/* (schema is the single
+    # source of the key set); Stats() maps them back to the plain keys.
+    # All Inc() calls happen under the engine lock, so Stats() — which
+    # also holds it — reads a mutually-consistent set.
     self._counters = {
-        "steps": 0, "decode_steps": 0, "mixed_steps": 0,
-        "tokens_emitted": 0, "prompt_tokens": 0,
-        "dense_fallback_steps": 0, "quantized_steps": 0,
-        "spec_cycles": 0, "draft_tokens": 0, "accepted_tokens": 0,
-    }
+        k: self.metrics.Counter(f"serving/{k}")
+        for k in observe_schema.ENGINE_COUNTER_KEYS}
+    # engine configuration facts + live sub-surfaces. Section callbacks
+    # deliberately read WITHOUT the engine lock (a registry snapshot
+    # holding the registry lock must never wait on the engine lock —
+    # lock-order inversion against the hot path's counter Incs); the
+    # atomic consistent read is Stats().
+    self.metrics.Gauge("serving/paged_path").Set(self.paged_path)
+    self.metrics.Gauge("serving/kv_cache_dtype").Set(self.kv_cache_dtype)
+    self.metrics.Gauge("serving/kv_bytes_per_token").Set(
+        self.kv_bytes_per_token)
+    self.metrics.Gauge("serving/serve_int8_weights").Set(
+        self.serve_int8_weights)
+    self.metrics.SectionFn("scheduler", self.sched.Stats)
+    self.metrics.SectionFn("kv_pages", self.alloc.Stats)
+    if self.state_pool is not None:
+      self.metrics.SectionFn("state_slots", self.state_pool.Stats)
+    if self.trace is not None:
+      self.metrics.SectionFn("trace", self.trace.Stats)
+    self._h_queue_wait = self.metrics.Histogram("serving/queue_wait_s")
+    self._h_ttft = self.metrics.Histogram("serving/ttft_s")
+    self._h_tpot = self.metrics.Histogram("serving/tpot_s")
+    self._pages_of: dict = {}   # req_id -> pages granted at admission
+    self._profile_window = None
     self._lock = threading.RLock()
     self._work = threading.Condition(self._lock)
     self._thread: Optional[threading.Thread] = None
@@ -340,6 +381,8 @@ class ServingLoop:
       self.sched.Submit(req)
       handle = StreamHandle(req_id, self, time.perf_counter())
       self._handles[req_id] = handle
+      if self.trace is not None:
+        self.trace.Submit(req_id, len(req.prompt), req.max_new)
       self._work.notify_all()
     return handle
 
@@ -350,6 +393,9 @@ class ServingLoop:
         h = self._handles.get(req_id)
         if h is not None and not h.done:
           h._Finish("cancelled")
+        if self.trace is not None:
+          self.trace.Retire(req_id, "cancelled",
+                            self._pages_of.pop(req_id, 0))
       return ok
 
   def _Loop(self):
@@ -372,7 +418,20 @@ class ServingLoop:
     (and all-opted-out batches) take the unchanged legacy path."""
     with self._lock:
       self.sched.EvictCancelled()
-      self.sched.Admit()
+      admitted = self.sched.Admit()
+      for seq in admitted:
+        h = self._handles.get(seq.id)
+        if h is not None and h.admit_time is None:
+          h.admit_time = time.perf_counter()
+        pages = 0
+        if self.sched.needs_kv_pages:
+          try:
+            pages = len(self.alloc.PagesOf(seq.id))
+          except KeyError:
+            pages = 0
+        self._pages_of[seq.id] = pages
+        if self.trace is not None:
+          self.trace.Admit(seq.id, seq.slot, pages)
       vbatch = None
       if self.spec is not None:
         vbatch = self.sched.BuildVerifyStep(self.spec.k)
@@ -380,9 +439,13 @@ class ServingLoop:
       if vbatch is None and batch is None:
         return 0
       tables = np.array(self.sched.block_tables)  # freeze under the lock
+      window = self._profile_window
+      if window is not None:
+        window.Start()
     if vbatch is not None:
       return self._SpecCycle(vbatch, tables)
-    sampled, new_states = self._step_fn(
+    sampled, new_states = self._compile_log.Call(
+        "mixed" if batch.mixed else "decode", self._step_fn,
         self._theta, self._states, jnp.asarray(batch.ids),
         jnp.asarray(batch.q_pos), jnp.asarray(batch.in_len),
         jnp.asarray(tables), jnp.asarray(batch.row_seeds),
@@ -398,15 +461,24 @@ class ServingLoop:
           for s in batch.rows])
       self.spec.ConsumeStep(batch, prefill_rows)
     with self._lock:
+      if self.trace is not None and batch.mixed:
+        # emit prefill-chunk spans BEFORE CommitStep advances the cursors:
+        # row i consumed in_len[i] prompt tokens starting at q_pos[i]
+        for i, seq in enumerate(batch.rows):
+          if (seq is not None
+              and seq.state is scheduler_lib.SeqState.PREFILL
+              and int(batch.in_len[i]) > 0):
+            self.trace.PrefillChunk(seq.id, int(batch.in_len[i]))
       events = self.sched.CommitStep(batch, sampled)
-      self._counters["steps"] += 1
-      self._counters["mixed_steps" if batch.mixed else "decode_steps"] += 1
-      self._counters["prompt_tokens"] += batch.prompt_tokens
+      self._counters["steps"].Inc()
+      self._counters["mixed_steps" if batch.mixed else "decode_steps"].Inc()
+      self._counters["prompt_tokens"].Inc(batch.prompt_tokens)
       if self.paged_path == "dense":
-        self._counters["dense_fallback_steps"] += 1
+        self._counters["dense_fallback_steps"].Inc()
       if self._kv_quantized:
-        self._counters["quantized_steps"] += 1
+        self._counters["quantized_steps"].Inc()
       self._PushEvents(events)
+      self._TickProfile()
     return len(events)
 
   def _SpecCycle(self, vbatch, tables) -> int:
@@ -422,35 +494,76 @@ class ServingLoop:
     out, alen = np.asarray(out), np.asarray(alen)
     with self._lock:
       events = self.sched.CommitVerifyStep(vbatch, out, alen)
-      self._counters["steps"] += 1
-      self._counters["decode_steps"] += 1
-      self._counters["spec_cycles"] += 1
+      self._counters["steps"].Inc()
+      self._counters["decode_steps"].Inc()
+      self._counters["spec_cycles"].Inc()
       if self.paged_path == "dense":
-        self._counters["dense_fallback_steps"] += 1
+        self._counters["dense_fallback_steps"].Inc()
       if self._kv_quantized:
-        self._counters["quantized_steps"] += 1
+        self._counters["quantized_steps"].Inc()
       for i, seq in enumerate(vbatch.rows):
         rk = int(vbatch.row_k[i])
         if (seq is None or rk == 0
             or seq.state is scheduler_lib.SeqState.CANCELLED):
           continue
         m = min(int(alen[i]), rk)
-        self._counters["draft_tokens"] += rk
-        self._counters["accepted_tokens"] += m
+        self._counters["draft_tokens"].Inc(rk)
+        self._counters["accepted_tokens"].Inc(m)
         spec.accepted_len_hist[m] += 1
+        if self.trace is not None:
+          self.trace.SpecVerify(seq.id, rk, m)
+          if rk - m > 0:
+            self.trace.Rollback(seq.id, rk - m)
       self._PushEvents(events)
+      self._TickProfile()
     return len(events)
 
   def _PushEvents(self, events):
     """Streams committed tokens to their handles (caller holds the lock)."""
     for req_id, tok, finished in events:
-      self._counters["tokens_emitted"] += 1
+      self._counters["tokens_emitted"].Inc()
+      if self.trace is not None:
+        self.trace.Token(req_id)
       h = self._handles.get(req_id)
       if h is None:
+        if finished and self.trace is not None:
+          self.trace.Retire(req_id, self.sched._by_id[req_id].finish_reason,
+                            self._pages_of.pop(req_id, 0))
         continue
       h._Push(tok)
       if finished:
         h._Finish(self.sched._by_id[req_id].finish_reason)
+        if self.trace is not None:
+          self.trace.Retire(req_id, h.finish_reason,
+                            self._pages_of.pop(req_id, 0))
+        self._ObserveLatencies(h)
+
+  def _ObserveLatencies(self, h: StreamHandle):
+    """Fills the latency histograms from the handle's lifecycle times;
+    independent of whether tracing is on (caller holds the lock)."""
+    if h.admit_time is not None:
+      self._h_queue_wait.Observe(h.admit_time - h.submit_time)
+    if h.first_token_time is not None:
+      self._h_ttft.Observe(h.first_token_time - h.submit_time)
+      ntok = len(h._tokens)
+      if ntok > 1 and h.finish_time is not None:
+        self._h_tpot.Observe(
+            (h.finish_time - h.first_token_time) / (ntok - 1))
+
+  def _TickProfile(self):
+    """Advances an armed N-step ProfileWindow (caller holds the lock)."""
+    if self._profile_window is not None:
+      if self._profile_window.StepDone():
+        self._profile_window = None
+
+  def ProfileSteps(self, logdir: str, steps: int = 5):
+    """Arms a jax.profiler window covering the next `steps` engine steps;
+    the trace lands under `<logdir>/plugins/profile/` (no-op on backends
+    without profiler support). Returns the armed ProfileWindow."""
+    window = observe.ProfileWindow(logdir, steps=steps)
+    with self._lock:
+      self._profile_window = window
+    return window
 
   # -- sync GShardDecode-parity mode ----------------------------------------
 
@@ -483,8 +596,11 @@ class ServingLoop:
   # -- introspection ---------------------------------------------------------
 
   def Stats(self) -> dict:
+    """Atomic engine snapshot (the consistent read surface; the registry's
+    Snapshot() is the lock-free best-effort view). Key set is declared in
+    observe/schema.py and validated by ValidateEngineStats in tests."""
     with self._lock:
-      stats = dict(self._counters)
+      stats = {k: c.value for k, c in self._counters.items()}
       stats["paged_path"] = self.paged_path
       stats["kv_cache_dtype"] = self.kv_cache_dtype
       stats["kv_bytes_per_token"] = self.kv_bytes_per_token
@@ -500,4 +616,7 @@ class ServingLoop:
           self.spec.accepted_len_hist.tolist() if self.spec else [])
       if self.spec is not None:
         stats["spec"] = self.spec.Describe()
+      if self.trace is not None:
+        stats["trace"] = self.trace.Stats()
+      stats["compile"] = self._compile_log.Records()
     return stats

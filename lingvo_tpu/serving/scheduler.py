@@ -89,6 +89,7 @@ class Sequence:
     self.pos = 0          # tokens WRITTEN to the KV cache so far
     self.out = []         # generated tokens (out[-1] may not be cached yet)
     self.finish_reason = None
+    self.slot = None      # decode slot index, set at admission (telemetry)
     # committed tokens an independent draft model's recurrent state has
     # consumed so far (speculative decoding only; engine-maintained)
     self.draft_pos = 0
@@ -230,6 +231,7 @@ class Scheduler:
         pages = []
       self.slots[i] = seq
       seq.state = SeqState.PREFILL
+      seq.slot = i
       self.block_tables[i, :] = 0
       self.block_tables[i, :len(pages)] = pages
       if self.state_pool is not None:

@@ -177,9 +177,13 @@ class SpecRunner:
 
   def __init__(self, config, *, task, theta, max_batch: int,
                page_size: int, prefill_chunk: int, temperature: float,
-               top_k: int, sample_seed: int):
+               top_k: int, sample_seed: int, compile_log=None):
     self.config = config
     self.k = config.k
+    # optional observe.CompileLog: routes the verify program through a
+    # one-shot AOT compile so the engine's compile records cover all
+    # three step programs (decode / mixed / spec_verify)
+    self._compile_log = compile_log
     self.is_self = isinstance(config, SelfDraft)
     self._task = task
     self._temperature = float(temperature)
@@ -418,11 +422,13 @@ class SpecRunner:
              q_logits):
     """The third compiled step program: ragged [B, k+1] verify + accept +
     SSM rollback in ONE jit. Returns (out_tokens, accept_len, states)."""
-    return self._verify_fn(
-        theta, states, jnp.asarray(ids), jnp.asarray(vbatch.q_pos),
-        jnp.asarray(vbatch.in_len), jnp.asarray(tables),
-        jnp.asarray(vbatch.row_seeds), jnp.asarray(vbatch.row_pos),
-        q_logits)
+    args = (theta, states, jnp.asarray(ids), jnp.asarray(vbatch.q_pos),
+            jnp.asarray(vbatch.in_len), jnp.asarray(tables),
+            jnp.asarray(vbatch.row_seeds), jnp.asarray(vbatch.row_pos),
+            q_logits)
+    if self._compile_log is not None:
+      return self._compile_log.Call("spec_verify", self._verify_fn, *args)
+    return self._verify_fn(*args)
 
   def Describe(self) -> dict:
     return self.config.Describe()

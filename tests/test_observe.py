@@ -1,0 +1,568 @@
+"""Unified observability layer: metrics registry, per-request traces,
+profiler/compile hooks, and the shared telemetry schema.
+
+Covers docs/observability.md:
+- `MetricsRegistry` kinds (counter / gauge / lazy gauge / section /
+  histogram), atomic flat snapshots, monotonic-delta semantics, callback
+  replacement, and error isolation (a broken stats provider never kills a
+  snapshot),
+- `TraceRecorder` lifecycle ordering (submit < admit < first token <
+  retire) with an injected deterministic clock, ring wraparound WITHOUT
+  open-request loss, derived per-request metrics (queue wait, TTFT,
+  per-output-token latency, spec acceptance),
+- `ChromeTrace()` export is valid Chrome trace-event JSON: round-trips
+  through json, timestamps are monotonic in file order, and every
+  duration B has its matching E on the same tid in stack order,
+- `ProfileWindow` degrades to a no-op (never raises) when the profiler
+  is unavailable; `CompileLog` AOT-compiles once, dispatches through the
+  stored executable, and permanently falls back on non-jit callables,
+- the shared schema validates both serving surfaces and round-trips
+  telemetry through a registry,
+- `tools/trace_report.py` summarizes an exported trace,
+- a seeded Poisson soak on a live tiny engine leaves a COMPLETE trace for
+  every request, schema-valid Stats(), compile records, and correct
+  registry deltas (slow).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lingvo_tpu import observe
+from lingvo_tpu.observe import schema as observe_schema
+from lingvo_tpu.observe import trace as trace_lib
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import trace_report  # noqa: E402
+
+
+# -- metrics registry --------------------------------------------------------
+
+
+class TestMetricsRegistry:
+
+  def test_counter_monotonic_and_get_or_create(self):
+    reg = observe.MetricsRegistry("t")
+    c = reg.Counter("serving/steps")
+    c.Inc()
+    c.Inc(4)
+    assert c.value == 5
+    # get-or-create: same name is the same object
+    assert reg.Counter("serving/steps") is c
+    with pytest.raises(AssertionError):
+      c.Inc(-1)
+
+  def test_gauge_and_lazy_gauge_replacement(self):
+    reg = observe.MetricsRegistry("t")
+    reg.Gauge("serving/kv_cache_dtype").Set("int8")
+    box = {"v": 1}
+    reg.GaugeFn("lazy", lambda: box["v"])
+    assert reg.Snapshot()["lazy"] == 1
+    box["v"] = 7
+    assert reg.Snapshot()["lazy"] == 7          # evaluated at snapshot time
+    reg.GaugeFn("lazy", lambda: 42)             # re-register REPLACES
+    snap = reg.Snapshot()
+    assert snap["lazy"] == 42
+    assert snap["serving/kv_cache_dtype"] == "int8"
+
+  def test_section_fn_splices_and_replaces(self):
+    reg = observe.MetricsRegistry("t")
+    reg.SectionFn("scheduler", lambda: {"queue_depth": 3, "slots": 2})
+    snap = reg.Snapshot()
+    assert snap["scheduler/queue_depth"] == 3 and snap["scheduler/slots"] == 2
+    reg.SectionFn("scheduler", lambda: {"queue_depth": 0})
+    snap = reg.Snapshot()
+    assert snap["scheduler/queue_depth"] == 0
+    assert "scheduler/slots" not in snap
+
+  def test_callback_error_isolation(self):
+    reg = observe.MetricsRegistry("t")
+    reg.Counter("ok").Inc()
+
+    def _Boom():
+      raise RuntimeError("provider died")
+
+    reg.GaugeFn("bad_gauge", _Boom)
+    reg.SectionFn("bad_section", _Boom)
+    snap = reg.Snapshot()                       # must not raise
+    assert snap["ok"] == 1
+    assert "provider died" in snap["bad_gauge"]
+    assert "provider died" in snap["bad_section"]
+
+  def test_histogram_buckets_and_snapshot_form(self):
+    reg = observe.MetricsRegistry("t")
+    h = reg.Histogram("lat", bounds=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+      h.Observe(v)
+    snap = reg.Snapshot()["lat"]
+    assert snap["count"] == 5
+    assert snap["counts"] == [1, 2, 1, 1]       # last bucket = overflow
+    assert snap["bounds"] == [0.01, 0.1, 1.0]
+    np.testing.assert_allclose(snap["sum"], 5.605)
+    np.testing.assert_allclose(snap["mean"], 5.605 / 5)
+
+  def test_delta_semantics(self):
+    reg = observe.MetricsRegistry("t")
+    c = reg.Counter("serving/tokens")
+    g = reg.Gauge("level")
+    h = reg.Histogram("lat", bounds=(1.0,))
+    c.Inc(10)
+    g.Set(100)
+    h.Observe(0.5)
+    prev = reg.Snapshot()
+    c.Inc(7)
+    g.Set(3)
+    h.Observe(2.0)
+    d = reg.Delta(prev)
+    assert d["serving/tokens"] == 7             # counters subtract
+    assert d["level"] == 3                      # gauges report current level
+    assert d["lat"]["count"] == 1               # histograms subtract
+    assert d["lat"]["counts"] == [0, 1]
+    np.testing.assert_allclose(d["lat"]["sum"], 2.0)
+    # a metric born after `prev` reports its full value
+    reg.Counter("new").Inc(5)
+    assert reg.Delta(prev)["new"] == 5
+
+  def test_describe_kinds(self):
+    reg = observe.MetricsRegistry("t")
+    reg.Counter("c")
+    reg.Gauge("g")
+    reg.GaugeFn("gf", lambda: 0)
+    reg.SectionFn("s", dict)
+    reg.Histogram("h")
+    assert reg.Describe() == {"c": "counter", "g": "gauge", "gf": "gauge_fn",
+                              "s": "section", "h": "histogram"}
+
+
+# -- trace recorder (deterministic injected clock) ---------------------------
+
+
+class _FakeClock:
+  """Monotonic fake clock: each call advances by `step` seconds."""
+
+  def __init__(self, step=0.001):
+    self.now = 0.0
+    self.step = step
+
+  def __call__(self):
+    self.now += self.step
+    return self.now
+
+
+def _ScriptedLifecycle(rec, req_id=1, tokens=4):
+  rec.Submit(req_id, prompt_tokens=5, max_new=tokens)
+  rec.Admit(req_id, slot=0, pages=2)
+  rec.PrefillChunk(req_id, 4)
+  rec.PrefillChunk(req_id, 1)
+  for _ in range(tokens):
+    rec.Token(req_id)
+  rec.Retire(req_id, "length", pages_freed=2)
+
+
+class TestTraceRecorder:
+
+  def test_lifecycle_ordering_and_derived_metrics(self):
+    clock = _FakeClock(step=0.001)
+    rec = trace_lib.TraceRecorder(clock=clock)
+    _ScriptedLifecycle(rec, req_id=7, tokens=4)
+    r = rec.Get(7)
+    assert r.complete
+    # the ordering satellite: submit < admit < first token < retire
+    assert r.submit_ts < r.admit_ts < r.first_token_ts < r.retire_ts
+    m = r.Metrics()
+    # fake clock ticks 1ms/event: submit@t1, admit@t2, chunks@t3,t4,
+    # tokens@t5..t8, retire@t9
+    np.testing.assert_allclose(m["queue_wait_s"], 0.001)
+    np.testing.assert_allclose(m["ttft_s"], 0.004)
+    np.testing.assert_allclose(m["tpot_s"], 0.001)  # (t8 - t5) / 3
+    np.testing.assert_allclose(m["total_s"], 0.008)
+    assert m["tokens"] == 4 and m["prompt_tokens"] == 5
+    assert m["prefill_chunks"] == 2 and m["pages"] == 2
+    assert m["finish_reason"] == "length"
+    assert "spec_cycles" not in m               # no spec fields w/o drafting
+
+  def test_spec_fields_and_acceptance(self):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    rec.Submit(1, 2, 8)
+    rec.Admit(1, 0, 1)
+    rec.SpecVerify(1, drafted=4, accepted=3)
+    rec.Token(1, n=4)                           # 3 accepted + 1 corrected
+    rec.Rollback(1, 1)
+    rec.SpecVerify(1, drafted=4, accepted=4)
+    rec.Token(1, n=4)
+    rec.Retire(1, "eos")
+    m = rec.Get(1).Metrics()
+    assert m["tokens"] == 8
+    assert m["spec_cycles"] == 2 and m["draft_tokens"] == 8
+    assert m["accepted_tokens"] == 7 and m["rolled_back_tokens"] == 1
+    np.testing.assert_allclose(m["spec_acceptance"], 7 / 8)
+
+  def test_ring_wraparound_keeps_open_requests(self):
+    """The wraparound satellite: a tiny ring drops raw events, but the
+    open request's record survives untouched."""
+    rec = trace_lib.TraceRecorder(capacity=8, clock=_FakeClock())
+    rec.Submit(1, 3, 1000)
+    rec.Admit(1, 0, 4)
+    for _ in range(500):
+      rec.Token(1)
+    stats = rec.Stats()
+    assert stats["events_buffered"] == 8
+    assert stats["events_dropped"] == 502 - 8
+    assert stats["requests_open"] == 1
+    r = rec.Get(1)                              # record survived the ring
+    assert r.submit_ts is not None and r.admit_ts is not None
+    assert r.tokens == 500 and r.prompt_tokens == 3
+    rec.Retire(1, "length", 4)
+    assert rec.Get(1).complete
+    assert rec.Stats()["requests_completed"] == 1
+    assert rec.Stats()["requests_open"] == 0
+
+  def test_completed_ring_evicts_oldest_only(self):
+    rec = trace_lib.TraceRecorder(completed_capacity=2, clock=_FakeClock())
+    for rid in (1, 2, 3):
+      rec.Submit(rid, 1, 1)
+      rec.Retire(rid, "eos")
+    reqs = rec.Requests()
+    assert set(reqs) == {2, 3}                  # 1 evicted (oldest)
+
+  def test_events_for_retired_request_keep_raw_only(self):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    rec.Token(99)                               # never submitted
+    assert rec.Get(99) is None
+    assert rec.Events()[-1][1] == "token"       # raw event still in ring
+
+  def test_trace_stats_schema(self):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    assert set(rec.Stats()) == observe_schema.TRACE_STATS_KEYS
+
+
+def _CheckChromeTrace(trace):
+  """Shared validity checks: json round-trip, monotonic ts in file order,
+  matched B/E pairs per tid in stack order."""
+  trace = json.loads(json.dumps(trace))         # must round-trip
+  events = trace["traceEvents"]
+  assert events, "empty trace"
+  last_ts = -float("inf")
+  stacks = {}
+  for e in events:
+    assert e["ph"] in ("M", "B", "E", "i"), e
+    if e["ph"] == "M":
+      continue
+    assert e["ts"] >= last_ts, f"ts went backwards at {e}"
+    last_ts = e["ts"]
+    if e["ph"] == "B":
+      stacks.setdefault(e["tid"], []).append(e["name"])
+    elif e["ph"] == "E":
+      stack = stacks.get(e["tid"])
+      assert stack, f"E without B on tid {e['tid']}: {e}"
+      stack.pop()
+  for tid, stack in stacks.items():
+    assert not stack, f"unclosed B events on tid {tid}: {stack}"
+  return trace
+
+
+class TestChromeTraceExport:
+
+  def test_valid_json_monotonic_matched_pairs(self, tmp_path):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    _ScriptedLifecycle(rec, req_id=1)
+    _ScriptedLifecycle(rec, req_id=2)
+    path = str(tmp_path / "trace.json")
+    exported = rec.Export(path)
+    with open(path) as f:
+      trace = json.load(f)                      # file itself parses
+    assert trace == json.loads(json.dumps(exported))
+    trace = _CheckChromeTrace(trace)
+    names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "B"]
+    for rid in (1, 2):
+      for phase in ("queued", "prefill", "decode"):
+        assert f"req {rid} {phase}" in names
+    assert set(trace["perRequest"]) == {"1", "2"}
+    assert trace["perRequest"]["1"]["total_s"] is not None
+
+  def test_open_request_emits_no_unmatched_b(self):
+    """A still-running request has no decode E yet — the exporter must
+    skip the open phase rather than write an unmatched B."""
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    rec.Submit(1, 2, 8)
+    rec.Admit(1, 0, 1)
+    rec.Token(1)                                # decode started, not done
+    trace = _CheckChromeTrace(rec.ChromeTrace())
+    names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "B"]
+    assert "req 1 queued" in names and "req 1 prefill" in names
+    assert "req 1 decode" not in names          # open phase skipped
+    assert trace["perRequest"]["1"]["total_s"] is None
+
+  def test_cancelled_while_queued_lands_on_queue_row(self):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    rec.Submit(1, 2, 8)
+    rec.Retire(1, "cancelled")
+    trace = _CheckChromeTrace(rec.ChromeTrace())
+    queued = [e for e in trace["traceEvents"]
+              if e["ph"] == "B" and e["name"] == "req 1 queued"]
+    assert queued and queued[0]["tid"] == trace_lib._QUEUE_ONLY_TID
+
+  def test_spec_instants_present(self):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    rec.Submit(1, 2, 8)
+    rec.Admit(1, 3, 1)
+    rec.SpecVerify(1, 4, 2)
+    rec.Rollback(1, 2)
+    trace = _CheckChromeTrace(rec.ChromeTrace())
+    instants = {e["name"]: e for e in trace["traceEvents"] if e["ph"] == "i"}
+    assert instants["spec_verify req 1"]["args"] == {"drafted": 4,
+                                                     "accepted": 2}
+    assert instants["rollback req 1"]["args"] == {"tokens": 2}
+    assert instants["spec_verify req 1"]["tid"] == 3
+
+
+# -- profiler window + compile log -------------------------------------------
+
+
+class TestProfileWindow:
+
+  def test_degrades_to_noop_when_profiler_broken(self, monkeypatch):
+    def _Boom(*a, **k):
+      raise RuntimeError("no profiler here")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", _Boom)
+    w = observe.ProfileWindow("/nonexistent", steps=3)
+    w.Start()                                   # must not raise
+    assert not w.active
+    assert "no profiler here" in w.error
+    assert w.StepDone() is True                 # errored window closes fast
+    with observe.ProfileWindow("/nonexistent") as w2:  # ctx mgr too
+      assert not w2.active
+
+  def test_step_window_counts_down(self, tmp_path, monkeypatch):
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    w = observe.ProfileWindow(str(tmp_path), steps=2)
+    w.Start()
+    w.Start()                                   # idempotent
+    assert calls["start"] == 1 and w.active
+    assert w.StepDone() is False
+    assert w.StepDone() is True                 # window closed at N steps
+    assert calls["stop"] == 1 and not w.active
+    w.Stop()                                    # idempotent
+    assert calls["stop"] == 1
+
+
+class TestCompileLog:
+
+  def test_jit_fn_compiles_once_and_dispatches(self):
+    reg = observe.MetricsRegistry("t")
+    log = observe.CompileLog(registry=reg, namespace="compile")
+    traces = {"n": 0}
+
+    @jax.jit
+    def f(x):
+      traces["n"] += 1
+      return x * 2
+
+    x = jnp.arange(4, dtype=jnp.float32)
+    for _ in range(3):
+      np.testing.assert_array_equal(np.asarray(log.Call("f", f, x)),
+                                    np.asarray(x) * 2)
+    rec = log.Records()["f"]
+    assert traces["n"] == 1                     # AOT-compiled exactly once
+    assert rec["calls"] == 3
+    assert rec["compile_wall_s"] > 0
+    assert "fallback" not in rec
+    snap = reg.Snapshot()
+    assert snap["compile/f_compile_wall_s"] == rec["compile_wall_s"]
+
+  def test_non_jit_fn_falls_back_forever(self):
+    log = observe.CompileLog()
+    assert log.Call("plain", lambda x: x + 1, 41) == 42
+    assert log.Call("plain", lambda x: x + 1, 1) == 2
+    rec = log.Records()["plain"]
+    assert rec["fallback"] == "not a jit wrapper (no .lower)"
+
+  def test_dispatch_aval_mismatch_falls_back(self):
+    log = observe.CompileLog()
+
+    @jax.jit
+    def f(x):
+      return x + 1
+
+    x32 = jnp.arange(4, dtype=jnp.float32)
+    log.Call("f", f, x32)                       # compiled for f32[4]
+    out = log.Call("f", f, jnp.arange(8, dtype=jnp.int32))  # wrong aval
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8) + 1)
+    assert log.Records()["f"]["fallback"].startswith("dispatch:")
+    # permanent: subsequent calls take the plain path and still work
+    np.testing.assert_array_equal(np.asarray(log.Call("f", f, x32)),
+                                  np.asarray(x32) + 1)
+
+
+# -- shared schema -----------------------------------------------------------
+
+
+class TestSchema:
+
+  def _Telemetry(self, **overrides):
+    vals = {k: 0 for k in observe_schema.GSHARD_TELEMETRY_KEYS}
+    vals.update(kv_cache_dtype="float32", serve_int8_weights=False,
+                accepted_len_hist=[])
+    vals.update(overrides)
+    return vals
+
+  def test_telemetry_exact_key_set_enforced(self):
+    telem = observe_schema.GShardTelemetry(**self._Telemetry())
+    assert list(telem) == list(observe_schema.GSHARD_TELEMETRY_KEYS)
+    with pytest.raises(AssertionError, match="missing"):
+      vals = self._Telemetry()
+      del vals["prefill_s"]
+      observe_schema.GShardTelemetry(**vals)
+    with pytest.raises(AssertionError, match="not in schema"):
+      observe_schema.GShardTelemetry(**self._Telemetry(bogus=1))
+
+  def test_publish_then_read_back_round_trips(self):
+    reg = observe.MetricsRegistry("t")
+    telem = observe_schema.GShardTelemetry(
+        **self._Telemetry(tokens_per_sec=123.0, kv_cache_dtype="int8"))
+    observe_schema.PublishTelemetry(reg, telem)
+    back = observe_schema.TelemetryFromRegistry(reg)
+    assert back == telem                        # registry is source of truth
+    assert reg.Snapshot()["serving/tokens_per_sec"] == 123.0
+
+  def test_validate_engine_stats_rejects_drift(self):
+    good = {k: 0 for k in observe_schema.ENGINE_STATS_REQUIRED}
+    observe_schema.ValidateEngineStats(good)
+    observe_schema.ValidateEngineStats({**good, "trace": {}})  # optional ok
+    with pytest.raises(AssertionError, match="missing"):
+      observe_schema.ValidateEngineStats(
+          {k: 0 for k in list(observe_schema.ENGINE_STATS_REQUIRED)[1:]})
+    with pytest.raises(AssertionError, match="not in schema"):
+      observe_schema.ValidateEngineStats({**good, "renegade_key": 1})
+
+
+# -- trace_report tool -------------------------------------------------------
+
+
+class TestTraceReport:
+
+  def _Exported(self, tmp_path):
+    rec = trace_lib.TraceRecorder(clock=_FakeClock())
+    _ScriptedLifecycle(rec, req_id=1)
+    _ScriptedLifecycle(rec, req_id=2, tokens=3)
+    path = str(tmp_path / "trace.json")
+    rec.Export(path)
+    return path
+
+  def test_summary_and_report(self, tmp_path):
+    path = self._Exported(tmp_path)
+    trace = trace_report.LoadTrace(path)
+    s = trace_report.Summary(trace)
+    assert s["requests"] == 2 and s["complete"] == 2
+    assert s["tokens"] == 7
+    assert s["ttft"]["n"] == 2 and s["ttft"]["p50_ms"] > 0
+    assert s["queue_wait_hist_ms"]
+    report = trace_report.Report(trace)
+    assert "ttft_ms" in report and "queue wait histogram" in report
+    assert trace_report.main([path]) == 0
+
+  def test_rejects_foreign_trace(self, tmp_path):
+    path = str(tmp_path / "foreign.json")
+    with open(path, "w") as f:
+      json.dump({"traceEvents": []}, f)
+    with pytest.raises(ValueError, match="perRequest"):
+      trace_report.LoadTrace(path)
+    assert trace_report.main([]) == 2
+
+
+# -- live engine soak (seeded Poisson arrivals) ------------------------------
+
+
+def _TinyLmParams():
+  from lingvo_tpu.models.lm import layers as lm_layers
+  return lm_layers.TransformerLm.Params().Set(
+      name="lm", vocab_size=64, model_dim=32, num_layers=2, num_heads=2,
+      hidden_dim=64, use_rotary=True)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+  task = _TinyLmParams().Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  return task, theta
+
+
+@pytest.mark.slow
+class TestEngineObservabilitySoak:
+
+  def test_poisson_soak_complete_traces_for_every_request(self, tiny_lm,
+                                                          tmp_path):
+    from lingvo_tpu.serving import engine as engine_lib
+    task, theta = tiny_lm
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=4, num_pages=32, max_batch=3,
+        max_seq_len=32, prefill_chunk=4, default_max_new=4)
+    rng = np.random.RandomState(0)
+    eng.Start()
+    try:
+      # warmup compiles outside the measured/validated window
+      eng.Submit([1, 2, 3], 2).Result(timeout=600)
+      prev = eng.metrics.Snapshot()
+      handles = []
+      for _ in range(10):
+        plen = int(rng.randint(2, 8))
+        max_new = int(rng.randint(2, 8))
+        prompt = rng.randint(1, 63, size=plen).tolist()
+        handles.append(eng.Submit(prompt, max_new))
+        time.sleep(float(rng.exponential(0.003)))
+      results = [h.Result(timeout=600) for h in handles]
+    finally:
+      eng.Stop()
+
+    # requests may finish early on eos, so count what actually streamed
+    streamed = sum(len(r) for r in results)
+    assert all(results)
+
+    stats = observe_schema.ValidateEngineStats(eng.Stats())
+    assert stats["tokens_emitted"] >= streamed
+    assert set(stats["scheduler"]) == observe_schema.SCHEDULER_STATS_KEYS
+    assert observe_schema.KV_PAGES_REQUIRED <= set(stats["kv_pages"])
+    assert set(stats["trace"]) == observe_schema.TRACE_STATS_KEYS
+
+    # the soak satellite: a COMPLETE lifecycle trace for every request
+    reqs = eng.trace.Requests()
+    assert len(reqs) == 11                      # warmup + 10 soak requests
+    for rid in [h.id for h in handles]:
+      r = reqs[rid]
+      assert r.complete, f"request {rid} has an incomplete trace"
+      assert r.submit_ts < r.admit_ts < r.first_token_ts <= r.retire_ts
+      assert r.tokens == len(results[rid - 2])  # req ids start after warmup
+      assert r.finish_reason in ("length", "eos")
+      assert r.prefill_chunks >= 1
+    assert eng.trace.Stats()["requests_open"] == 0
+
+    # compile records: both step programs ran through the AOT path
+    assert stats["compile"]["mixed"]["calls"] > 0
+    assert "fallback" not in stats["compile"]["mixed"]
+
+    # registry delta over the soak window matches the streamed tokens
+    delta = eng.metrics.Delta(prev)
+    assert delta["serving/tokens_emitted"] == streamed
+    assert delta["serving/ttft_s"]["count"] == 10
+    assert delta["serving/queue_wait_s"]["count"] == 10
+
+    # exported trace: valid Chrome JSON, consumable by trace_report
+    path = str(tmp_path / "soak_trace.json")
+    _CheckChromeTrace(eng.trace.Export(path))
+    s = trace_report.Summary(trace_report.LoadTrace(path))
+    assert s["requests"] == 11 and s["complete"] == 11
+    assert s["ttft"]["n"] == 11

@@ -432,6 +432,9 @@ class TestQuantizedEngine:
     np.testing.assert_array_equal(out_f, out_8)
 
     sf, s8 = eng_f.Stats(), eng_8.Stats()
+    from lingvo_tpu.observe import schema as observe_schema
+    observe_schema.ValidateEngineStats(sf)
+    observe_schema.ValidateEngineStats(s8)
     base = "pallas" if jax.default_backend() == "tpu" else "xla"
     assert sf["paged_path"] == base
     assert sf["kv_cache_dtype"] == "float32"
@@ -489,6 +492,8 @@ class TestQuantizedEngine:
     out_8 = eng_8.RunBatch(self._PROMPTS, self._LENS, 4)
     np.testing.assert_array_equal(out_d, out_8)
     s8 = eng_8.Stats()
+    from lingvo_tpu.observe import schema as observe_schema
+    observe_schema.ValidateEngineStats(s8)
     assert s8["paged_path"] == "dense"
     assert s8["kv_cache_dtype"] == "int8"
     assert s8["dense_fallback_steps"] == s8["steps"] > 0

@@ -509,6 +509,14 @@ class TestServingEngine:
                           "kv_cache_dtype", "kv_bytes_per_token",
                           "serve_int8_weights", "draft_tokens",
                           "accepted_tokens", "accepted_len_hist"}
+    # the literal set above IS the shared schema: the telemetry dict is
+    # generated from observe.schema, so any key added to one surface
+    # without the other now fails here, not in a bench comparison
+    from lingvo_tpu.observe import schema as observe_schema
+    assert set(telem) == set(observe_schema.GSHARD_TELEMETRY_KEYS)
+    assert list(telem) == list(observe_schema.GSHARD_TELEMETRY_KEYS)
+    # both surfaces share the mirrored keys by construction
+    assert observe_schema.SHARED_SERVING_KEYS <= set(telem)
     # batch-synchronous decode never speculates: the spec keys exist (the
     # engine-Stats mirror contract) but stay at their zero values
     assert telem["draft_tokens"] == 0

@@ -443,9 +443,11 @@ class TestSpecEngine:
       assert eng.Stats()["kv_pages"]["free"] == eng.num_pages
 
   def test_stats_telemetry_surface(self, tiny_lm):
+    from lingvo_tpu.observe import schema as observe_schema
     task, theta = tiny_lm
     legacy = _Engine(task, theta)
     stats = legacy.Stats()
+    observe_schema.ValidateEngineStats(stats)
     # the keys exist on EVERY engine; legacy engines pin them at zero
     assert stats["spec_cycles"] == 0 and stats["draft_tokens"] == 0
     assert stats["accepted_tokens"] == 0
@@ -453,6 +455,7 @@ class TestSpecEngine:
     eng = _Engine(task, theta, spec_decode.SelfDraft(k=3, num_layers=1))
     eng.RunBatch(np.array([[5, 6]], np.int32), np.array([2], np.int32), 6)
     stats = eng.Stats()
+    observe_schema.ValidateEngineStats(stats)
     assert stats["spec"] == {"draft": "self", "k": 3, "num_layers": 1}
     assert len(stats["accepted_len_hist"]) == 4   # k + 1 buckets
     assert sum(stats["accepted_len_hist"]) == stats["spec_cycles"]

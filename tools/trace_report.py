@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Per-request latency report from an exported serving trace.
+
+Input: the Chrome trace-event JSON written by
+`TraceRecorder.Export(path)` (lingvo_tpu/observe/trace.py). The file is
+Perfetto-openable; this tool consumes the extra top-level `perRequest`
+key (ignored by trace viewers) and prints:
+
+- a per-request table: slot, prompt/output tokens, pages, queue wait,
+  TTFT, per-output-token latency, total, finish reason;
+- aggregate TTFT / TPOT / total-latency p50/p99;
+- a queue-wait histogram (how long requests sat before admission).
+
+Usage:
+  python tools/trace_report.py /tmp/serving_trace.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def LoadTrace(path: str) -> dict:
+  with open(path) as f:
+    trace = json.load(f)
+  if "perRequest" not in trace:
+    raise ValueError(
+        f"{path}: no perRequest key — not a TraceRecorder.Export file")
+  return trace
+
+
+def _Percentiles(values) -> dict:
+  vals = [v for v in values if v is not None]
+  if not vals:
+    return {"n": 0}
+  arr = np.asarray(vals, np.float64)
+  return {
+      "n": int(arr.size),
+      "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+      "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+      "mean_ms": round(float(arr.mean()) * 1e3, 3),
+      "max_ms": round(float(arr.max()) * 1e3, 3),
+  }
+
+
+def _QueueWaitHistogram(waits, n_buckets: int = 8) -> list:
+  """[(upper_bound_ms, count)] over the observed queue-wait range."""
+  vals = np.asarray([w for w in waits if w is not None], np.float64)
+  if vals.size == 0:
+    return []
+  hi = max(float(vals.max()), 1e-6)
+  bounds = np.linspace(hi / n_buckets, hi, n_buckets)
+  out = []
+  prev = 0.0
+  for b in bounds:
+    n = int(np.sum((vals > prev) & (vals <= b))) + (
+        int(np.sum(vals == 0.0)) if prev == 0.0 else 0)
+    out.append((round(b * 1e3, 3), n))
+    prev = b
+  return out
+
+
+def Summary(trace: dict) -> dict:
+  """Aggregate metrics from a loaded trace dict."""
+  reqs = list(trace["perRequest"].values())
+  return {
+      "requests": len(reqs),
+      "complete": sum(1 for r in reqs if r.get("total_s") is not None),
+      "tokens": sum(r.get("tokens", 0) for r in reqs),
+      "ttft": _Percentiles([r.get("ttft_s") for r in reqs]),
+      "tpot": _Percentiles([r.get("tpot_s") for r in reqs]),
+      "total": _Percentiles([r.get("total_s") for r in reqs]),
+      "queue_wait": _Percentiles([r.get("queue_wait_s") for r in reqs]),
+      "queue_wait_hist_ms": _QueueWaitHistogram(
+          [r.get("queue_wait_s") for r in reqs]),
+  }
+
+
+def _Ms(v) -> str:
+  return "-" if v is None else f"{v * 1e3:.2f}"
+
+
+def Report(trace: dict) -> str:
+  """The human-readable report (table + percentiles + histogram)."""
+  reqs = sorted(trace["perRequest"].items(), key=lambda kv: int(kv[0]))
+  header = (f"{'req':>5} {'slot':>4} {'prompt':>6} {'tokens':>6} "
+            f"{'pages':>5} {'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
+            f"{'total_ms':>9}  reason")
+  lines = [header, "-" * len(header)]
+  for rid, r in reqs:
+    lines.append(
+        f"{rid:>5} {str(r.get('slot', '-')):>4} "
+        f"{r.get('prompt_tokens', 0):>6} {r.get('tokens', 0):>6} "
+        f"{r.get('pages', 0):>5} {_Ms(r.get('queue_wait_s')):>9} "
+        f"{_Ms(r.get('ttft_s')):>9} {_Ms(r.get('tpot_s')):>9} "
+        f"{_Ms(r.get('total_s')):>9}  {r.get('finish_reason') or 'open'}")
+  s = Summary(trace)
+  lines.append("")
+  for name in ("ttft", "tpot", "total", "queue_wait"):
+    p = s[name]
+    if p.get("n"):
+      lines.append(f"{name:>10}: p50 {p['p50_ms']} ms   p99 {p['p99_ms']} "
+                   f"ms   mean {p['mean_ms']} ms   (n={p['n']})")
+  hist = s["queue_wait_hist_ms"]
+  if hist:
+    lines.append("")
+    lines.append("queue wait histogram:")
+    peak = max(n for _, n in hist) or 1
+    for bound, n in hist:
+      bar = "#" * round(40 * n / peak)
+      lines.append(f"  <= {bound:>9.3f} ms  {n:>4}  {bar}")
+  return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+  argv = sys.argv[1:] if argv is None else argv
+  if len(argv) != 1:
+    print(__doc__, file=sys.stderr)
+    return 2
+  trace = LoadTrace(argv[0])
+  print(Report(trace))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
