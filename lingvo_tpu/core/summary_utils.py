@@ -62,13 +62,19 @@ class SummaryWriter:
     The bridge from the metrics registry (observe/metrics.py) to event
     files: numeric counters/gauges/section values go through Scalars'
     numeric filter unchanged; histogram snapshots (dict-valued) flatten
-    to `<name>/count|sum|mean`. Returns the snapshot it wrote from."""
+    to `<name>/count|sum|mean` plus bucket-interpolated `/p50|/p99`
+    quantiles, so TensorBoard sees tail latency without the trace
+    tooling. Returns the snapshot it wrote from."""
+    from lingvo_tpu.observe import metrics as observe_metrics
     snap = registry.Snapshot()
     flat = {}
     for k, v in snap.items():
       if isinstance(v, dict) and "counts" in v and "bounds" in v:
         for field in ("count", "sum", "mean"):
           flat[f"{k}/{field}"] = v[field]
+        quantiles = observe_metrics.HistogramQuantiles(v, qs=(0.5, 0.99))
+        flat[f"{k}/p50"] = quantiles[0.5]
+        flat[f"{k}/p99"] = quantiles[0.99]
       else:
         flat[k] = v
     self.Scalars(flat, step, prefix=prefix)

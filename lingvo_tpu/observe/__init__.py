@@ -1,6 +1,6 @@
-"""lingvo_tpu.observe: the framework-wide observability layer (ISSUE 12).
+"""lingvo_tpu.observe: the framework-wide observability layer.
 
-Three pillars, one import:
+The in-process pillars (ISSUE 12), one import:
 
 - `MetricsRegistry` / `Default()` (observe/metrics.py): counters, gauges,
   histograms with atomic snapshots and monotonic-delta semantics. Serving
@@ -14,14 +14,31 @@ Three pillars, one import:
   per-compiled-program records (compile wall time, XLA memory plan,
   donation set).
 
-`observe.schema` declares every telemetry key set once — engine `Stats()`
-and GShardDecode telemetry are views generated from it.
+And the fleet-facing layer (ISSUE 13) on top:
+
+- `StatusServer` / `PrometheusText` (observe/export.py): a stdlib HTTP
+  thread per process serving /metrics, /statusz, /traces, /healthz.
+- `GoodputTracker` / `PublishMfu` (observe/goodput.py): wall-time
+  goodput/badput buckets + the `train/mfu` lazy gauge.
+- `StallWatchdog` (observe/watchdog.py): heartbeat liveness, stall trip
+  taxonomy, automatic ProfileWindow flight capture.
+- `observe.aggregate`: scrape-and-merge across N replica endpoints.
+
+`observe.schema` declares every telemetry key set once — engine `Stats()`,
+GShardDecode telemetry, endpoint paths, /statusz keys, goodput buckets and
+watchdog stats are views generated from it.
 """
 
+from lingvo_tpu.observe import aggregate  # noqa: F401
 from lingvo_tpu.observe import schema  # noqa: F401
+from lingvo_tpu.observe.export import (  # noqa: F401
+    BuildInfo, MetricName, PrometheusText, StatusServer)
+from lingvo_tpu.observe.goodput import (  # noqa: F401
+    GoodputTracker, PeakFlopsPerDevice, PublishMfu)
 from lingvo_tpu.observe.metrics import (  # noqa: F401
-    DEFAULT_BOUNDS, Default, MetricsRegistry)
+    DEFAULT_BOUNDS, Default, HistogramQuantiles, MetricsRegistry)
 from lingvo_tpu.observe.profile import (  # noqa: F401
     CompileInfo, CompileLog, ProfileWindow, ProfilerSupported)
 from lingvo_tpu.observe.trace import (  # noqa: F401
     RequestTrace, TraceRecorder)
+from lingvo_tpu.observe.watchdog import StallWatchdog  # noqa: F401

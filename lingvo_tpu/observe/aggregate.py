@@ -1,0 +1,128 @@
+"""Fleet aggregation: scrape N status endpoints, merge their snapshots.
+
+The substrate the disaggregated router does least-loaded admission
+against (ROADMAP: "the router only has to aggregate across replicas"):
+every replica exports /statusz (observe/export.py); this module pulls N
+of them and folds the registry snapshots into one fleet view with the
+only merge semantics that are honest per metric kind:
+
+- **counters** sum — fleet totals of monotonic work counts;
+- **histograms** merge bucket-by-bucket when the bounds match (count,
+  sum and per-bucket counts add; mean recomputed) — fleet latency
+  distributions stay exact because bucketing is lossless under union;
+- **everything else** (gauges, section values, config strings) stays
+  per-replica under `<label>/<name>` — a level has no meaningful sum.
+
+`Scrape`/`ScrapeAll` speak stdlib urllib to /statusz; `MergeSnapshots`
+is pure and also consumed in-process (bench fleet smoke, tests);
+`LeastLoaded` picks the admission target. `tools/fleet_report.py` is the
+CLI over all of it.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+from lingvo_tpu.observe import schema
+
+
+def Scrape(url: str, timeout: float = 5.0) -> dict:
+  """GETs a replica's /statusz and returns the (validated) document.
+
+  `url` may be a bare `host:port` or a base `http://host:port` — the
+  /statusz path is appended when absent."""
+  if "://" not in url:
+    url = "http://" + url
+  if not url.endswith("/statusz"):
+    url = url.rstrip("/") + "/statusz"
+  with urllib.request.urlopen(url, timeout=timeout) as resp:
+    doc = json.loads(resp.read().decode("utf-8"))
+  return schema.ValidateStatusz(doc)
+
+
+def ScrapeAll(urls, timeout: float = 5.0) -> dict:
+  """{label: statusz doc} for every reachable url; unreachable replicas
+  land as {"error": str} so one dead replica can't hide the fleet."""
+  out = {}
+  for url in urls:
+    label = url.replace("http://", "").replace("/statusz", "").rstrip("/")
+    try:
+      out[label] = Scrape(url, timeout=timeout)
+    except Exception as e:  # noqa: BLE001 - report, don't die
+      out[label] = {"error": f"{type(e).__name__}: {e}"}
+  return out
+
+
+def _IsHistogram(v) -> bool:
+  return isinstance(v, dict) and "counts" in v and "bounds" in v
+
+
+def _KindOf(name: str, describe: dict) -> str:
+  kind = describe.get(name)
+  if kind is not None:
+    return kind
+  head = name.split("/", 1)[0]
+  if describe.get(head) == "section":
+    return "gauge"
+  return "gauge"
+
+
+def _MergeHist(a: dict, b: dict) -> dict:
+  if a["bounds"] != b["bounds"]:   # incompatible bucketing: keep the larger
+    return a if a["count"] >= b["count"] else b
+  count = a["count"] + b["count"]
+  total = a["sum"] + b["sum"]
+  return {
+      "count": count,
+      "sum": total,
+      "mean": total / count if count else 0.0,
+      "bounds": list(a["bounds"]),
+      "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
+  }
+
+
+def MergeSnapshots(replicas) -> dict:
+  """Folds [(label, snapshot, describe)] into one fleet dict.
+
+  Returns {"replicas": [labels], "fleet": {...}, "per_replica":
+  {label: {...}}}: `fleet` holds summed counters and merged histograms
+  under their original names; `per_replica` holds everything else
+  (gauges, sections, strings) keyed by replica label."""
+  labels, fleet, per_replica = [], {}, {}
+  for label, snapshot, describe in replicas:
+    labels.append(label)
+    mine = per_replica.setdefault(label, {})
+    for name, v in snapshot.items():
+      if _IsHistogram(v):
+        fleet[name] = _MergeHist(fleet[name], v) if name in fleet else dict(v)
+      elif (_KindOf(name, describe) == "counter"
+            and isinstance(v, (int, float)) and not isinstance(v, bool)):
+        fleet[name] = fleet.get(name, 0) + v
+      else:
+        mine[name] = v
+  return {"replicas": labels, "fleet": fleet, "per_replica": per_replica}
+
+
+def MergeStatusz(docs: dict) -> dict:
+  """MergeSnapshots over {label: statusz doc} (errors skipped)."""
+  return MergeSnapshots([
+      (label, doc["snapshot"], doc.get("describe", {}))
+      for label, doc in docs.items() if "snapshot" in doc])
+
+
+def LeastLoaded(docs: dict,
+                load_key: str = "scheduler/queue_depth") -> Optional[str]:
+  """The replica label with the smallest numeric `load_key` in its
+  snapshot — the router's admission primitive. Replicas missing the key
+  (or erroring) are never chosen; None when nobody qualifies."""
+  best, best_load = None, None
+  for label in sorted(docs):
+    doc = docs[label]
+    v = doc.get("snapshot", {}).get(load_key)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+      continue
+    if best_load is None or v < best_load:
+      best, best_load = label, v
+  return best

@@ -1,0 +1,199 @@
+"""Goodput/badput wall-time accounting + honest MFU publication.
+
+Two questions a fleet dashboard asks of every trainer that this module
+answers from the process-global registry:
+
+- **Where did the wall time go?** `GoodputTracker` classifies elapsed
+  time into the `schema.GOODPUT_BUCKETS`: `step` (productive device
+  loops) vs badput — `compile`, `checkpoint_save`/`_restore`, `eval`,
+  `infeed_wait`, `recovery` (transient-failure retries) — plus the
+  residual `other` (wall − accounted), so the buckets always sum to
+  ~wall time. Hooks are context managers (`with tracker.Track("eval")`)
+  placed in the train/eval programs and the executor; the tracker
+  publishes everything as a lazy `goodput/*` registry section, so the
+  numbers are current at every scrape without a publish step.
+
+- **How fast relative to the hardware?** `PublishMfu` wires a
+  `train/mfu` lazy gauge: the train-step executable's XLA cost analysis
+  (flops/step, recorded by the programs' CompileLog/_RecordCompile or a
+  lazy `.lower().cost_analysis()` — no second compile either way) × the
+  `StepRateTracker` step-rate gauge ÷ nominal peak FLOP/s of the
+  attached devices. Peak numbers are per-chip dense-matmul nominals; on
+  CPU the denominator is a placeholder, so treat CPU MFU as relative
+  only (the flops numerator and the published `train/flops_per_step`
+  are exact everywhere).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+import jax
+
+from lingvo_tpu.observe import schema
+
+# Nominal peak dense-matmul FLOP/s per chip by device-kind substring
+# (bf16 numbers for TPUs). Matched case-insensitively, first hit wins;
+# order newest-first so "v5p" matches before "v5".
+PEAK_FLOPS_BY_KIND = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("cpu", 1e11),   # placeholder: CPU MFU is relative, not absolute
+)
+DEFAULT_PEAK_FLOPS = 100e12
+
+
+def PeakFlopsPerDevice(device_kind: str | None = None) -> float:
+  """Nominal per-chip peak FLOP/s for a device kind (default: device 0)."""
+  if device_kind is None:
+    devs = jax.devices()
+    device_kind = devs[0].device_kind if devs else ""
+  kind = (device_kind or "").lower()
+  for sub, peak in PEAK_FLOPS_BY_KIND:
+    if sub in kind:
+      return peak
+  return DEFAULT_PEAK_FLOPS
+
+
+class GoodputTracker:
+  """Accumulates wall time into goodput/badput buckets (module docstring).
+
+  clock: injectable monotonic-seconds source (tests). Registering with a
+  registry publishes `Stats()` as the lazy `goodput/*` section. One
+  tracker per process is the normal shape (`Get()`); programs and the
+  executor all feed the same one so buckets partition ONE wall clock.
+  """
+
+  def __init__(self, registry=None, clock=time.perf_counter,
+               section: str = "goodput"):
+    self._clock = clock
+    self._lock = threading.Lock()
+    self._t0 = clock()
+    self._buckets = {b: 0.0 for b in schema.GOODPUT_BUCKETS if b != "other"}
+    if registry is not None:
+      registry.SectionFn(section, self.Stats)
+
+  def Add(self, bucket: str, seconds: float):
+    assert bucket in self._buckets, (
+        f"unknown goodput bucket {bucket!r}; schema.GOODPUT_BUCKETS = "
+        f"{schema.GOODPUT_BUCKETS}")
+    with self._lock:
+      self._buckets[bucket] += max(float(seconds), 0.0)
+
+  def CompileSeconds(self) -> float:
+    """Monotonic total of the compile bucket — callers snapshot it around
+    a window to find how much compilation happened inside."""
+    with self._lock:
+      return self._buckets["compile"]
+
+  @contextlib.contextmanager
+  def Track(self, bucket: str):
+    """Attributes the wall time of the enclosed block to `bucket`."""
+    t0 = self._clock()
+    try:
+      yield
+    finally:
+      self.Add(bucket, self._clock() - t0)
+
+  @contextlib.contextmanager
+  def TrackExcludingCompile(self, bucket: str):
+    """Like Track, minus any compile seconds the jax.monitoring listener
+    attributed during the block — lazy jit compiles inside a step/eval
+    window must not be double-counted as productive (or eval) time."""
+    t0 = self._clock()
+    c0 = self.CompileSeconds()
+    try:
+      yield
+    finally:
+      elapsed = self._clock() - t0
+      compiled = self.CompileSeconds() - c0
+      self.Add(bucket, max(elapsed - compiled, 0.0))
+
+  def Reset(self):
+    with self._lock:
+      self._t0 = self._clock()
+      for b in self._buckets:
+        self._buckets[b] = 0.0
+
+  def Stats(self) -> dict:
+    """`goodput/*` section: per-bucket seconds + wall + productive ratio.
+    `other_s` is the residual (clamped at 0), so the buckets sum to wall —
+    up to the slight compile-event overcount noted above."""
+    with self._lock:
+      wall = max(self._clock() - self._t0, 0.0)
+      out = {f"{b}_s": round(v, 6) for b, v in self._buckets.items()}
+      accounted = sum(self._buckets.values())
+      productive = sum(self._buckets[b] for b in schema.GOODPUT_PRODUCTIVE)
+    out["other_s"] = round(max(wall - accounted, 0.0), 6)
+    out["wall_s"] = round(wall, 6)
+    out["productive_ratio"] = round(productive / wall, 6) if wall else 0.0
+    assert set(out) == set(schema.GOODPUT_STATS_KEYS)
+    return out
+
+
+_GET_LOCK = threading.Lock()
+_TRACKER: GoodputTracker | None = None
+
+# duration events covering the whole compile pipeline: jaxpr trace,
+# MLIR lowering, XLA backend compile — they fire on every cache miss,
+# AOT or lazy, so the listener sees each compile exactly once. Inner-jit
+# trace/lowering events nest inside the outer jit's, so the compile
+# bucket can overcount by the nested fraction (<1% in practice): the
+# buckets sum to ~wall, not exactly wall.
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+
+
+def _OnJaxEvent(event: str, duration_s: float, **_):
+  """jax.monitoring duration listener feeding the global tracker. This is
+  how lazily-jitted programs (no AOT CompileLog) still land their compile
+  wall in the compile bucket instead of hiding inside a step window."""
+  if event.startswith(_COMPILE_EVENT_PREFIX) and _TRACKER is not None:
+    _TRACKER.Add("compile", duration_s)
+
+
+def Get() -> GoodputTracker:
+  """The process-global tracker, registered on observe.Default()."""
+  global _TRACKER
+  with _GET_LOCK:
+    if _TRACKER is None:
+      from lingvo_tpu.observe import metrics as metrics_lib
+      _TRACKER = GoodputTracker(registry=metrics_lib.Default())
+      try:
+        jax.monitoring.register_event_duration_secs_listener(_OnJaxEvent)
+      except Exception:  # noqa: BLE001 - accounting must never break jax
+        pass
+    return _TRACKER
+
+
+def PublishMfu(registry, flops_per_step: float,
+               rate_gauge: str = "train/train_steps_per_second",
+               name: str = "train/mfu",
+               peak_flops: float | None = None):
+  """Wires `train/mfu` as a lazy gauge over the step-rate gauge.
+
+  mfu = flops_per_step × steps_per_second / (per-device peak × #devices).
+  Reading the rate gauge's `.value` inside the GaugeFn is safe: the
+  registry lock is an RLock and the snapshot already holds it. Also
+  publishes the inputs (`train/flops_per_step`, `train/peak_flops`) so a
+  scraper can recompute with its own peak numbers."""
+  if peak_flops is None:
+    peak_flops = PeakFlopsPerDevice() * max(jax.device_count(), 1)
+  flops = float(flops_per_step)
+  registry.Gauge("train/flops_per_step").Set(flops)
+  registry.Gauge("train/peak_flops").Set(float(peak_flops))
+  rate_g = registry.Gauge(rate_gauge)
+
+  def _Mfu():
+    rate = rate_g.value
+    if not isinstance(rate, (int, float)) or rate <= 0 or peak_flops <= 0:
+      return 0.0
+    return flops * rate / peak_flops
+
+  registry.GaugeFn(name, _Mfu)

@@ -216,6 +216,34 @@ class MetricsRegistry:
     return out
 
 
+def HistogramQuantiles(snap: dict, qs=(0.5, 0.99)) -> dict:
+  """Bucket-interpolated quantiles from a histogram snapshot dict.
+
+  Linear interpolation inside the bucket the quantile rank lands in (the
+  Prometheus `histogram_quantile` rule): the first bucket interpolates
+  from 0, and ranks in the overflow bucket clamp to the highest finite
+  bound (there is no upper edge to interpolate toward). Returns
+  {q: value}; all zeros for an empty histogram."""
+  total = snap["count"]
+  bounds, counts = snap["bounds"], snap["counts"]
+  out = {}
+  for q in qs:
+    if total <= 0 or not bounds:
+      out[q] = 0.0
+      continue
+    rank = q * total
+    cum = 0
+    value = bounds[-1]   # default: rank fell in the overflow bucket
+    for i, n in enumerate(counts[:len(bounds)]):
+      if cum + n >= rank and n > 0:
+        lo = bounds[i - 1] if i > 0 else 0.0
+        value = lo + (bounds[i] - lo) * (rank - cum) / n
+        break
+      cum += n
+    out[q] = value
+  return out
+
+
 _DEFAULT_LOCK = threading.Lock()
 _DEFAULT: MetricsRegistry | None = None
 

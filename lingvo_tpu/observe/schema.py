@@ -48,8 +48,9 @@ ENGINE_STATS_REQUIRED = frozenset(
 #   spec        — engines with a draft source
 #   trace       — engines with tracing enabled (the default)
 #   compile     — per-compiled-program records (observe/profile.py)
+#   watchdog    — engines with a stall watchdog (observe/watchdog.py)
 ENGINE_STATS_OPTIONAL = frozenset(
-    {"state_slots", "spec", "trace", "compile"})
+    {"state_slots", "spec", "trace", "compile", "watchdog"})
 
 
 def ValidateEngineStats(stats: dict) -> dict:
@@ -125,4 +126,66 @@ KV_PAGES_OPTIONAL = frozenset({"page_bytes", "pool_bytes"})
 TRACE_STATS_KEYS = frozenset({
     "events_emitted", "events_buffered", "events_dropped",
     "requests_open", "requests_completed",
+})
+
+
+# -- HTTP status endpoints (observe/export.py) --------------------------------
+
+# Every path a StatusServer serves. The server builds its route table FROM
+# this tuple (and asserts the two match), so a new endpoint lands here or
+# the server refuses to start.
+ENDPOINT_PATHS = ("/metrics", "/statusz", "/traces", "/healthz")
+
+# /statusz JSON document: top-level keys. `snapshot`/`describe` are the
+# owning registry's Snapshot()/Describe(); `stats` is the owner's richer
+# structured view (engine Stats() with compile records, executor program
+# records) or None; `build` is BuildInfo() below.
+STATUSZ_REQUIRED = frozenset({"name", "build", "snapshot", "describe",
+                              "stats"})
+STATUSZ_OPTIONAL = frozenset({"watchdog"})
+
+# observe/export.py BuildInfo() — the jax/config facts /statusz carries.
+BUILD_INFO_KEYS = frozenset({
+    "jax_version", "jaxlib_version", "backend", "device_count",
+    "device_kind", "process_index", "process_count",
+})
+
+
+def ValidateStatusz(doc: dict) -> dict:
+  """Asserts a /statusz document matches the schema; returns it unchanged."""
+  keys = set(doc)
+  missing = STATUSZ_REQUIRED - keys
+  assert not missing, f"/statusz missing schema keys: {sorted(missing)}"
+  unknown = keys - STATUSZ_REQUIRED - STATUSZ_OPTIONAL
+  assert not unknown, f"/statusz keys not in schema: {sorted(unknown)}"
+  bkeys = set(doc["build"])
+  bmissing = BUILD_INFO_KEYS - bkeys
+  assert not bmissing, f"/statusz build missing keys: {sorted(bmissing)}"
+  return doc
+
+
+# -- goodput / badput accounting (observe/goodput.py) -------------------------
+
+# Wall-time classification buckets. `step` is the productive bucket;
+# everything else is badput; `other` is the residual (wall − accounted), so
+# the buckets always sum to ~wall time.
+GOODPUT_BUCKETS = ("step", "compile", "checkpoint_save", "checkpoint_restore",
+                   "eval", "infeed_wait", "recovery", "other")
+GOODPUT_PRODUCTIVE = frozenset({"step"})
+
+# observe/goodput.py GoodputTracker.Stats() — the `goodput/*` section.
+GOODPUT_STATS_KEYS = frozenset(
+    {f"{b}_s" for b in GOODPUT_BUCKETS} | {"wall_s", "productive_ratio"})
+
+
+# -- stall watchdog (observe/watchdog.py) -------------------------------------
+
+# Trip taxonomy: no heartbeat within k×EMA step time, a step-time
+# regression, or serving queue growth without retirement.
+WATCHDOG_TRIP_KINDS = ("no_heartbeat", "step_regression", "queue_stall")
+
+# observe/watchdog.py StallWatchdog.Stats() — the `watchdog/*` section.
+WATCHDOG_STATS_KEYS = frozenset({
+    "healthy", "beats", "trips", "tripped", "last_beat_age_s",
+    "step_ema_s", "capture_armed",
 })

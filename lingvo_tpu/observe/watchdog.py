@@ -1,0 +1,215 @@
+"""Stall watchdog: heartbeat-driven liveness with automatic flight capture.
+
+Nobody was watching the watchers: a hung device step, a step-time
+regression, or a scheduler that admits but never retires all looked like
+"the process is up" from outside. `StallWatchdog` closes that gap:
+
+- **Heartbeats.** The train loop and `ServingLoop` call `Beat()` once per
+  completed step; the watchdog keeps an EMA of inter-beat time. `Check()`
+  — run by the /healthz scrape thread, a periodic checker thread, or a
+  test — evaluates the trip conditions. The split matters: a hung step
+  loop cannot self-report, so liveness must be evaluated on a thread the
+  stall can't take down.
+
+- **Trips** (`schema.WATCHDOG_TRIP_KINDS`):
+    no_heartbeat     now − last beat > stall_factor × max(EMA, min_interval)
+    step_regression  the latest step took > regression_factor × prior EMA
+    queue_stall      serving queue depth grew over the observation window
+                     while retirements stayed flat
+  On a NEW trip episode: the per-kind and total trip counters increment
+  (once per episode, not per scrape), `healthy` flips (so /healthz
+  returns 503), and — when a capture logdir is configured — a
+  `ProfileWindow` flight recorder is armed over the next `capture_steps`
+  beats, so the profile covers exactly the recovery/stall neighborhood.
+  A condition that clears (a beat arrives, the queue drains) ends the
+  episode and restores health.
+
+All state is lock-guarded and every timestamp comes from an injectable
+`clock`, so trip windows are testable without sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from lingvo_tpu.observe import profile as profile_lib
+from lingvo_tpu.observe import schema
+
+
+class StallWatchdog:
+  """Heartbeat liveness + stall classification (module docstring).
+
+  registry: optional MetricsRegistry — publishes `Stats()` as the lazy
+  `watchdog/*` section plus monotonic trip counters
+  (`watchdog/trips_total`, `watchdog/trips_<kind>`). capture_logdir:
+  arming directory for the automatic ProfileWindow (None disables
+  auto-capture). clock: injectable monotonic-seconds source.
+  """
+
+  def __init__(self, registry=None, *, stall_factor: float = 10.0,
+               min_interval_s: float = 1.0, regression_factor: float = 4.0,
+               ema_alpha: float = 0.2, queue_window: int = 4,
+               capture_logdir: Optional[str] = None, capture_steps: int = 5,
+               clock=time.monotonic, namespace: str = "watchdog"):
+    self._lock = threading.Lock()
+    self._clock = clock
+    self.stall_factor = float(stall_factor)
+    self.min_interval_s = float(min_interval_s)
+    self.regression_factor = float(regression_factor)
+    self.ema_alpha = float(ema_alpha)
+    self.capture_logdir = capture_logdir
+    self.capture_steps = int(capture_steps)
+    self._beats = 0
+    self._last_beat = clock()
+    self._ema: Optional[float] = None
+    self._last_step_s: Optional[float] = None
+    self._prev_ema: Optional[float] = None
+    # (depth, retired) observations; a full window with growing depth and
+    # flat retirement is the queue_stall signature
+    self._queue = deque(maxlen=max(int(queue_window), 2))
+    self._tripped: set = set()       # kinds with an active episode
+    self._trips_total = 0
+    self.capture: Optional[profile_lib.ProfileWindow] = None
+    self._counters = None
+    if registry is not None:
+      self._counters = {
+          "total": registry.Counter(f"{namespace}/trips_total"),
+          **{k: registry.Counter(f"{namespace}/trips_{k}")
+             for k in schema.WATCHDOG_TRIP_KINDS}}
+      registry.SectionFn(namespace, self.Stats)
+    self._checker: Optional[threading.Thread] = None
+    self._checker_stop = threading.Event()
+
+  # -- signal intake ----------------------------------------------------------
+
+  def Beat(self, step_time_s: Optional[float] = None):
+    """One completed step. step_time_s overrides the inter-beat elapsed
+    time (callers that know the device wall should pass it)."""
+    with self._lock:
+      now = self._clock()
+      if step_time_s is None and self._beats > 0:
+        step_time_s = now - self._last_beat
+      self._beats += 1
+      self._last_beat = now
+      if step_time_s is not None:
+        self._prev_ema = self._ema
+        self._last_step_s = float(step_time_s)
+        self._ema = (self._last_step_s if self._ema is None else
+                     self.ema_alpha * self._last_step_s
+                     + (1.0 - self.ema_alpha) * self._ema)
+      if self.capture is not None and self.capture.StepDone():
+        self.capture = None   # flight recorder window closed
+      self._Evaluate(now)
+
+  def Idle(self):
+    """The monitored loop is alive but has no work: refresh liveness
+    without folding the idle wait into the step-time EMA. Without this
+    a traffic-less serving replica stops beating and reads as a
+    no_heartbeat stall after the trip window."""
+    with self._lock:
+      self._last_beat = self._clock()
+
+  def ObserveQueue(self, depth: int, retired: int):
+    """Serving-side signal: queue depth + cumulative retirements."""
+    with self._lock:
+      self._queue.append((int(depth), int(retired)))
+
+  # -- evaluation -------------------------------------------------------------
+
+  def Check(self) -> dict:
+    """Evaluates all trip conditions NOW; returns Stats(). This is the
+    entry point for /healthz scrapes and checker threads — it must be
+    called from a thread the monitored loop cannot hang."""
+    with self._lock:
+      self._Evaluate(self._clock())
+      return self._StatsLocked()
+
+  def _Evaluate(self, now: float):
+    """Trip/clear pass (caller holds the lock)."""
+    # no_heartbeat: only meaningful once the loop has started beating
+    if self._beats > 0:
+      window = self.stall_factor * max(self._ema or 0.0, self.min_interval_s)
+      self._SetCondition("no_heartbeat", now - self._last_beat > window)
+    # step_regression: latest step vs the EMA before it was folded in
+    if self._prev_ema is not None and self._last_step_s is not None:
+      self._SetCondition(
+          "step_regression",
+          self._last_step_s > self.regression_factor
+          * max(self._prev_ema, 1e-9))
+    # queue_stall: a full window where depth grew but nothing retired
+    if len(self._queue) == self._queue.maxlen:
+      (d0, r0), (d1, r1) = self._queue[0], self._queue[-1]
+      self._SetCondition("queue_stall", d1 > d0 and d1 > 0 and r1 == r0)
+
+  def _SetCondition(self, kind: str, active: bool):
+    if active and kind not in self._tripped:
+      self._tripped.add(kind)
+      self._trips_total += 1
+      if self._counters is not None:
+        self._counters["total"].Inc()
+        self._counters[kind].Inc()
+      if self.capture_logdir and self.capture is None:
+        self.capture = profile_lib.ProfileWindow(
+            self.capture_logdir, steps=self.capture_steps).Start()
+    elif not active and kind in self._tripped:
+      self._tripped.discard(kind)
+
+  # -- views ------------------------------------------------------------------
+
+  @property
+  def healthy(self) -> bool:
+    with self._lock:
+      return not self._tripped
+
+  def Stats(self) -> dict:
+    with self._lock:
+      return self._StatsLocked()
+
+  def _StatsLocked(self) -> dict:
+    out = {
+        "healthy": not self._tripped,
+        "beats": self._beats,
+        "trips": self._trips_total,
+        "tripped": ",".join(sorted(self._tripped)),
+        "last_beat_age_s": round(self._clock() - self._last_beat, 6),
+        "step_ema_s": round(self._ema, 6) if self._ema is not None else 0.0,
+        "capture_armed": self.capture is not None,
+    }
+    assert set(out) == set(schema.WATCHDOG_STATS_KEYS)
+    return out
+
+  # -- optional periodic checker ---------------------------------------------
+
+  def StartChecker(self, interval_s: float = 1.0) -> "StallWatchdog":
+    """Background thread calling Check() every interval (for processes
+    without a /healthz scraper); StopChecker() to end it."""
+    if self._checker is None:
+      self._checker_stop.clear()
+
+      def _Run():
+        while not self._checker_stop.wait(interval_s):
+          self.Check()
+
+      self._checker = threading.Thread(target=_Run, daemon=True,
+                                       name="stall-watchdog")
+      self._checker.start()
+    return self
+
+  def StopChecker(self):
+    if self._checker is not None:
+      self._checker_stop.set()
+      self._checker.join(timeout=5.0)
+      self._checker = None
+
+  def Close(self):
+    """Teardown: stops the checker thread and any still-armed flight
+    recorder. The jax profiler is a process singleton — an abandoned
+    window would block every later capture in the process."""
+    self.StopChecker()
+    with self._lock:
+      cap, self.capture = self.capture, None
+    if cap is not None:
+      cap.Stop()

@@ -16,6 +16,8 @@ from typing import Any
 
 import jax
 
+from lingvo_tpu import observe
+from lingvo_tpu.observe import goodput as goodput_lib
 from lingvo_tpu.core import checkpointer as checkpointer_lib
 from lingvo_tpu.core import py_utils
 from lingvo_tpu.core.nested_map import NestedMap
@@ -26,7 +28,7 @@ class ExecutorTpu:
   def __init__(self, model_params, logdir: str, schedule=None, task=None,
                init_seed: int = 1234, precompile: bool = False,
                max_train_retries: int = 3, mlperf_benchmark: str = "",
-               trial=None):
+               trial=None, serve_port=None, watchdog=None):
     """model_params: SingleTaskModel-style params (task + input attached).
 
     If `task` is given (e.g. the instance shared with the program schedule),
@@ -35,6 +37,14 @@ class ExecutorTpu:
     None. `max_train_retries`: consecutive transient failures tolerated
     before giving up (each retry restores the last checkpoint — ref
     `base_runner._RunLoop:399-528` taxonomy).
+
+    serve_port: when not None, a StatusServer over the process-global
+    registry serves /metrics, /statusz, /traces, /healthz for this
+    trainer (0 = ephemeral port; read `self.status_server.port`). It is
+    stopped when the main loop exits. watchdog: None auto-creates a
+    StallWatchdog when serve_port is set; True forces one; False
+    disables; or pass a configured StallWatchdog. The watchdog beats
+    once per schedule Run, so /healthz flips when the train loop stalls.
     """
     self._logdir = logdir
     os.makedirs(logdir, exist_ok=True)
@@ -105,6 +115,33 @@ class ExecutorTpu:
               window=tp.early_stop_window,
               tolerance=tp.early_stop_tolerance,
               metric_history=self._metric_history))
+    # fleet-facing telemetry (observe/): checkpoint/recovery wall time
+    # feeds the process-global goodput tracker; serve_port opens the
+    # status endpoints; the watchdog beats once per schedule Run
+    self._goodput = goodput_lib.Get()
+    self.watchdog = None
+    if isinstance(watchdog, observe.StallWatchdog):
+      self.watchdog = watchdog
+    elif watchdog or (watchdog is None and serve_port is not None):
+      self.watchdog = observe.StallWatchdog(observe.Default())
+    self.status_server = None
+    if serve_port is not None:
+      self.status_server = observe.StatusServer(
+          serve_port, registry=observe.Default(), name="executor",
+          statusz_fn=self._StatuszStats,
+          watchdog=self.watchdog).Start()
+
+  def _StatuszStats(self) -> dict:
+    """Structured /statusz `stats`: loop facts + every program's AOT
+    compile records (wall time, XLA memory plan, flops)."""
+    out = {"max_steps": self._max_steps, "compile": {}}
+    for prog in self._SchedulePrograms():
+      name = (getattr(getattr(prog, "p", None), "name", "")
+              or type(prog).__name__)
+      recs = getattr(prog, "compile_records", None)
+      if recs:
+        out["compile"][name] = dict(recs)
+    return out
 
   @property
   def task(self):
@@ -184,7 +221,8 @@ class ExecutorTpu:
     # 'no checkpoint at all' (fresh run) is distinct from 'restored the
     # step-0 checkpoint' — warm start must apply only to the former
     fresh_run = self._checkpointer.LatestStep() is None
-    state, start_step = self._checkpointer.Restore(state)
+    with self._goodput.Track("checkpoint_restore"):
+      state, start_step = self._checkpointer.Restore(state)
     if fresh_run and self._task is not None:
       rules = getattr(self._task.p.train, "init_from_checkpoint_rules", None)
       if rules:
@@ -257,6 +295,11 @@ class ExecutorTpu:
       return self._MainLoopBody(state, start_step)
     finally:
       self._ShutdownPrograms()
+      if self.status_server is not None:
+        self.status_server.Stop()
+        self.status_server = None
+      if self.watchdog is not None:
+        self.watchdog.Close()   # drop any still-armed flight recorder
 
   def _MainLoopBody(self, state, start_step):
     from lingvo_tpu.core import retry as retry_lib
@@ -265,13 +308,16 @@ class ExecutorTpu:
     while step < self._max_steps:
       # Save applies the cadence policy itself; checking ShouldSave here
       # too would run its multi-host broadcast twice per cycle
-      self._checkpointer.Save(step, state)
+      with self._goodput.Track("checkpoint_save"):
+        self._checkpointer.Save(step, state)
       if self._mlperf is not None:
         self._mlperf.Print(self._mllog.BLOCK_START,
                            metadata={"step": step})
       try:
         state, results = self._schedule.Run(state)
         consecutive_failures = 0
+        if self.watchdog is not None:
+          self.watchdog.Beat()
       except BaseException as e:  # noqa: BLE001
         if self._mlperf is not None:
           # keep intervals balanced: close the block before retrying/raising
@@ -286,13 +332,15 @@ class ExecutorTpu:
               f"restoring last checkpoint and retrying "
               f"({consecutive_failures}/{self._max_train_retries}) "
               f"in {delay:.0f}s", flush=True)
-        time.sleep(delay)
-        # rebuild device state from the last checkpoint (ref: cleanup +
-        # rebuild session + resume from checkpoint); restart any errored
-        # infeed producers so the retried Run pulls fresh batches
-        self._RecoverPrograms()
-        state, step = self._checkpointer.Restore(
-            self._PlaceState(self._CreateTrainState()))
+        with self._goodput.Track("recovery"):
+          time.sleep(delay)
+          # rebuild device state from the last checkpoint (ref: cleanup +
+          # rebuild session + resume from checkpoint); restart any errored
+          # infeed producers so the retried Run pulls fresh batches
+          self._RecoverPrograms()
+        with self._goodput.Track("checkpoint_restore"):
+          state, step = self._checkpointer.Restore(
+              self._PlaceState(self._CreateTrainState()))
         continue
       step = int(jax.device_get(state.step))
       state = self._MaybePrune(state, step)
@@ -384,7 +432,8 @@ class ExecutorTpu:
       self._mlperf.Close()
     if not self._trial_done:
       self._trial.ReportDone()
-    self._checkpointer.Save(step, state, force=True)
+    with self._goodput.Track("checkpoint_save"):
+      self._checkpointer.Save(step, state, force=True)
     self._checkpointer.Close()
     # marker for follower jobs (evaler/decoder pollers): training is over —
     # process the final checkpoint and exit instead of idling to timeout

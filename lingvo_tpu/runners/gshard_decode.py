@@ -62,7 +62,8 @@ class GShardDecode:
                prefill_chunk_size: int = 0,
                use_legacy_prime: bool = False,
                serve_int8_weights: bool = False,
-               len_buckets=DEFAULT_LEN_BUCKETS):
+               len_buckets=DEFAULT_LEN_BUCKETS,
+               serve_port=None):
     """task: a TransformerLm-style task exposing InitDecodeState/ExtendStep.
 
     temperature/top_k: sampling controls (core/sampling.py). temperature
@@ -76,6 +77,10 @@ class GShardDecode:
     the A/B reference). serve_int8_weights: rewrite each restored theta so
     decode projections run int8 integer matmuls (quant.weights — rewritten
     once per checkpoint, cached). len_buckets: prompt-width buckets.
+    serve_port: when not None, a StatusServer over this driver's registry
+    serves /metrics and /statusz (0 = ephemeral; read
+    `self.status_server.port`); /statusz `stats` carries the last
+    DecodeOnce telemetry.
     """
     self._task = task
     self._train_dir = train_dir
@@ -108,6 +113,11 @@ class GShardDecode:
     self.metrics = observe.MetricsRegistry("gshard_decode")
     self._decodes = self.metrics.Counter("serving/decodes")
     self._last_telemetry = None
+    self.status_server = None
+    if serve_port is not None:
+      self.status_server = observe.StatusServer(
+          serve_port, registry=self.metrics, name="gshard_decode",
+          statusz_fn=lambda: {"telemetry": self._last_telemetry}).Start()
 
   def _GetDecodeFn(self, p_len: int, t_max: int):
     """Builds (init_fn, decode_fn) for a static (p_len, t_max) pair."""

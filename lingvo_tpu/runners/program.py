@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lingvo_tpu import observe
+from lingvo_tpu.observe import goodput as goodput_lib
 from lingvo_tpu.core import base_layer
 from lingvo_tpu.core import hyperparams
 from lingvo_tpu.core import metrics as metrics_lib
@@ -113,6 +114,9 @@ class BaseProgram:
     # train-side observability publishes to the process-global registry
     # (one trainer per process; serving engines use per-instance ones)
     self.metrics = observe.Default()
+    # all programs feed ONE process-global goodput tracker, so the
+    # buckets partition a single wall clock (observe/goodput.py)
+    self._goodput = goodput_lib.Get()
     self._rate_tracker = summary_utils.StepRateTracker(
         registry=self.metrics, name=self.p.name or "train")
     # {program_name: compile record} — wall time + XLA memory plan of each
@@ -187,10 +191,21 @@ class BaseProgram:
     Dispatch behavior is unchanged: like the previous Compile(), the
     executable is discarded and Run keeps calling the jit wrapper."""
     t0 = time.perf_counter()
-    compiled = fn.lower(*args).compile()
+    # exclude the listener-attributed backend-compile seconds so the AOT
+    # window's remainder (lowering glue) is all that lands here extra
+    with self._goodput.TrackExcludingCompile("compile"):
+      compiled = fn.lower(*args).compile()
     rec = {"name": name,
            "compile_wall_s": round(time.perf_counter() - t0, 6)}
     rec.update(observe.CompileInfo(compiled))
+    from lingvo_tpu.core import computation_cost
+    try:
+      flops = float(computation_cost.CostAnalysisOf(compiled).get(
+          "flops", 0.0))
+    except Exception:  # noqa: BLE001 - cost analysis is backend-optional
+      flops = 0.0
+    if flops > 0:
+      rec["flops"] = flops
     self.compile_records[name] = rec
     ns = self.p.name or type(self).__name__
     self.metrics.Gauge(
@@ -198,6 +213,11 @@ class BaseProgram:
     if "temp_bytes" in rec:
       self.metrics.Gauge(
           f"{ns}/compile/{name}_temp_bytes").Set(rec["temp_bytes"])
+    self._OnCompileRecord(name, rec)
+
+  def _OnCompileRecord(self, name: str, rec: dict) -> None:
+    """Subclass hook after every AOT compile record (TrainProgram uses it
+    to derive flops/step and publish `train/mfu`)."""
 
   def _GetStepFn(self, state: NestedMap | None = None):
     raise NotImplementedError
@@ -330,6 +350,10 @@ class TrainProgram(BaseProgram):
   The jit'd unit is a single TrainStep; the host loop feeds batches and
   donates the state buffers so theta/opt-state update in place on device.
   """
+
+  # flops per optimizer step, from the step executable's XLA cost
+  # analysis; set once (AOT compile record or lazy first-Run lower())
+  _flops_per_step: float | None = None
 
   @classmethod
   def Params(cls):
@@ -482,6 +506,57 @@ class TrainProgram(BaseProgram):
           registry=self.metrics)
     return self._telemetry
 
+  def _OnCompileRecord(self, name: str, rec: dict) -> None:
+    """Derives flops/step from the AOT compile's cost analysis and wires
+    the `train/mfu` lazy gauge ("loop" compiles cover steps_per_loop
+    optimizer steps in one executable)."""
+    flops = rec.get("flops", 0.0)
+    if flops <= 0:
+      return
+    steps = self.p.steps_per_loop if name == "loop" else 1
+    self._SetFlopsPerStep(flops / max(steps, 1))
+
+  def _SetFlopsPerStep(self, flops_per_step: float) -> None:
+    self._flops_per_step = flops_per_step
+    goodput_lib.PublishMfu(
+        self.metrics, flops_per_step,
+        rate_gauge=f"train/{self.p.name or 'train'}_steps_per_second")
+
+  def _MaybePublishMfu(self, fn, *args, steps: int = 1) -> None:
+    """Lazy flops/step for runs without an AOT Compile(): one abstract
+    `.lower().cost_analysis()` on the first Run — tracing only, never a
+    second XLA compilation (jax >= 0.4.30 analyzes the lowered HLO)."""
+    if self._flops_per_step is not None or not hasattr(fn, "lower"):
+      return
+    try:
+      cost = fn.lower(*args).cost_analysis()
+      if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+      flops = float((cost or {}).get("flops", 0.0))
+    except Exception:  # noqa: BLE001 - cost analysis is backend-optional
+      flops = 0.0
+    if flops > 0:
+      self._SetFlopsPerStep(flops / max(steps, 1))
+    else:
+      self._flops_per_step = 0.0   # don't re-trace every Run
+
+  def _MarkRunStart(self) -> None:
+    self._run_compile_mark = self._goodput.CompileSeconds()
+
+  def _AttributeRunWall(self, t_start: float, infeed_wait_s: float) -> None:
+    """Goodput attribution of one Run's wall: input wait is badput, the
+    rest is the productive device loop minus any lazy jit compiles the
+    jax.monitoring listener attributed inside the window (first Run
+    without AOT precompile — that wall is compile badput, not step). In
+    the async/deferred pipeline the Run blocks on the PREVIOUS loop's
+    telemetry, so in steady state its wall still spans ~one device loop."""
+    wall = max(time.time() - t_start, 0.0)
+    compiled = max(
+        self._goodput.CompileSeconds()
+        - getattr(self, "_run_compile_mark", 0.0), 0.0)
+    self._goodput.Add("infeed_wait", min(max(infeed_wait_s, 0.0), wall))
+    self._goodput.Add("step", max(wall - infeed_wait_s - compiled, 0.0))
+
   def _RefreshHostSchedules(self) -> None:
     """Host-driven schedules (DevBasedSchedule anneal-on-plateau) may change
     between runs; their values are trace-time constants, so a change must
@@ -515,6 +590,7 @@ class TrainProgram(BaseProgram):
     only the infeed_wait_s / host_overhead_s timers are new."""
     p = self.p
     t0 = time.time()
+    self._MarkRunStart()
     if p.on_device_loop:
       # host: prefetch + stack steps_per_loop batches; device: one program
       t_in = time.perf_counter()
@@ -525,6 +601,7 @@ class TrainProgram(BaseProgram):
       stacked = self._PutStackedBatch(stacked)
       infeed_wait_s = time.perf_counter() - t_in
       fn = self._GetLoopFn(state)
+      self._MaybePublishMfu(fn, state, stacked, steps=p.steps_per_loop)
       with self._MeshScope(), self._ProfilerScope():
         state, acc, stats_acc = fn(state, stacked)
         jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
@@ -539,6 +616,7 @@ class TrainProgram(BaseProgram):
           batch = self._PutBatch(
               self.input_generator.GetPreprocessedInputBatch())
           infeed_wait_s += time.perf_counter() - t_in
+          self._MaybePublishMfu(fn, state, batch)
           state, out = fn(state, batch)
           acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
           stats_pairs = NestedMap(
@@ -549,6 +627,7 @@ class TrainProgram(BaseProgram):
         # inside the profiler scope so traces capture the device work.
         jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
     wall = time.time() - t0
+    self._AttributeRunWall(t0, infeed_wait_s)
     t_tel = time.perf_counter()
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     if stats_acc:
@@ -579,6 +658,7 @@ class TrainProgram(BaseProgram):
     (<= 1 loop stale; the first Run blocks for its own)."""
     p = self.p
     t0 = time.time()
+    self._MarkRunStart()
     infeed = self._GetInfeed()
     wait0 = infeed.wait_s
     if p.on_device_loop:
@@ -588,6 +668,7 @@ class TrainProgram(BaseProgram):
       if not infeed.places_batches:
         stacked = self._PutStackedBatch(stacked)
       fn = self._GetLoopFn(state)
+      self._MaybePublishMfu(fn, state, stacked, steps=p.steps_per_loop)
       with self._MeshScope(), self._ProfilerScope():
         state, acc, stats_acc = fn(state, stacked)
         if self._profiling_run:
@@ -604,6 +685,7 @@ class TrainProgram(BaseProgram):
             raise StopIteration("train input exhausted")
           if not infeed.places_batches:
             batch = self._PutBatch(batch)
+          self._MaybePublishMfu(fn, state, batch)
           state, out = fn(state, batch)
           acc = metrics_lib.AccumulateMetrics(acc, out.metrics)
           stats_pairs = NestedMap(
@@ -628,7 +710,9 @@ class TrainProgram(BaseProgram):
         self._FinalizeLoop, step_arr, acc, stats_acc, t0,
         host_overhead_s, infeed_wait_s, queue_depth, input_stats)
     if not p.defer_telemetry:
-      return state, job()[1]
+      result = job()[1]
+      self._AttributeRunWall(t0, infeed_wait_s)
+      return state, result
     fut = self._GetTelemetry().Submit(job)
     prev, self._pending_telemetry = self._pending_telemetry, fut
     # steady state: return loop k-1's result (its fetch overlapped this
@@ -636,6 +720,7 @@ class TrainProgram(BaseProgram):
     # marks it consumed so Flush won't report it a second time
     self._pending_consumed = prev is None
     result = (prev if prev is not None else fut).result()[1]
+    self._AttributeRunWall(t0, infeed_wait_s)
     return state, result
 
   def _FinalizeLoop(self, step_arr, acc, stats_acc, t_start,
@@ -706,6 +791,10 @@ class EvalProgram(BaseProgram):
     return self.p.steps_per_loop
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    with self._goodput.TrackExcludingCompile("eval"):   # badput, minus compiles
+      return self._RunEval(state)
+
+  def _RunEval(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
     fn = self._GetStepFn(state)
     theta = self._EvalTheta(state)
     acc = None
@@ -777,6 +866,10 @@ class DecodeProgram(BaseProgram):
     return self._step_fn
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
+    with self._goodput.TrackExcludingCompile("eval"):   # decode rides eval badput
+      return self._RunDecode(state)
+
+  def _RunDecode(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
     fn = self._GetStepFn(state)
     theta = (state.ema_theta
              if self.p.use_ema and "ema_theta" in state else state.theta)

@@ -137,7 +137,8 @@ class ServingLoop:
                temperature: float = 0.0, top_k: int = 0,
                sample_seed: int = 0, kv_cache_dtype: Optional[str] = None,
                serve_int8_weights: bool = False, spec=None,
-               trace=True, metrics_registry=None):
+               trace=True, metrics_registry=None,
+               serve_port: Optional[int] = None, watchdog=None):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
@@ -160,6 +161,15 @@ class ServingLoop:
     to share/configure one. metrics_registry: the observe.MetricsRegistry
     this engine publishes through (None = a fresh per-engine registry, so
     replicas and tests stay isolated).
+    serve_port: opt-in fleet endpoints (observe/export.py) — an integer
+    starts a StatusServer on that port (0 = ephemeral, read
+    `self.status_server.port`) serving /metrics, /statusz, /traces and
+    /healthz over this engine's registry/Stats()/trace; the server stops
+    with Stop(). watchdog: stall watchdog (observe/watchdog.py) — True
+    builds a default StallWatchdog on this engine's registry, or pass a
+    configured StallWatchdog (capture logdir, injectable clock); the
+    engine heartbeats it per step and feeds it queue observations, and
+    /healthz runs its Check() at scrape time.
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -280,6 +290,21 @@ class ServingLoop:
     self._thread: Optional[threading.Thread] = None
     self._running = False
     self._seq_counter = 0
+    # stall watchdog: StepOnce heartbeats + queue observations feed it;
+    # the /healthz scrape thread (or a test) runs Check() — liveness must
+    # be evaluated on a thread a hung step loop can't take down
+    self.watchdog = None
+    if watchdog is not None and watchdog is not False:
+      self.watchdog = (watchdog
+                       if isinstance(watchdog, observe.StallWatchdog)
+                       else observe.StallWatchdog(self.metrics))
+    # fleet-facing endpoints, opt-in via serve_port (0 = ephemeral port)
+    self.status_server = None
+    if serve_port is not None:
+      self.status_server = observe.StatusServer(
+          serve_port, registry=self.metrics, name="serving",
+          statusz_fn=self.Stats, trace=self.trace,
+          watchdog=self.watchdog).Start()
 
   # -- path classification ---------------------------------------------------
 
@@ -353,6 +378,11 @@ class ServingLoop:
     if self._thread is not None:
       self._thread.join(timeout=timeout)
       self._thread = None
+    if self.status_server is not None:
+      self.status_server.Stop()
+      self.status_server = None
+    if self.watchdog is not None:
+      self.watchdog.Close()   # drop any still-armed flight recorder
 
   def Submit(self, prompt, max_new_tokens: Optional[int] = None,
              eos_id=_END, seed: Optional[int] = None,
@@ -383,6 +413,9 @@ class ServingLoop:
       self._handles[req_id] = handle
       if self.trace is not None:
         self.trace.Submit(req_id, len(req.prompt), req.max_new)
+      if self.watchdog is not None:
+        st = self.sched.Stats()
+        self.watchdog.ObserveQueue(st["queue_depth"], st["finished"])
       self._work.notify_all()
     return handle
 
@@ -405,6 +438,10 @@ class ServingLoop:
           return
         if not self.sched.HasWork():
           self._work.wait(timeout=0.05)
+          # no work is not a stall: refresh liveness so an idle replica
+          # keeps answering /healthz 200 past the no_heartbeat window
+          if self.watchdog is not None:
+            self.watchdog.Idle()
           continue
       self.StepOnce()
 
@@ -479,6 +516,7 @@ class ServingLoop:
         self._counters["quantized_steps"].Inc()
       self._PushEvents(events)
       self._TickProfile()
+      self._BeatWatchdog()
     return len(events)
 
   def _SpecCycle(self, vbatch, tables) -> int:
@@ -516,6 +554,7 @@ class ServingLoop:
             self.trace.Rollback(seq.id, rk - m)
       self._PushEvents(events)
       self._TickProfile()
+      self._BeatWatchdog()
     return len(events)
 
   def _PushEvents(self, events):
@@ -555,6 +594,15 @@ class ServingLoop:
     if self._profile_window is not None:
       if self._profile_window.StepDone():
         self._profile_window = None
+
+  def _BeatWatchdog(self):
+    """One step's liveness heartbeat + queue observation (caller holds
+    the lock). The watchdog's own lock nests strictly inside the engine
+    lock here; Check() runs lock-free of the engine on scrape threads."""
+    if self.watchdog is not None:
+      st = self.sched.Stats()
+      self.watchdog.ObserveQueue(st["queue_depth"], st["finished"])
+      self.watchdog.Beat()
 
   def ProfileSteps(self, logdir: str, steps: int = 5):
     """Arms a jax.profiler window covering the next `steps` engine steps;
@@ -618,5 +666,7 @@ class ServingLoop:
         stats["spec"] = self.spec.Describe()
       if self.trace is not None:
         stats["trace"] = self.trace.Stats()
+      if self.watchdog is not None:
+        stats["watchdog"] = self.watchdog.Stats()
       stats["compile"] = self._compile_log.Records()
     return stats

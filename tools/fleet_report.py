@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Fleet report: scrape N /statusz endpoints and print one merged view.
+
+Each serving engine / trainer process exposes /statusz when constructed
+with `serve_port` (lingvo_tpu/observe/export.py). This tool polls any
+number of them (observe/aggregate.py), merges the registry snapshots —
+counters sum, histogram buckets merge, gauges stay per-replica — and
+prints:
+
+- a fleet totals table (summed counters, merged-histogram p50/p99);
+- a per-replica gauge table (queue depth, active slots, config facts);
+- the least-loaded replica (the router's admission choice);
+- any unreachable replicas, each with its error.
+
+Usage:
+  python tools/fleet_report.py host1:8080 host2:8080 ...
+  python tools/fleet_report.py --json host1:8080 host2:8080
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from lingvo_tpu.observe import aggregate
+from lingvo_tpu.observe import metrics as metrics_lib
+
+
+def FleetReport(docs: dict) -> str:
+  """The human-readable report over {label: statusz doc (or error)}."""
+  lines = []
+  errors = {k: v["error"] for k, v in docs.items() if "error" in v}
+  live = {k: v for k, v in docs.items() if "snapshot" in v}
+  merged = aggregate.MergeStatusz(live)
+  lines.append(f"replicas: {len(live)} live, {len(errors)} unreachable")
+  for label, err in sorted(errors.items()):
+    lines.append(f"  DOWN {label}: {err}")
+  lines.append("")
+  lines.append("fleet totals (counters summed, histograms merged):")
+  for name in sorted(merged["fleet"]):
+    v = merged["fleet"][name]
+    if isinstance(v, dict):   # merged histogram: show count + quantiles
+      q = metrics_lib.HistogramQuantiles(v, qs=(0.5, 0.99))
+      lines.append(f"  {name:<44} n={v['count']:<8} "
+                   f"p50={q[0.5]:.4g} p99={q[0.99]:.4g}")
+    else:
+      lines.append(f"  {name:<44} {v}")
+  lines.append("")
+  lines.append("per-replica gauges:")
+  for label in merged["replicas"]:
+    lines.append(f"  [{label}]")
+    gauges = merged["per_replica"].get(label, {})
+    for name in sorted(gauges):
+      v = gauges[name]
+      if isinstance(v, (dict, list)):
+        continue   # structured values belong to the raw /statusz
+      lines.append(f"    {name:<42} {v}")
+  target = aggregate.LeastLoaded(live)
+  if target is not None:
+    lines.append("")
+    lines.append(f"least-loaded replica (scheduler/queue_depth): {target}")
+  return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+  argv = sys.argv[1:] if argv is None else argv
+  as_json = "--json" in argv
+  urls = [a for a in argv if not a.startswith("--")]
+  if not urls:
+    print(__doc__, file=sys.stderr)
+    return 2
+  docs = aggregate.ScrapeAll(urls)
+  if as_json:
+    out = {"merged": aggregate.MergeStatusz(docs),
+           "least_loaded": aggregate.LeastLoaded(docs),
+           "errors": {k: v["error"] for k, v in docs.items()
+                      if "error" in v}}
+    print(json.dumps(out, indent=1, default=str))
+  else:
+    print(FleetReport(docs))
+  # partial fleet visibility is still a report, but exit nonzero when
+  # NOTHING answered so cron/scripts notice a dead fleet
+  return 0 if any("snapshot" in v for v in docs.values()) else 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())

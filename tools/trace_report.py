@@ -11,8 +11,13 @@ key (ignored by trace viewers) and prints:
 - aggregate TTFT / TPOT / total-latency p50/p99;
 - a queue-wait histogram (how long requests sat before admission).
 
+With MULTIPLE trace files (one per serving replica) it prints a merged
+per-replica latency table instead — one row per file plus a fleet row
+computed over the union of requests.
+
 Usage:
   python tools/trace_report.py /tmp/serving_trace.json
+  python tools/trace_report.py /tmp/replica_a.json /tmp/replica_b.json
 """
 
 from __future__ import annotations
@@ -115,13 +120,53 @@ def Report(trace: dict) -> str:
   return "\n".join(lines)
 
 
+def MergedReport(traces: dict) -> str:
+  """Per-replica latency table over {label: trace dict} + a fleet row.
+
+  Each row is that replica's Summary(); the fleet row recomputes the
+  percentiles over the UNION of all requests (percentiles don't merge
+  from per-replica percentiles)."""
+  header = (f"{'replica':<24} {'reqs':>5} {'tokens':>7} "
+            f"{'ttft_p50':>9} {'ttft_p99':>9} {'tpot_p50':>9} "
+            f"{'tpot_p99':>9} {'total_p50':>10} {'total_p99':>10}")
+  lines = [header, "-" * len(header)]
+
+  def _Row(label, reqs):
+    ttft = _Percentiles([r.get("ttft_s") for r in reqs])
+    tpot = _Percentiles([r.get("tpot_s") for r in reqs])
+    total = _Percentiles([r.get("total_s") for r in reqs])
+
+    def _P(p, k):
+      return f"{p[k]:.2f}" if p.get("n") else "-"
+
+    return (f"{label:<24} {len(reqs):>5} "
+            f"{sum(r.get('tokens', 0) for r in reqs):>7} "
+            f"{_P(ttft, 'p50_ms'):>9} {_P(ttft, 'p99_ms'):>9} "
+            f"{_P(tpot, 'p50_ms'):>9} {_P(tpot, 'p99_ms'):>9} "
+            f"{_P(total, 'p50_ms'):>10} {_P(total, 'p99_ms'):>10}")
+
+  union = []
+  for label in sorted(traces):
+    reqs = list(traces[label]["perRequest"].values())
+    union.extend(reqs)
+    lines.append(_Row(label, reqs))
+  lines.append("-" * len(header))
+  lines.append(_Row("FLEET", union))
+  lines.append("")
+  lines.append("(latencies in ms; fleet percentiles computed over the "
+               "union of requests)")
+  return "\n".join(lines)
+
+
 def main(argv=None) -> int:
   argv = sys.argv[1:] if argv is None else argv
-  if len(argv) != 1:
+  if not argv:
     print(__doc__, file=sys.stderr)
     return 2
-  trace = LoadTrace(argv[0])
-  print(Report(trace))
+  if len(argv) == 1:
+    print(Report(LoadTrace(argv[0])))
+    return 0
+  print(MergedReport({path: LoadTrace(path) for path in argv}))
   return 0
 
 
