@@ -1147,6 +1147,127 @@ def _BenchQuantServing(jax, jnp, model_registry, on_tpu):
   }
 
 
+def _BenchPrefixCache(jax, jnp, model_registry, on_tpu):
+  """Global prefix cache win on a shared-system-prompt stream (ISSUE 14).
+
+  A seeded Poisson stream where 90% of requests open with the same
+  system prompt is played against two identical engines — prefix cache
+  ON vs OFF — at the SAME page pool, sized well below slots x
+  per-request footprint so admission concurrency is page-bound.
+  Acceptance keys: `prefill_tokens_ratio` (cache off/on prompt tokens
+  actually computed; the bar is >= 2x at 0.9 sharing), `slots_live_peak`
+  (the cache engine must admit STRICTLY more concurrently at fixed HBM,
+  because borrowed pages stop counting against the pool), and
+  `streams_identical` (greedy token streams byte-identical cache on vs
+  off — sharing may never shift a single token).
+  """
+  from lingvo_tpu.serving import engine as engine_lib
+
+  rng = np.random.RandomState(0)
+  if on_tpu:
+    n_req, b_slots, page, max_seq = 32, 8, 128, 1024
+    sys_len, t_lo, t_hi, o_lo, o_hi = 256, 32, 128, 32, 128
+    mean_gap_s = 0.005
+  else:
+    n_req, b_slots, page, max_seq = 16, 4, 8, 64
+    sys_len, t_lo, t_hi, o_lo, o_hi = 32, 4, 14, 8, 16
+    mean_gap_s = 0.005
+
+  mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  mp.task.use_rotary = True
+  if on_tpu:
+    mp.task.model_dim = 512
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 1024
+  else:
+    mp.task.model_dim = 256
+    mp.task.num_layers = 4
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 512
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  vocab = task.p.vocab_size
+
+  # 0.9 share fraction: most requests open with the same system prompt
+  sys_prompt = rng.randint(1, vocab, sys_len).astype(np.int32)
+  prompts = []
+  for i in range(n_req):
+    tail = rng.randint(1, vocab, rng.randint(t_lo, t_hi + 1)).astype(
+        np.int32)
+    if rng.rand() < 0.9:
+      prompts.append(np.concatenate([sys_prompt, tail]))
+    else:
+      prompts.append(tail)
+  max_news = rng.randint(o_lo, o_hi + 1, n_req)
+  arrivals = np.concatenate(
+      [[0.0], np.cumsum(rng.exponential(mean_gap_s, n_req - 1))])
+  total_useful = int(np.sum(max_news))
+
+  # page-bound pool: each shared-prompt request footprints ~full_pages
+  # pages; give the pool roughly half of slots x footprint so the OFF
+  # engine cannot fill its slots while the ON engine (whose borrowers are
+  # charged only their uncached remainder) can
+  full_pages = -(-(sys_len + t_hi + o_hi) // page)
+  num_pages = (b_slots * full_pages) // 2
+
+  def _Play(prefix_cache):
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=page, num_pages=num_pages,
+        max_batch=b_slots, max_seq_len=max_seq,
+        prefill_chunk=16 if on_tpu else 4,
+        prefix_cache=prefix_cache)
+    # warm both compile programs AND (on the cache engine) the tree, so
+    # the timed stream measures steady-state sharing, not cold-start
+    warm = np.zeros((1, sys_len), np.int32)
+    warm[0] = sys_prompt
+    eng.RunBatch(warm, np.array([sys_len], np.int32), 4)
+    eng.Start()
+    t0 = time.perf_counter()
+    handles = []
+    for i in range(n_req):
+      dt = t0 + arrivals[i] - time.perf_counter()
+      if dt > 0:
+        time.sleep(dt)
+      handles.append(eng.Submit(prompts[i], int(max_news[i])))
+    streams = [h.Result(timeout=1200) for h in handles]
+    wall = time.perf_counter() - t0
+    stats = eng.Stats()
+    eng.Stop()
+    return streams, wall, stats
+
+  s_off, wall_off, stats_off = _Play(None)
+  s_on, wall_on, stats_on = _Play(True)
+
+  pt_off = stats_off["prompt_tokens"]
+  pt_on = stats_on["prompt_tokens"]
+  peak_off = stats_off["scheduler"]["slots_live_peak"]
+  peak_on = stats_on["scheduler"]["slots_live_peak"]
+  return {
+      "requests": n_req,
+      "useful_tokens": total_useful,
+      "share_fraction": 0.9,
+      "system_prompt_tokens": sys_len,
+      "slots": b_slots,
+      "page_size": page,
+      "num_pages": num_pages,
+      "streams_identical": s_on == s_off,
+      "prefill_tokens": {"off": pt_off, "on": pt_on},
+      "prefill_tokens_ratio": round(pt_off / max(pt_on, 1), 3),
+      "slots_live_peak": {"off": peak_off, "on": peak_on},
+      "concurrency_strictly_higher": bool(peak_on > peak_off),
+      "kv_page_peak": {"off": stats_off["kv_pages"]["peak_in_use"],
+                       "on": stats_on["kv_pages"]["peak_in_use"]},
+      "prefix_cache": stats_on["prefix_cache"],
+      "off_engine": {"wall_s": round(wall_off, 3),
+                     "tokens_per_sec": round(total_useful / wall_off, 1)},
+      "on_engine": {"wall_s": round(wall_on, 3),
+                    "tokens_per_sec": round(total_useful / wall_on, 1)},
+  }
+
+
 def _BenchFusedXent(jax, jnp, model_registry, on_tpu):
   """Dense vs fused blockwise LM-head xent (ops/fused_xent.py): full
   train-step time and peak memory at vocab 32k / 128k.
@@ -1822,6 +1943,8 @@ def main():
        lambda: _BenchSpecDecode(jax, jnp, model_registry, on_tpu)),
       ("quant_serving",
        lambda: _BenchQuantServing(jax, jnp, model_registry, on_tpu)),
+      ("prefix_cache",
+       lambda: _BenchPrefixCache(jax, jnp, model_registry, on_tpu)),
       ("fused_xent",
        lambda: _BenchFusedXent(jax, jnp, model_registry, on_tpu)),
       ("input_pipeline",

@@ -27,6 +27,7 @@ ENGINE_COUNTER_KEYS = (
     "tokens_emitted", "prompt_tokens",
     "dense_fallback_steps", "quantized_steps",
     "spec_cycles", "draft_tokens", "accepted_tokens",
+    "prefix_hit_tokens",
 )
 
 # Static engine configuration facts (set once at construction).
@@ -36,7 +37,7 @@ ENGINE_INFO_KEYS = (
 )
 
 # Nested sub-dict sections always present in Stats().
-ENGINE_SECTION_KEYS = ("scheduler", "kv_pages", "mixers")
+ENGINE_SECTION_KEYS = ("scheduler", "kv_pages", "mixers", "prefix_cache")
 
 # Keys every engine Stats() dict must carry.
 ENGINE_STATS_REQUIRED = frozenset(
@@ -60,6 +61,12 @@ def ValidateEngineStats(stats: dict) -> dict:
   assert not missing, f"engine Stats() missing schema keys: {sorted(missing)}"
   unknown = keys - ENGINE_STATS_REQUIRED - ENGINE_STATS_OPTIONAL
   assert not unknown, f"engine Stats() keys not in schema: {sorted(unknown)}"
+  pc = set(stats["prefix_cache"])
+  assert pc == PREFIX_CACHE_STATS_KEYS, (
+      f"prefix_cache section keys drifted from schema: {sorted(pc)}")
+  kv = set(stats["kv_pages"])
+  assert KV_PAGES_REQUIRED <= kv, (
+      f"kv_pages section missing keys: {sorted(KV_PAGES_REQUIRED - kv)}")
   return stats
 
 
@@ -74,6 +81,7 @@ GSHARD_TELEMETRY_KEYS = (
     "decode_state_bytes_per_seq",
     "kv_cache_dtype", "kv_bytes_per_token", "serve_int8_weights",
     "draft_tokens", "accepted_tokens", "accepted_len_hist",
+    "prefix_hit_tokens", "prefix_cache",
 )
 
 # Keys both serving surfaces advertise (values must mean the same thing).
@@ -109,7 +117,7 @@ def TelemetryFromRegistry(registry, prefix: str = "serving/") -> dict:
 
 # serving/scheduler.py Scheduler.Stats()
 SCHEDULER_STATS_KEYS = frozenset({
-    "slots", "slots_live", "slots_prefill", "queue_depth",
+    "slots", "slots_live", "slots_prefill", "slots_live_peak", "queue_depth",
     "admitted", "finished", "cancelled", "rejected_overlong",
     "needs_kv_pages",
 })
@@ -118,9 +126,25 @@ SCHEDULER_STATS_KEYS = frozenset({
 # when the engine priced the pool via its KV census)
 KV_PAGES_REQUIRED = frozenset({
     "num_pages", "page_size", "in_use", "free", "utilization",
-    "peak_in_use", "num_sequences", "rolled_back_tokens",
+    "peak_in_use", "num_sequences", "rolled_back_tokens", "shared_pages",
 })
 KV_PAGES_OPTIONAL = frozenset({"page_bytes", "pool_bytes"})
+
+# serving/prefix_cache.py PrefixCache.Stats() — present on BOTH serving
+# surfaces (engine Stats() section + GShardDecode telemetry key); surfaces
+# without a cache report DisabledPrefixCacheStats().
+PREFIX_CACHE_STATS_KEYS = frozenset({
+    "enabled", "hits", "misses", "hit_tokens", "evictions", "cow_copies",
+    "cached_pages", "cached_tokens",
+})
+
+
+def DisabledPrefixCacheStats() -> dict:
+  """The prefix_cache section a surface WITHOUT a cache reports — same
+  key set, all-zero counters, enabled=False."""
+  out = {k: 0 for k in sorted(PREFIX_CACHE_STATS_KEYS)}
+  out["enabled"] = False
+  return out
 
 # observe/trace.py TraceRecorder.Stats()
 TRACE_STATS_KEYS = frozenset({

@@ -47,7 +47,7 @@ class RequestTrace:
       "submit_ts", "admit_ts", "first_token_ts", "last_token_ts",
       "retire_ts", "finish_reason", "tokens", "prefill_chunks",
       "prefill_tokens", "spec_cycles", "draft_tokens", "accepted_tokens",
-      "rolled_back_tokens",
+      "rolled_back_tokens", "prefix_hit_tokens",
   )
 
   def __init__(self, req_id):
@@ -69,6 +69,7 @@ class RequestTrace:
     self.draft_tokens = 0
     self.accepted_tokens = 0
     self.rolled_back_tokens = 0
+    self.prefix_hit_tokens = 0
 
   @property
   def complete(self) -> bool:
@@ -108,6 +109,8 @@ class RequestTrace:
       out["accepted_tokens"] = self.accepted_tokens
       out["spec_acceptance"] = self.accepted_tokens / self.draft_tokens
       out["rolled_back_tokens"] = self.rolled_back_tokens
+    if self.prefix_hit_tokens:
+      out["prefix_hit_tokens"] = self.prefix_hit_tokens
     return out
 
 
@@ -120,8 +123,8 @@ class TraceRecorder:
   """
 
   # event kind -> record update, dispatched in Emit
-  KINDS = ("submit", "admit", "prefill_chunk", "token", "spec_verify",
-           "rollback", "retire")
+  KINDS = ("submit", "prefix_hit", "admit", "prefill_chunk", "token",
+           "spec_verify", "rollback", "retire")
 
   def __init__(self, capacity: int = 8192, completed_capacity: int = 4096,
                clock=time.perf_counter):
@@ -140,9 +143,10 @@ class TraceRecorder:
   def Emit(self, kind: str, req_id, a: int = 0, b: int = 0,
            reason: Optional[str] = None):
     """Records one event. (a, b) are kind-specific small ints:
-    submit(prompt_tokens, max_new) · admit(slot, pages) ·
-    prefill_chunk(tokens) · token(n) · spec_verify(drafted, accepted) ·
-    rollback(tokens) · retire(pages_freed) + reason."""
+    submit(prompt_tokens, max_new) · prefix_hit(tokens) ·
+    admit(slot, pages) · prefill_chunk(tokens) · token(n) ·
+    spec_verify(drafted, accepted) · rollback(tokens) ·
+    retire(pages_freed) + reason."""
     ts = self._clock()
     with self._lock:
       self._ring.append((ts, kind, req_id, a, b, reason))
@@ -156,6 +160,8 @@ class TraceRecorder:
         rec.submit_ts = ts
         rec.prompt_tokens = a
         rec.max_new = b
+      elif kind == "prefix_hit":
+        rec.prefix_hit_tokens += a
       elif kind == "admit":
         rec.admit_ts = ts
         rec.slot = a
@@ -183,6 +189,11 @@ class TraceRecorder:
   # convenience emitters (one per lifecycle kind)
   def Submit(self, req_id, prompt_tokens: int = 0, max_new: int = 0):
     self.Emit("submit", req_id, prompt_tokens, max_new)
+
+  def PrefixHit(self, req_id, tokens: int):
+    """Prompt tokens served from the prefix cache (between submit and
+    admit: the hit is resolved during the admission the request wins)."""
+    self.Emit("prefix_hit", req_id, tokens)
 
   def Admit(self, req_id, slot: int, pages: int = 0):
     self.Emit("admit", req_id, slot, pages)

@@ -299,6 +299,10 @@ class GShardDecode:
         draft_tokens=0,
         accepted_tokens=0,
         accepted_len_hist=[],
+        # prefix-cache telemetry, same mirroring contract: the batch-
+        # synchronous driver re-prefills every prompt, so no cache exists
+        prefix_hit_tokens=0,
+        prefix_cache=observe_schema.DisabledPrefixCacheStats(),
     ))
     self._decodes.Inc()
     # the dict every result record carries is rebuilt FROM the registry —

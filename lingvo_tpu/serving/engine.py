@@ -71,6 +71,7 @@ from lingvo_tpu.observe import schema as observe_schema
 from lingvo_tpu.quant import kv as kv_quant
 from lingvo_tpu.quant import weights as quant_weights
 from lingvo_tpu.serving import kv_cache
+from lingvo_tpu.serving import prefix_cache as prefix_cache_lib
 from lingvo_tpu.serving import scheduler as scheduler_lib
 from lingvo_tpu.serving import spec_decode
 
@@ -137,7 +138,7 @@ class ServingLoop:
                temperature: float = 0.0, top_k: int = 0,
                sample_seed: int = 0, kv_cache_dtype: Optional[str] = None,
                serve_int8_weights: bool = False, spec=None,
-               trace=True, metrics_registry=None,
+               prefix_cache=None, trace=True, metrics_registry=None,
                serve_port: Optional[int] = None, watchdog=None):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
@@ -155,6 +156,13 @@ class ServingLoop:
     `spec_decode.SelfDraft` (early-exit over the same theta) or
     `spec_decode.ModelDraft` (independent pageless draft model). None
     keeps the exact two-program legacy engine.
+    prefix_cache: cross-request KV prefix sharing
+    (serving/prefix_cache.py) — None (default) keeps the bit-exact
+    legacy admission path, True builds a fresh PrefixCache over this
+    engine's pool, or pass a PrefixCache instance (rebound via Bind —
+    a cache built against a different pool or kv dtype is invalidated,
+    never cross-shared). Requires an attention-only stack: O(1)-state
+    mixers carry recurrent state the cache can neither share nor skip.
     trace: per-request lifecycle tracing (observe/trace.py) — True (the
     default; overhead is bounded by the bench's observability section)
     builds a fresh TraceRecorder, False disables, or pass a TraceRecorder
@@ -203,10 +211,25 @@ class ServingLoop:
     if self.mixers["num_ssm"] > 0:
       self.state_pool = kv_cache.StateSlotPool(
           max_batch, self.mixers["decode_state_bytes_per_slot"])
+    # global prefix cache: opt-in KV page sharing across requests. Gated
+    # to attention-only stacks — an SSM/hybrid row's recurrent state must
+    # replay EVERY prompt token, so skipping cached prefill would decode
+    # against wrong state (and the state itself is per-slot, unshareable).
+    self.prefix_cache = None
+    if prefix_cache is not None and prefix_cache is not False:
+      if self.mixers["num_attention"] == 0 or self.mixers["num_ssm"] > 0:
+        raise ValueError(
+            "prefix_cache requires an attention-only stack: O(1)-state "
+            f"mixers (census {self.mixers}) carry recurrent state that "
+            "cannot be shared across requests or skipped by cached prefill")
+      self.prefix_cache = (
+          prefix_cache if isinstance(prefix_cache, prefix_cache_lib.PrefixCache)
+          else prefix_cache_lib.PrefixCache())
+      self.prefix_cache.Bind(self.alloc, self.kv_cache_dtype)
     self.sched = scheduler_lib.Scheduler(
         max_batch, self.alloc, table_pages, prefill_chunk,
         needs_kv_pages=self.mixers["num_attention"] > 0,
-        state_pool=self.state_pool)
+        state_pool=self.state_pool, prefix_cache=self.prefix_cache)
     # pool page num_pages (the +1) is the trash page padding writes hit;
     # num_slots sizes the per-slot O(1) mixer states (attention ignores it);
     # the kv dtype override is a static string arg (hashable)
@@ -235,6 +258,10 @@ class ServingLoop:
       return jnp.stack(cols, axis=1), states
 
     self._step_fn = jax.jit(_Step, donate_argnums=donate)
+    # copy-on-write executor: one jitted page copy across every page-pool
+    # leaf of the decode state (compiled once; src/dst are traced scalars)
+    self._cow_fn = (self._BuildCowFn(task, theta, kv_cache_dtype)
+                    if self.prefix_cache is not None else None)
     # observability (observe/): per-engine metrics registry, per-request
     # lifecycle trace, and one-shot compile records for the step programs
     self.metrics = (metrics_registry if metrics_registry is not None
@@ -276,6 +303,10 @@ class ServingLoop:
         self.serve_int8_weights)
     self.metrics.SectionFn("scheduler", self.sched.Stats)
     self.metrics.SectionFn("kv_pages", self.alloc.Stats)
+    self.metrics.SectionFn(
+        "prefix_cache",
+        self.prefix_cache.Stats if self.prefix_cache is not None
+        else observe_schema.DisabledPrefixCacheStats)
     if self.state_pool is not None:
       self.metrics.SectionFn("state_slots", self.state_pool.Stats)
     if self.trace is not None:
@@ -340,6 +371,74 @@ class ServingLoop:
       suffix = ""
     base = "pallas" if jax.default_backend() == "tpu" else "xla"
     return base + suffix
+
+  # -- prefix-cache support --------------------------------------------------
+
+  def _BuildCowFn(self, task, theta, kv_cache_dtype):
+    """Jits a whole-page device copy `states, src, dst -> states`.
+
+    Which decode-state leaves are page pools (and which axis pages them)
+    is detected STRUCTURALLY: abstract-eval InitPagedDecodeState at two
+    pool sizes and diff the leaf shapes — the axis that grew is the page
+    axis. That handles every layout uniformly: flat stacks page axis 0,
+    repeat-stacked layers page axis 1 (leaves carry a leading reps axis),
+    int8 K/V plus their f32 scale sidecars each get their own leaf, and
+    O(1)-mixer state leaves (shape-independent of the pool) are left
+    untouched."""
+    def _Shapes(np_total):
+      return jax.eval_shape(
+          lambda th: task.InitPagedDecodeState(
+              th, np_total, self.page_size, self.max_batch, kv_cache_dtype),
+          theta)
+
+    a = jax.tree_util.tree_leaves(_Shapes(self.num_pages + 1))
+    b = jax.tree_util.tree_leaves(_Shapes(self.num_pages + 2))
+    axes = []
+    for la, lb in zip(a, b):
+      diff = [i for i, (x, y) in enumerate(zip(la.shape, lb.shape))
+              if x != y]
+      assert len(diff) <= 1, (la.shape, lb.shape)
+      axes.append(diff[0] if diff else None)
+
+    def _CopyPage(states, src, dst):
+      leaves, treedef = jax.tree_util.tree_flatten(states)
+      assert len(leaves) == len(axes), (len(leaves), len(axes))
+      out = []
+      for leaf, ax in zip(leaves, axes):
+        if ax is None:
+          out.append(leaf)
+        else:
+          row = jnp.take(leaf, src, axis=ax)
+          out.append(leaf.at[(slice(None),) * ax + (dst,)].set(row))
+      return jax.tree_util.tree_unflatten(treedef, out)
+
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(_CopyPage, donate_argnums=donate)
+
+  def _RunCow(self, admitted):
+    """Executes pending copy-on-write page splits for freshly admitted
+    sequences (caller holds the lock; the loop thread owns _states)."""
+    for seq in admitted:
+      for src, dst in seq.cow_pairs:
+        self._states = self._cow_fn(self._states,
+                                    jnp.asarray(src, jnp.int32),
+                                    jnp.asarray(dst, jnp.int32))
+      seq.cow_pairs = []
+
+  def UpdateTheta(self, theta):
+    """Hot-swaps the served checkpoint and invalidates the prefix cache
+    (every cached page holds K/V computed under the OLD theta — serving
+    it to new requests would silently mix checkpoints). In-flight
+    sequences continue under the new theta, as with any mid-serving
+    swap; a ModelDraft's independent draft theta is not touched (stale
+    drafts cost acceptance rate, never correctness — every proposal is
+    verified against the live theta)."""
+    with self._lock:
+      if self.serve_int8_weights:
+        theta, _ = quant_weights.Int8ServingTheta(theta)
+      self._theta = theta
+      if self.prefix_cache is not None:
+        self.prefix_cache.Invalidate()
 
   # -- async API -------------------------------------------------------------
 
@@ -467,8 +566,15 @@ class ServingLoop:
           except KeyError:
             pages = 0
         self._pages_of[seq.id] = pages
+        if seq.reused_tokens > 0:
+          self._counters["prefix_hit_tokens"].Inc(seq.reused_tokens)
+          if self.trace is not None:
+            self.trace.PrefixHit(seq.id, seq.reused_tokens)
         if self.trace is not None:
           self.trace.Admit(seq.id, seq.slot, pages)
+      if self.prefix_cache is not None and admitted:
+        # split shared pages the new rows will write into BEFORE any step
+        self._RunCow(admitted)
       vbatch = None
       if self.spec is not None:
         vbatch = self.sched.BuildVerifyStep(self.spec.k)
@@ -656,6 +762,9 @@ class ServingLoop:
       stats["scheduler"] = self.sched.Stats()
       stats["kv_pages"] = self.alloc.Stats()
       stats["mixers"] = dict(self.mixers)
+      stats["prefix_cache"] = (
+          self.prefix_cache.Stats() if self.prefix_cache is not None
+          else observe_schema.DisabledPrefixCacheStats())
       if self.state_pool is not None:
         stats["state_slots"] = self.state_pool.Stats()
       # acceptance telemetry: hist[m] = verify rows whose accepted draft

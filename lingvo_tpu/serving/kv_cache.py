@@ -13,6 +13,21 @@ the pool — eviction "defragments" by construction (freed high pages sink
 to the back of the heap and are reused last), so a long-running server's
 working set stays dense without ever copying K/V between pages.
 
+Prefix sharing (serving/prefix_cache.py) adds REFCOUNTS on top: a page
+may be referenced by several sequences (a shared system-prompt prefix)
+and/or by the prefix cache itself. `Allocate` grants exclusive pages
+(refcount 1); `Share` lets a second owner borrow pages already resident;
+`Retain`/`Release` are the cache's ownerless references. `Free` only
+DECREMENTS — a page returns to the free heap exactly when its last
+reference drops, which preserves both standing contracts: `Allocate`
+stays all-or-nothing over the free heap, and reclaimed pages re-enter
+the same min-heap (lowest-first defrag by construction). `CopyOnWrite`
+is the write-hazard escape hatch: before a sequence writes into a page
+it does not exclusively own, the scheduler swaps in a fresh private page
+(the engine copies the bytes device-side); `AssertExclusive` makes any
+missed hazard — including a speculative-decoding rollback rewrite — a
+loud failure instead of silent cross-request corruption.
+
 O(1)-state mixers (core/ssm.py) need a second, much simpler resource:
 `StateSlotPool`. An SSM layer's decode state is a fixed [B, N, H, S]
 array — one constant-size matrix per batch row, no growth with sequence
@@ -50,6 +65,9 @@ class PageAllocator:
     self.page_bytes = int(page_bytes)
     self._free = list(range(num_pages))  # already a valid min-heap
     self._owned: dict[object, list[int]] = {}
+    # page -> reference count (sequence owners + cache retains). Absent
+    # means free. Pages return to the heap only when this hits 0.
+    self._ref: dict[int, int] = {}
     self.peak_in_use = 0
     # speculative-decoding rollback accounting: token slots that were
     # written by a verify step and then rejected. Rollback is pure cursor
@@ -80,6 +98,35 @@ class PageAllocator:
     """The sequence's pages in logical order (index i = logical page i)."""
     return list(self._owned[seq_id])
 
+  def RefCount(self, page: int) -> int:
+    """References on `page` (0 = free)."""
+    return self._ref.get(page, 0)
+
+  @property
+  def shared_pages(self) -> int:
+    """Pages currently referenced more than once (the sharing win)."""
+    return sum(1 for r in self._ref.values() if r >= 2)
+
+  def AssertExclusive(self, seq_id, start_token: int, num_tokens: int):
+    """Write-hazard guard: every page covering logical token slots
+    [start_token, start_token + num_tokens) must be referenced ONLY by
+    seq_id. A device write (including a speculative verify step whose
+    rejected tail will be re-written after rollback) to a page another
+    sequence or the prefix cache references would corrupt their streams;
+    copy-on-write at admission is supposed to make this impossible."""
+    if num_tokens <= 0:
+      return
+    pages = self._owned[seq_id]
+    lo = start_token // self.page_size
+    hi = (start_token + num_tokens - 1) // self.page_size
+    for idx in range(lo, min(hi, len(pages) - 1) + 1):
+      pg = pages[idx]
+      assert self._ref.get(pg, 0) == 1, (
+          f"seq {seq_id!r} writing tokens [{start_token}, "
+          f"{start_token + num_tokens}) would touch page {pg} (logical "
+          f"{idx}) with refcount {self._ref.get(pg, 0)} — shared pages "
+          f"must be copy-on-write'd before any write")
+
   def Stats(self) -> dict:
     out = {
         "num_pages": self.num_pages,
@@ -90,6 +137,7 @@ class PageAllocator:
         "peak_in_use": self.peak_in_use,
         "num_sequences": len(self._owned),
         "rolled_back_tokens": self.rolled_back_tokens,
+        "shared_pages": self.shared_pages,
     }
     if self.page_bytes:
       out["page_bytes"] = self.page_bytes
@@ -107,23 +155,73 @@ class PageAllocator:
     if n > len(self._free):
       raise OutOfPages(f"need {n} pages, {len(self._free)} free")
     got = [heapq.heappop(self._free) for _ in range(n)]
+    for pg in got:
+      self._ref[pg] = 1
     self._owned.setdefault(seq_id, []).extend(got)
     self.peak_in_use = max(self.peak_in_use, self.num_in_use)
     return got
+
+  def Share(self, seq_id, pages: list[int]):
+    """Appends already-resident `pages` to seq_id's logical order, adding
+    one reference each. The free heap is untouched — sharing is how a
+    request's footprint stops counting against the pool."""
+    if not pages:
+      return
+    for pg in pages:
+      assert self._ref.get(pg, 0) >= 1, f"cannot share free page {pg}"
+      self._ref[pg] += 1
+    self._owned.setdefault(seq_id, []).extend(pages)
+
+  def Retain(self, page: int):
+    """Adds an ownerless reference (the prefix cache holding a page alive
+    past its writer's retirement)."""
+    assert self._ref.get(page, 0) >= 1, f"cannot retain free page {page}"
+    self._ref[page] += 1
+
+  def Release(self, page: int):
+    """Drops one ownerless reference (cache eviction/invalidation)."""
+    self._DecRef(page)
+
+  def CopyOnWrite(self, seq_id, logical_idx: int):
+    """Replaces seq_id's shared logical page with a fresh private one.
+
+    Returns (old_page, new_page) for the engine to copy device-side, or
+    None when the page is already exclusive. All-or-nothing like Allocate:
+    raises OutOfPages without side effects when the pool is empty."""
+    pages = self._owned[seq_id]
+    old = pages[logical_idx]
+    if self._ref.get(old, 0) == 1:
+      return None
+    (new,) = self.Allocate(seq_id, 1)
+    self._owned[seq_id].pop()        # Allocate appended; splice in place
+    pages[logical_idx] = new
+    self._DecRef(old)
+    return (old, new)
 
   def NoteRollback(self, num_tokens: int):
     """Records num_tokens rejected verify-step writes (cursor rollback)."""
     assert num_tokens >= 0, num_tokens
     self.rolled_back_tokens += int(num_tokens)
 
+  def _DecRef(self, page: int):
+    r = self._ref.get(page, 0)
+    assert r >= 1, f"double free of page {page}"
+    if r == 1:
+      del self._ref[page]
+      heapq.heappush(self._free, page)
+    else:
+      self._ref[page] = r - 1
+
   def Free(self, seq_id) -> int:
-    """Returns every page owned by seq_id to the pool; returns the count.
+    """Drops seq_id's reference on every page it holds; returns the count
+    of pages released (pages shared with other owners survive — they
+    return to the pool when the LAST reference drops).
 
     Idempotent: freeing an unknown/already-freed id is a no-op (eviction
     and cancellation can race to the same sequence at a step boundary)."""
     pages = self._owned.pop(seq_id, [])
     for pg in pages:
-      heapq.heappush(self._free, pg)
+      self._DecRef(pg)
     return len(pages)
 
 

@@ -1,0 +1,246 @@
+"""Global prefix cache: cross-request KV page sharing with CoW admission.
+
+Millions of requests carrying the same system prompt should pay its
+prefill ONCE. The block-table indirection of paged serving already lets
+two sequences' tables point at the same physical page (the kernels were
+proven alias-tolerant by the hostile stale-table test in PR 6), so all a
+prefix cache needs is host-side bookkeeping:
+
+- a **radix/prefix tree with one node per FULL page**, keyed by the
+  page's page_size token-id chunk. A path from the root spells a
+  page-aligned token prefix; each node maps its chunk to the resident
+  physical page holding that chunk's K/V. Only COMPLETE pages are ever
+  cached — a partially-filled tail page is private to its writer, which
+  is what makes the sharing story simple: divergence inside a page can
+  only happen on a page the cache never handed out (plus the one
+  full-cover case the scheduler copy-on-writes, below).
+- **refcounts on the PageAllocator** (kv_cache.py): the cache holds one
+  `Retain` reference per node, each borrowing sequence holds one `Share`
+  reference, and a page is physically reclaimed only when the last
+  reference drops. Cached-but-unreferenced pages (refcount 1, cache
+  only) are exactly the evictable set.
+- **LRU eviction under pool pressure**: when admission cannot reserve a
+  request's uncached remainder, the scheduler asks the cache to release
+  least-recently-probed unreferenced pages. Nodes with live borrowers
+  are never evicted (their refcount > 1); evicting a node orphans its
+  subtree's deeper nodes, so eviction walks leaves-first.
+- **invalidation**: a checkpoint/theta swap makes every cached page
+  stale (`Invalidate()` drops the whole tree), and pools of different
+  kv_cache_dtype must never cross-share (`Bind` invalidates on dtype or
+  allocator mismatch — an int8 page is bytes-incompatible with a bf16
+  probe even if the token chunk matches).
+
+The one write-into-shared-page case: when a probe covers the WHOLE
+prompt, prefill must still recompute the last prompt token to produce
+first-token logits, and that write lands in the final matched page. The
+scheduler copy-on-writes that page at admission (allocator.CopyOnWrite),
+so device writes NEVER touch a page with refcount > 1 — an invariant
+`PageAllocator.AssertExclusive` checks on every step build, which is
+also what keeps speculative-decoding rollback (a cursor rewind + rewrite
+of the same slots) safe against sharing.
+
+Thread safety: like the allocator/scheduler, this is plain host state
+serialized by the engine's scheduler lock.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lingvo_tpu.serving import kv_cache
+
+
+class _Node:
+  """One cached full page: `chunk` (page_size token tuple) -> `page`."""
+
+  __slots__ = ("chunk", "page", "parent", "children", "last_used")
+
+  def __init__(self, chunk, page, parent):
+    self.chunk = chunk
+    self.page = page
+    self.parent = parent
+    self.children: dict = {}
+    self.last_used = 0
+
+
+class PrefixCache:
+  """Page-granular radix tree over one engine's page pool.
+
+  max_pages: cap on pages the cache may retain (None = bounded only by
+  the pool; eviction then happens purely under admission pressure).
+  kv_cache_dtype: the pool's effective KV dtype — recorded so `Bind`
+  can refuse to carry entries across pools that disagree.
+  """
+
+  def __init__(self, allocator: Optional[kv_cache.PageAllocator] = None,
+               kv_cache_dtype: Optional[str] = None,
+               max_pages: Optional[int] = None):
+    self.alloc = allocator
+    self.kv_cache_dtype = kv_cache_dtype
+    self.max_pages = max_pages
+    self._root = _Node(None, None, None)
+    self._nodes: dict[int, _Node] = {}   # page -> node (eviction walk)
+    self._tick = 0                       # monotonic LRU clock
+    # counters surfaced via Stats() -> prefix_cache/* registry section
+    self.hits = 0
+    self.misses = 0
+    self.hit_tokens = 0
+    self.evictions = 0
+    self.cow_copies = 0
+
+  # -- binding / invalidation -------------------------------------------------
+
+  def Bind(self, allocator: kv_cache.PageAllocator,
+           kv_cache_dtype: Optional[str]):
+    """Attaches the cache to an engine's pool. A cache built against a
+    different allocator or kv dtype is invalidated first: page ids are
+    meaningless across pools, and int8 vs bf16 pages never cross-share."""
+    if self.alloc is not allocator or self.kv_cache_dtype != kv_cache_dtype:
+      self.Invalidate()
+    self.alloc = allocator
+    self.kv_cache_dtype = kv_cache_dtype
+    return self
+
+  def Invalidate(self) -> int:
+    """Drops every cached page (checkpoint/theta swap: all K/V is stale).
+    Borrowing sequences keep their references — their pages just stop
+    being offered to new requests. Returns pages released."""
+    n = len(self._nodes)
+    if self.alloc is not None:
+      for page in self._nodes:
+        self.alloc.Release(page)
+    self.evictions += n
+    self._root = _Node(None, None, None)
+    self._nodes = {}
+    return n
+
+  # -- queries ----------------------------------------------------------------
+
+  @property
+  def cached_pages(self) -> int:
+    return len(self._nodes)
+
+  def _Chunks(self, prompt):
+    ps = self.alloc.page_size
+    for i in range(len(prompt) // ps):
+      yield tuple(prompt[i * ps:(i + 1) * ps])
+
+  def _Walk(self, prompt, touch: bool):
+    node, pages = self._root, []
+    for chunk in self._Chunks(prompt):
+      child = node.children.get(chunk)
+      if child is None:
+        break
+      if touch:
+        self._tick += 1
+        child.last_used = self._tick
+      pages.append(child.page)
+      node = child
+    return pages
+
+  def PeekHitTokens(self, prompt) -> int:
+    """Reusable-token count a Probe would return — no counters, no LRU
+    touch (Submit-time introspection)."""
+    matched = len(self._Walk(prompt, touch=False)) * self.alloc.page_size
+    return min(matched, len(prompt) - 1) if matched else 0
+
+  def Probe(self, prompt) -> tuple[list[int], int]:
+    """Longest cached page-aligned prefix of `prompt` — PURE: no counters,
+    no LRU touch. Admission may probe the same queued request every
+    engine step while the pool is full; only the probe that turns into an
+    admission counts (NoteAdmitted).
+
+    Returns (pages, matched_tokens) where pages[i] holds prompt tokens
+    [i*page_size, (i+1)*page_size)."""
+    pages = self._Walk(prompt, touch=False)
+    return pages, len(pages) * self.alloc.page_size
+
+  def NoteAdmitted(self, prompt, matched_tokens: int):
+    """Records one admission's cache outcome: a hit when any page
+    matched (LRU-touching the matched path), else a miss. hit_tokens
+    counts tokens whose prefill is actually SKIPPED — min(matched,
+    len(prompt) - 1), since a full-cover match still recomputes the last
+    prompt token for its logits."""
+    if matched_tokens > 0:
+      self._Walk(prompt, touch=True)
+      self.hits += 1
+      self.hit_tokens += min(matched_tokens, len(prompt) - 1)
+    else:
+      self.misses += 1
+
+  # -- mutations --------------------------------------------------------------
+
+  def Insert(self, prompt, pages: list[int]):
+    """Caches `prompt`'s full-page prefix: pages[i] must hold the i-th
+    page_size chunk (the scheduler passes the sequence's own pages right
+    after prefill completes). Existing nodes win — the first writer's
+    page stays canonical and later identical prefixes share it; only
+    chunks not yet present retain new pages. Respects max_pages by
+    evicting LRU unreferenced pages first and stopping (prefix-complete)
+    when room runs out."""
+    node = self._root
+    for i, chunk in enumerate(self._Chunks(prompt)):
+      if i >= len(pages):
+        break
+      child = node.children.get(chunk)
+      if child is None:
+        if self.max_pages is not None and len(self._nodes) >= self.max_pages:
+          if self.EvictLru(len(self._nodes) - self.max_pages + 1) == 0:
+            break
+        page = pages[i]
+        if page in self._nodes:
+          break   # page already caches a different chunk (stale insert)
+        self.alloc.Retain(page)
+        child = _Node(chunk, page, node)
+        node.children[chunk] = child
+        self._nodes[page] = child
+      self._tick += 1
+      child.last_used = self._tick
+      node = child
+
+  def EvictLru(self, n: int) -> int:
+    """Releases up to n least-recently-used UNREFERENCED cached pages
+    (refcount 1: cache-only — pages some sequence still borrows are
+    pinned by their refcount). Evicts leaves-first so the tree never
+    holds a child whose parent is gone; an inner node only becomes
+    evictable once its subtree is. Returns pages released."""
+    released = 0
+    while released < n:
+      victims = [nd for nd in self._nodes.values()
+                 if not nd.children and self.alloc.RefCount(nd.page) == 1]
+      if not victims:
+        break
+      victims.sort(key=lambda nd: nd.last_used)
+      for nd in victims:
+        if released >= n:
+          break
+        self.alloc.Release(nd.page)
+        del self._nodes[nd.page]
+        del nd.parent.children[nd.chunk]
+        released += 1
+        self.evictions += 1
+    return released
+
+  def EvictForPressure(self, shortfall: int) -> int:
+    """Admission pressure valve: frees up to `shortfall` pages back to
+    the pool. No-op for shortfall <= 0."""
+    return self.EvictLru(shortfall) if shortfall > 0 else 0
+
+  def NoteCow(self):
+    """One copy-on-write page split performed on behalf of this cache."""
+    self.cow_copies += 1
+
+  # -- introspection ----------------------------------------------------------
+
+  def Stats(self) -> dict:
+    ps = self.alloc.page_size if self.alloc is not None else 0
+    return {
+        "enabled": True,
+        "hits": self.hits,
+        "misses": self.misses,
+        "hit_tokens": self.hit_tokens,
+        "evictions": self.evictions,
+        "cow_copies": self.cow_copies,
+        "cached_pages": self.cached_pages,
+        "cached_tokens": self.cached_pages * ps,
+    }

@@ -9,7 +9,14 @@ iteration is admit → build → (device step) → commit:
   page_size) pages) up front. Reserve-all-on-admission means an admitted
   sequence can never run out of pages mid-flight, so there is no
   preemption/swap machinery — pool pressure shows up only as queueing
-  (the allocator-exhaustion satellite: graceful, never a crash).
+  (the allocator-exhaustion satellite: graceful, never a crash). With a
+  prefix cache attached (serving/prefix_cache.py), admission first
+  probes the cache: matched full-page prefixes are BORROWED (refcount
+  shares, not fresh pages), only the uncached remainder is charged to
+  the pool — so shared pages stop counting against the reservation,
+  which is the concurrency jump — and prefill starts at the first
+  uncached token. A match covering the whole prompt copy-on-writes its
+  final page, because prefill must recompute the last prompt token.
 - `BuildStep` flattens the live slots into one batch for the compiled
   PagedStep program. Steady state is a pure decode step (chunk width
   C == 1, every live row feeds its last sampled token). Whenever any slot
@@ -93,6 +100,11 @@ class Sequence:
     # committed tokens an independent draft model's recurrent state has
     # consumed so far (speculative decoding only; engine-maintained)
     self.draft_pos = 0
+    # prefix-cache admission results: prompt tokens whose prefill was
+    # skipped (seq.pos starts there), and (src, dst) physical page pairs
+    # the engine must copy device-side before this sequence's first step
+    self.reused_tokens = 0
+    self.cow_pairs: list[tuple[int, int]] = []
 
   @property
   def id(self):
@@ -131,7 +143,8 @@ class Scheduler:
   def __init__(self, max_slots: int, allocator: kv_cache.PageAllocator,
                table_pages: int, prefill_chunk: int,
                needs_kv_pages: bool = True,
-               state_pool: Optional[kv_cache.StateSlotPool] = None):
+               state_pool: Optional[kv_cache.StateSlotPool] = None,
+               prefix_cache=None):
     """table_pages: block-table width (pages per sequence) — the static
     max_seq_len / page_size bound every compiled program carries.
     prefill_chunk: prompt tokens a prefilling row consumes per mixed step.
@@ -139,6 +152,9 @@ class Scheduler:
     writes the paged pool) — admission is then bounded by slots only, and
     the allocator is never charged. state_pool: slot-ownership accounting
     for O(1) mixer states (acquired on admit, released on retirement).
+    prefix_cache: optional serving/prefix_cache.PrefixCache bound to
+    `allocator` — admission probes/borrows cached prefix pages and
+    completed prefills insert theirs; None keeps the exact legacy path.
     """
     assert max_slots >= 1 and table_pages >= 1 and prefill_chunk >= 1
     self.max_slots = max_slots
@@ -147,6 +163,7 @@ class Scheduler:
     self.prefill_chunk = prefill_chunk
     self.needs_kv_pages = needs_kv_pages
     self.state_pool = state_pool
+    self.prefix_cache = prefix_cache
     self.waiting = collections.deque()        # of Sequence (QUEUED)
     self.slots: list[Optional[Sequence]] = [None] * max_slots
     self._by_id: dict[object, Sequence] = {}
@@ -158,6 +175,7 @@ class Scheduler:
     self.finished = 0
     self.cancelled = 0
     self.rejected_overlong = 0
+    self.slots_live_peak = 0
 
   # -- submission ------------------------------------------------------------
 
@@ -209,6 +227,53 @@ class Scheduler:
         evicted.append(seq)
     return evicted
 
+  def _AdmitPages(self, seq: Sequence) -> bool:
+    """Reserves seq's whole footprint, borrowing cached prefix pages.
+
+    Probes the prefix cache (if any) for the prompt's longest cached
+    page-aligned prefix, pins those pages with refcount shares, charges
+    the pool only for the uncached remainder, copy-on-writes any shared
+    page prefill will write into (only the final matched page, and only
+    on a full-cover match), and rewinds seq.pos past the reused tokens.
+    Returns False with NO net side effects when the pool cannot cover
+    the remainder even after evicting unreferenced cached pages."""
+    req = seq.req
+    total = self.alloc.PagesFor(len(req.prompt) + req.max_new)
+    shared, matched = [], 0
+    if self.prefix_cache is not None:
+      shared, matched = self.prefix_cache.Probe(req.prompt)
+    # prefill resumes at the first uncached token; a full-cover match
+    # still recomputes the LAST prompt token (its logits seed decoding)
+    p0 = min(matched, len(req.prompt) - 1)
+    first_write_page = p0 // self.alloc.page_size
+    n_cow = max(len(shared) - first_write_page, 0)
+    need_new = (total - len(shared)) + n_cow
+    # pin the borrowed pages FIRST (refcount >= 2 makes them un-evictable),
+    # then squeeze the pool: cached-but-unreferenced pages yield under
+    # admission pressure
+    self.alloc.Share(seq.id, shared)
+    if not self.alloc.CanAllocate(need_new):
+      if self.prefix_cache is not None:
+        self.prefix_cache.EvictForPressure(need_new - self.alloc.num_free)
+      if not self.alloc.CanAllocate(need_new):
+        self.alloc.Free(seq.id)   # undo the share; head-of-line blocks
+        return False
+    cow = []
+    for idx in range(first_write_page, len(shared)):
+      pair = self.alloc.CopyOnWrite(seq.id, idx)
+      if pair is not None:
+        cow.append(pair)
+        if self.prefix_cache is not None:
+          self.prefix_cache.NoteCow()
+    if total > len(shared):
+      self.alloc.Allocate(seq.id, total - len(shared))
+    if self.prefix_cache is not None:
+      self.prefix_cache.NoteAdmitted(req.prompt, matched)
+    seq.pos = p0
+    seq.reused_tokens = p0
+    seq.cow_pairs = cow
+    return True
+
   def Admit(self) -> list:
     """FIFO-admits waiting requests into free slots while pages last.
 
@@ -220,11 +285,10 @@ class Scheduler:
         continue
       seq = self.waiting[0]
       if self.needs_kv_pages:
-        need = self.alloc.PagesFor(len(seq.req.prompt) + seq.req.max_new)
-        if not self.alloc.CanAllocate(need):
+        if not self._AdmitPages(seq):
           break
         self.waiting.popleft()
-        pages = self.alloc.Allocate(seq.id, need)
+        pages = self.alloc.PagesOf(seq.id)
       else:
         # pure O(1)-mixer stack: nothing pages, a free slot IS admission
         self.waiting.popleft()
@@ -237,6 +301,8 @@ class Scheduler:
       if self.state_pool is not None:
         self.state_pool.Acquire(seq.id, i)
       self.admitted += 1
+      self.slots_live_peak = max(
+          self.slots_live_peak, sum(s is not None for s in self.slots))
       admitted.append(seq)
     return admitted
 
@@ -271,6 +337,10 @@ class Scheduler:
       else:  # DECODE: feed the last sampled token (writes it to the cache)
         ids[i, 0] = seq.out[-1]
         in_len[i] = 1
+      if self.needs_kv_pages:
+        # prefix sharing invariant: this row's KV writes must land only
+        # in pages it exclusively owns (CoW happened at admission)
+        self.alloc.AssertExclusive(seq.id, seq.pos, int(in_len[i]))
     return StepBatch(ids, q_pos, in_len, rows, mixed, prompt_tokens,
                      row_seeds=row_seeds, row_pos=row_pos)
 
@@ -290,6 +360,14 @@ class Scheduler:
           continue                       # more prompt chunks to go
         tok = int(sampled[i, n - 1])     # sample after the LAST prompt token
         seq.state = SeqState.DECODE
+        if self.prefix_cache is not None and self.needs_kv_pages:
+          # the prompt's K/V is now fully resident: cache its full-page
+          # prefix (the partial tail page — and every decode page after
+          # it — stays private to this sequence)
+          n_full = len(seq.req.prompt) // self.alloc.page_size
+          if n_full > 0:
+            self.prefix_cache.Insert(
+                seq.req.prompt, self.alloc.PagesOf(seq.id)[:n_full])
       elif seq.state is SeqState.DECODE:
         seq.pos += 1                     # the fed-back token is now cached
         tok = int(sampled[i, 0])
@@ -354,6 +432,11 @@ class Scheduler:
       row_k[i] = max(rk, 0)
       in_len[i] = row_k[i] + 1
       any_spec = any_spec or row_k[i] > 0
+      if self.needs_kv_pages:
+        # rollback safety against prefix sharing: the verify step writes
+        # (and, after rejection, REWRITES) slots pos..pos+row_k — those
+        # pages must never be shared with another request or the cache
+        self.alloc.AssertExclusive(seq.id, seq.pos, int(in_len[i]))
     if not any_spec:
       return None
     return StepBatch(ids, q_pos, in_len, rows, mixed=False, prompt_tokens=0,
@@ -423,4 +506,5 @@ class Scheduler:
         "cancelled": self.cancelled,
         "rejected_overlong": self.rejected_overlong,
         "needs_kv_pages": self.needs_kv_pages,
+        "slots_live_peak": self.slots_live_peak,
     }

@@ -334,6 +334,14 @@ class SpecRunner:
     if self.is_self:
       return
     in_len = batch.in_len * prefill_rows.astype(np.int32)
+    # prefix-cache admitted rows prefill from the first UNCACHED token, so
+    # the chunk on the wire starts at q_pos > draft_pos — riding along
+    # would skip the draft state over the cached prefix. Leave those rows
+    # to _DrainBacklog, which replays the full committed stream from
+    # draft_pos (host-side tokens, q_pos == 0 reset included).
+    for i, seq in enumerate(batch.rows):
+      if seq is not None and in_len[i] and seq.draft_pos != int(batch.q_pos[i]):
+        in_len[i] = 0
     if not in_len.any():
       return
     self.draft_states = self._consume_fn(

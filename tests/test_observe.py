@@ -440,13 +440,22 @@ class TestSchema:
 
   def test_validate_engine_stats_rejects_drift(self):
     good = {k: 0 for k in observe_schema.ENGINE_STATS_REQUIRED}
+    # sections with validated inner key sets need real shapes
+    good["prefix_cache"] = observe_schema.DisabledPrefixCacheStats()
+    good["kv_pages"] = {k: 0 for k in observe_schema.KV_PAGES_REQUIRED}
     observe_schema.ValidateEngineStats(good)
     observe_schema.ValidateEngineStats({**good, "trace": {}})  # optional ok
     with pytest.raises(AssertionError, match="missing"):
       observe_schema.ValidateEngineStats(
-          {k: 0 for k in list(observe_schema.ENGINE_STATS_REQUIRED)[1:]})
+          {k: v for k, v in list(good.items())[1:]})
     with pytest.raises(AssertionError, match="not in schema"):
       observe_schema.ValidateEngineStats({**good, "renegade_key": 1})
+    # inner-section drift is a failure too, not just top-level drift
+    with pytest.raises(AssertionError, match="prefix_cache"):
+      observe_schema.ValidateEngineStats(
+          {**good, "prefix_cache": {**good["prefix_cache"], "bogus": 1}})
+    with pytest.raises(AssertionError, match="kv_pages"):
+      observe_schema.ValidateEngineStats({**good, "kv_pages": {}})
 
 
 # -- trace_report tool -------------------------------------------------------

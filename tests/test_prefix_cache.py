@@ -1,0 +1,382 @@
+"""Global prefix cache: CoW KV page sharing across requests.
+
+Three layers, mirroring the implementation split:
+
+- `TestRefcountedAllocator`: kv_cache.PageAllocator's new reference
+  machinery in isolation — Share/Retain/Release refcounts, per-reference
+  Free, copy-on-write splits, and the AssertExclusive write guard.
+- `TestPrefixTree`: prefix_cache.PrefixCache over a bare allocator —
+  pure Probe vs NoteAdmitted counters, canonical inserts, LRU eviction
+  (leaves-first, pinned pages immune), invalidation and Bind mismatch.
+- `TestPrefixEngine`: the full serving loop — the contract that matters
+  is BYTE-IDENTICAL token streams: warm (cache hit) == cold (miss) ==
+  dense greedy reference == cache-off legacy engine, across bf16, int8
+  scale-sidecar pools, and speculative decoding on a shared prefix
+  (verify-step writes run under AssertExclusive, so a rollback that
+  touched a shared page would fail loudly, not corrupt silently).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from lingvo_tpu.observe import schema as observe_schema
+from lingvo_tpu.serving import engine as engine_lib
+from lingvo_tpu.serving import kv_cache
+from lingvo_tpu.serving import prefix_cache as prefix_cache_lib
+from lingvo_tpu.serving import spec_decode
+
+from tests.test_serving_engine import (_GreedyRef, _TinyLmParams,
+                                       tiny_lm)  # noqa: F401
+
+
+# -- allocator refcounts ------------------------------------------------------
+
+
+class TestRefcountedAllocator:
+
+  def test_share_adds_references_and_free_drops_one(self):
+    alloc = kv_cache.PageAllocator(8, 4)
+    pages = alloc.Allocate("a", 2)
+    assert all(alloc.RefCount(p) == 1 for p in pages)
+    assert alloc.shared_pages == 0
+    alloc.Share("b", pages)
+    assert all(alloc.RefCount(p) == 2 for p in pages)
+    assert alloc.shared_pages == 2
+    assert alloc.num_free == 6          # sharing is free of pool charge
+    assert alloc.Stats()["shared_pages"] == 2
+    alloc.Free("a")
+    assert all(alloc.RefCount(p) == 1 for p in pages)
+    assert alloc.num_free == 6          # b still holds them
+    alloc.Free("b")
+    assert alloc.num_free == 8
+    assert alloc.shared_pages == 0
+
+  def test_share_empty_is_a_noop(self):
+    alloc = kv_cache.PageAllocator(4, 4)
+    alloc.Share("ghost", [])
+    assert alloc.Stats()["num_sequences"] == 0
+    with pytest.raises(KeyError):
+      alloc.PagesOf("ghost")
+
+  def test_cow_splits_shared_page_in_place(self):
+    alloc = kv_cache.PageAllocator(8, 4)
+    a = alloc.Allocate("a", 2)
+    assert alloc.CopyOnWrite("a", 0) is None   # exclusive: no split
+    alloc.Share("b", a)
+    pair = alloc.CopyOnWrite("b", 1)
+    assert pair is not None
+    old, new = pair
+    assert old == a[1] and new not in a
+    assert alloc.PagesOf("b") == [a[0], new]   # spliced at logical idx 1
+    assert alloc.PagesOf("a") == a             # writer untouched
+    assert alloc.RefCount(old) == 1 and alloc.RefCount(new) == 1
+    assert alloc.shared_pages == 1             # only a[0] still shared
+
+  def test_cow_out_of_pages_has_no_side_effects(self):
+    alloc = kv_cache.PageAllocator(2, 4)
+    a = alloc.Allocate("a", 2)
+    alloc.Share("b", a)
+    with pytest.raises(kv_cache.OutOfPages):
+      alloc.CopyOnWrite("b", 0)
+    assert alloc.PagesOf("b") == a
+    assert all(alloc.RefCount(p) == 2 for p in a)
+
+  def test_retain_release_and_double_free_assert(self):
+    alloc = kv_cache.PageAllocator(2, 4)
+    (pg,) = alloc.Allocate("a", 1)
+    alloc.Retain(pg)                    # ownerless cache reference
+    alloc.Free("a")
+    assert alloc.RefCount(pg) == 1 and alloc.num_free == 1
+    alloc.Release(pg)
+    assert alloc.num_free == 2
+    with pytest.raises(AssertionError):
+      alloc.Release(pg)                 # double free is loud
+    with pytest.raises(AssertionError):
+      alloc.Retain(pg)                  # cannot retain a free page
+
+  def test_assert_exclusive_guards_shared_write_ranges(self):
+    alloc = kv_cache.PageAllocator(8, 4)
+    a = alloc.Allocate("a", 2)
+    alloc.AssertExclusive("a", 0, 8)    # exclusive everywhere: fine
+    alloc.Share("b", [a[0]])
+    with pytest.raises(AssertionError):
+      alloc.AssertExclusive("a", 0, 4)  # page 0 now shared
+    alloc.AssertExclusive("a", 4, 4)    # page 1 still exclusive
+    alloc.AssertExclusive("a", 0, 0)    # empty write range: no-op
+    alloc.AssertExclusive("a", 4, 100)  # range clamps to owned pages
+
+
+# -- prefix tree --------------------------------------------------------------
+
+
+def _Cached(alloc, cache, prompt):
+  """Simulates a writer sequence that prefilled `prompt` then retired:
+  the cache's Retain is what keeps the pages alive past the Free."""
+  wid = object()
+  pages = alloc.Allocate(wid, len(prompt) // alloc.page_size)
+  cache.Insert(prompt, pages)
+  alloc.Free(wid)
+  return pages
+
+
+class TestPrefixTree:
+
+  def _Fixture(self, num_pages=16, page_size=4, **kw):
+    alloc = kv_cache.PageAllocator(num_pages, page_size)
+    return alloc, prefix_cache_lib.PrefixCache(alloc, None, **kw)
+
+  def test_probe_is_pure_and_note_admitted_counts(self):
+    alloc, cache = self._Fixture()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    pages = _Cached(alloc, cache, prompt)
+    assert cache.cached_pages == 2
+    for _ in range(5):                  # admission re-probes every step
+      got, matched = cache.Probe(prompt)
+      assert got == pages and matched == 8
+    assert cache.hits == 0 and cache.misses == 0 and cache.hit_tokens == 0
+    # partial prefix matches only full pages
+    got, matched = cache.Probe(prompt[:6] + [99, 99])
+    assert got == pages[:1] and matched == 4
+    # full-cover hit still recomputes the last prompt token
+    cache.NoteAdmitted(prompt, 8)
+    assert cache.hits == 1 and cache.hit_tokens == 7
+    cache.NoteAdmitted([9, 9, 9, 9], 0)
+    assert cache.misses == 1 and cache.hit_tokens == 7
+
+  def test_insert_keeps_first_writers_pages_canonical(self):
+    alloc, cache = self._Fixture()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    first = _Cached(alloc, cache, prompt)
+    free_before = alloc.num_free
+    second = _Cached(alloc, cache, prompt)   # identical prefix, new pages
+    assert first != second
+    got, _ = cache.Probe(prompt)
+    assert got == first                      # first writer stays canonical
+    assert cache.cached_pages == 2
+    assert alloc.num_free == free_before     # duplicates fully released
+
+  def test_evict_lru_leaves_first_and_pinned_pages_survive(self):
+    alloc, cache = self._Fixture()
+    p1 = [1, 2, 3, 4, 5, 6, 7, 8]
+    p2 = [9, 10, 11, 12]
+    pages1 = _Cached(alloc, cache, p1)
+    _Cached(alloc, cache, p2)
+    cache.NoteAdmitted(p1, 8)                # p1 is now most-recent
+    assert cache.EvictLru(1) == 1
+    assert cache.cached_pages == 2           # LRU victim was p2's page
+    assert cache.Probe(p2)[1] == 0
+    assert cache.Probe(p1)[1] == 8
+    # pinned by a borrower: refcount 2 pages are not evictable
+    alloc.Share("s", pages1)
+    assert cache.EvictLru(5) == 0
+    alloc.Free("s")
+    # leaves-first: both nodes go once unpinned, deep node before parent
+    assert cache.EvictLru(5) == 2
+    assert cache.cached_pages == 0 and cache.evictions == 3
+    assert alloc.num_free == alloc.num_pages
+
+  def test_invalidate_and_bind_mismatch(self):
+    alloc, cache = self._Fixture()
+    _Cached(alloc, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+    assert cache.Invalidate() == 2
+    assert cache.cached_pages == 0 and cache.evictions == 2
+    assert alloc.num_free == alloc.num_pages
+    # same pool, same dtype: Bind keeps entries
+    _Cached(alloc, cache, [1, 2, 3, 4])
+    cache.Bind(alloc, None)
+    assert cache.cached_pages == 1
+    # dtype flip: an int8 page never serves a bf16 probe
+    cache.Bind(alloc, "int8")
+    assert cache.cached_pages == 0
+    # allocator identity flip: page ids are meaningless across pools
+    _Cached(alloc, cache, [1, 2, 3, 4])
+    cache.Bind(kv_cache.PageAllocator(16, 4), "int8")
+    assert cache.cached_pages == 0
+
+  def test_max_pages_cap_evicts_then_stops(self):
+    alloc, cache = self._Fixture(max_pages=1)
+    wid = object()
+    pages = alloc.Allocate(wid, 2)
+    cache.Insert([1, 2, 3, 4, 5, 6, 7, 8], pages)
+    # writer still holds both pages -> nothing evictable -> prefix-complete
+    # insert stops at the cap instead of overshooting
+    assert cache.cached_pages == 1
+    alloc.Free(wid)
+    _Cached(alloc, cache, [21, 22, 23, 24])
+    assert cache.cached_pages == 1           # cap held via LRU eviction
+    assert cache.evictions == 1
+    assert cache.Probe([21, 22, 23, 24])[1] == 4
+
+  def test_stats_key_set_matches_schema(self):
+    _, cache = self._Fixture()
+    assert set(cache.Stats()) == observe_schema.PREFIX_CACHE_STATS_KEYS
+    assert cache.Stats()["enabled"] is True
+    disabled = observe_schema.DisabledPrefixCacheStats()
+    assert set(disabled) == observe_schema.PREFIX_CACHE_STATS_KEYS
+    assert disabled["enabled"] is False
+
+
+# -- serving engine -----------------------------------------------------------
+
+
+def _MakeEngine(task, theta, **kw):
+  kw.setdefault("page_size", 4)
+  kw.setdefault("num_pages", 16)
+  kw.setdefault("max_batch", 2)
+  kw.setdefault("max_seq_len", 32)
+  kw.setdefault("prefill_chunk", 4)
+  kw.setdefault("default_max_new", 6)
+  kw.setdefault("prefix_cache", True)
+  return engine_lib.ServingLoop(task, theta, **kw)
+
+
+def _Run(eng, prompt, max_new):
+  """Drives one request inline (deterministic: no loop thread)."""
+  h = eng.Submit(list(prompt), max_new)
+  while not h.done:
+    eng.StepOnce()
+  return h.Result(timeout=0)
+
+
+_PROMPT = [5, 9, 2, 33, 17, 4, 11, 3]   # page-aligned: 2 full pages at ps=4
+
+
+class TestPrefixEngine:
+
+  def test_cold_then_warm_streams_byte_identical(self, tiny_lm):
+    task, theta = tiny_lm
+    eng = _MakeEngine(task, theta)
+    ref = _GreedyRef(task, theta, _PROMPT, 6)
+    cold = _Run(eng, _PROMPT, 6)
+    assert cold == ref
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["misses"] == 1 and pc["hits"] == 0
+    assert pc["cached_pages"] == 2 and pc["cached_tokens"] == 8
+    warm = _Run(eng, _PROMPT, 6)
+    assert warm == cold                      # THE contract: bit-exact reuse
+    stats = eng.Stats()
+    pc = stats["prefix_cache"]
+    # full-cover match: last prompt token recomputes, so 7 tokens skipped
+    # and exactly the final shared page is copy-on-write'd
+    assert pc["hits"] == 1 and pc["hit_tokens"] == 7
+    assert pc["cow_copies"] == 1
+    assert stats["prefix_hit_tokens"] == 7
+    # both requests drained; only the cache's retains keep pages resident
+    assert pc["cached_pages"] == 2
+    assert stats["kv_pages"]["free"] == eng.num_pages - 2
+
+  def test_cache_on_matches_cache_off_legacy(self, tiny_lm):
+    task, theta = tiny_lm
+    eng_off = _MakeEngine(task, theta, prefix_cache=None)
+    eng_on = _MakeEngine(task, theta)
+    assert eng_off.prefix_cache is None
+    assert eng_off.Stats()["prefix_cache"]["enabled"] is False
+    for prompt in (_PROMPT, [7, 7, 7], _PROMPT):
+      assert _Run(eng_on, prompt, 5) == _Run(eng_off, prompt, 5)
+
+  def test_mid_page_divergence_stays_isolated(self, tiny_lm):
+    """Two prompts sharing one full page then diverging mid-page: the
+    borrower must never see the writer's tail tokens (its divergent page
+    is private — the cache only ever hands out full pages)."""
+    task, theta = tiny_lm
+    eng = _MakeEngine(task, theta)
+    a = [5, 9, 2, 33, 17, 4]                 # 1 full page + 2-token tail
+    b = [5, 9, 2, 33, 7, 8]                  # same page 0, different tail
+    out_a = _Run(eng, a, 6)
+    out_b = _Run(eng, b, 6)
+    assert out_a == _GreedyRef(task, theta, a, 6)
+    assert out_b == _GreedyRef(task, theta, b, 6)
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["hits"] == 1 and pc["hit_tokens"] == 4
+    assert pc["cow_copies"] == 0             # divergence page was private
+    # and the writer's stream is reproducible after the borrower ran
+    assert _Run(eng, a, 6) == out_a
+
+  def test_eviction_under_pool_pressure(self, tiny_lm):
+    task, theta = tiny_lm
+    eng = _MakeEngine(task, theta, num_pages=4, max_batch=1, max_seq_len=16)
+    p1, p2 = _PROMPT, [40, 41, 42, 43, 44, 45, 46, 47]
+    assert _Run(eng, p1, 4) == _GreedyRef(task, theta, p1, 4)
+    stats = eng.Stats()
+    assert stats["prefix_cache"]["cached_pages"] == 2
+    assert stats["kv_pages"]["free"] == 2    # cache holds 2 of 4 pages
+    # p2 needs 3 pages -> admission must evict a cached page to proceed
+    assert _Run(eng, p2, 4) == _GreedyRef(task, theta, p2, 4)
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["evictions"] >= 1
+    assert pc["misses"] == 2
+
+  def test_int8_scale_sidecar_pages_shared(self, tiny_lm):
+    """Warm int8 hits reuse quantized K/V pages AND their f32 scale
+    sidecars; parity target is the int8 cache-off engine (int8 rounding
+    shifts tokens vs the dense reference, sharing must not shift more)."""
+    task, theta = tiny_lm
+    eng8 = _MakeEngine(task, theta, kv_cache_dtype="int8")
+    eng8_off = _MakeEngine(task, theta, kv_cache_dtype="int8",
+                           prefix_cache=None)
+    assert eng8.kv_cache_dtype == "int8"
+    ref = _Run(eng8_off, _PROMPT, 6)
+    cold = _Run(eng8, _PROMPT, 6)
+    warm = _Run(eng8, _PROMPT, 6)
+    assert cold == ref and warm == ref
+    pc = eng8.Stats()["prefix_cache"]
+    assert pc["hits"] == 1 and pc["cow_copies"] == 1
+
+  def test_spec_decode_on_shared_prefix(self, tiny_lm):
+    """Regression for the rollback audit: speculative verify writes (and
+    their rejected-tail re-writes after rollback) run under
+    AssertExclusive, so a rollback into a shared page would assert. The
+    warm spec stream must equal the cold one and the dense reference."""
+    task, theta = tiny_lm
+    eng = _MakeEngine(task, theta, num_pages=24, max_batch=2,
+                      spec=spec_decode.SelfDraft(k=4, num_layers=1),
+                      default_max_new=8)
+    ref = _GreedyRef(task, theta, _PROMPT, 8)
+    cold = _Run(eng, _PROMPT, 8)
+    warm = _Run(eng, _PROMPT, 8)
+    assert cold == ref and warm == ref
+    stats = eng.Stats()
+    assert stats["prefix_cache"]["hits"] == 1
+    assert stats["spec_cycles"] > 0          # spec path actually ran
+
+  def test_ssm_stack_is_rejected(self):
+    from lingvo_tpu.core import ssm
+    p = _TinyLmParams(
+        mixer_tpl=ssm.GatedSSMLayer.Params().Set(state_dim=8, chunk_size=4),
+        mixer_atten_every_n=2)
+    task = p.Instantiate()
+    task.FinalizePaths()
+    theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="attention-only"):
+      _MakeEngine(task, theta)
+
+  def test_update_theta_invalidates_cache(self, tiny_lm):
+    task, theta = tiny_lm
+    eng = _MakeEngine(task, theta)
+    cold = _Run(eng, _PROMPT, 6)
+    assert eng.Stats()["prefix_cache"]["cached_pages"] == 2
+    eng.UpdateTheta(theta)                   # checkpoint swap: all KV stale
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["cached_pages"] == 0 and pc["evictions"] == 2
+    # next identical request is a miss, and (same theta) byte-identical
+    assert _Run(eng, _PROMPT, 6) == cold
+    assert eng.Stats()["prefix_cache"]["misses"] == 2
+
+  def test_stats_schema_and_midflight_sharing(self, tiny_lm):
+    task, theta = tiny_lm
+    eng = _MakeEngine(task, theta)
+    _Run(eng, _PROMPT, 6)
+    h = eng.Submit(list(_PROMPT), 6)
+    eng.StepOnce()                           # admit the warm request
+    mid = eng.Stats()
+    assert mid["kv_pages"]["shared_pages"] >= 1   # page 0: seq + cache
+    assert mid["scheduler"]["slots_live"] == 1
+    while not h.done:
+      eng.StepOnce()
+    stats = eng.Stats()
+    observe_schema.ValidateEngineStats(stats)
+    assert stats["prefix_cache"]["enabled"] is True
+    assert set(stats["prefix_cache"]) == observe_schema.PREFIX_CACHE_STATS_KEYS
+    assert stats["scheduler"]["slots_live_peak"] >= 1
+    assert stats["kv_pages"]["shared_pages"] == 0  # drained: only cache refs

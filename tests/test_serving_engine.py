@@ -508,7 +508,8 @@ class TestServingEngine:
                           "tokens_per_sec", "decode_state_bytes_per_seq",
                           "kv_cache_dtype", "kv_bytes_per_token",
                           "serve_int8_weights", "draft_tokens",
-                          "accepted_tokens", "accepted_len_hist"}
+                          "accepted_tokens", "accepted_len_hist",
+                          "prefix_hit_tokens", "prefix_cache"}
     # the literal set above IS the shared schema: the telemetry dict is
     # generated from observe.schema, so any key added to one surface
     # without the other now fails here, not in a bench comparison
@@ -522,6 +523,11 @@ class TestServingEngine:
     assert telem["draft_tokens"] == 0
     assert telem["accepted_tokens"] == 0
     assert telem["accepted_len_hist"] == []
+    # ...and never serves cached prefixes: same mirror contract
+    assert telem["prefix_hit_tokens"] == 0
+    assert telem["prefix_cache"]["enabled"] is False
+    assert set(telem["prefix_cache"]) == (
+        observe_schema.PREFIX_CACHE_STATS_KEYS)
     assert telem["prompt_tokens"] == 7 and telem["decode_tokens"] == 12
     assert telem["decode_state_bytes_per_seq"] > 0
     assert telem["tokens_per_sec"] > 0
