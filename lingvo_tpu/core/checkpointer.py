@@ -6,12 +6,26 @@ max_to_keep GC with keep_every_n (`saver.py:297`), saved-value sanity checks
 (`saver.py:64-95`), async saving (`saver.py:335`) — implemented over
 `orbax.checkpoint` which already speaks sharded jax.Array natively (the
 TPU-native replacement for the reference's graph-mode sharded Saver).
+
+Two save surfaces:
+- `Save` — synchronous write (the caller blocks through the orbax write);
+  used at exit-time force saves and by anything needing write-then-read.
+- `SaveAsync` — the pipelined executor's cadence save: snapshot the state
+  on the calling thread (a cheap device-side copy fence; only THAT is
+  `checkpoint_save` badput) and run the orbax write on a background
+  worker. `WaitForPendingSave` is the barrier — Restore/Close/the final
+  force-save all cross it, so a restore can never read a half-written
+  step and worker errors surface at the next fence instead of vanishing.
+
+Goodput attribution lives INSIDE the save calls, gated on an actual write:
+a cadence no-op contributes zero `checkpoint_save` badput.
 """
 
 from __future__ import annotations
 
 import os
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any
 
 import jax
@@ -33,16 +47,26 @@ class Checkpointer:
                max_to_keep: int = 10,
                keep_every_n_steps: int | None = None,
                async_save: bool = True,
-               sanity_checks: bool = True):
+               sanity_checks: bool = True,
+               goodput=None):
+    """goodput: injectable GoodputTracker (tests); None resolves the
+    process-global tracker lazily on the first actual write."""
     import orbax.checkpoint as ocp
     self._train_dir = os.path.abspath(train_dir)
     os.makedirs(self._train_dir, exist_ok=True)
     self._save_interval_steps = save_interval_steps
     self._save_interval_seconds = save_interval_seconds
     self._sanity_checks = sanity_checks
+    self._goodput = goodput
     self._last_save_time = time.time()
     self._last_save_step = -1
     self._last_probe_step = -(self._SECONDS_CHECK_STRIDE + 1)
+    # SaveAsync background writer: one worker => writes land in submission
+    # order; at most one write outstanding (SaveAsync barriers on the
+    # previous one, so a slow filesystem applies backpressure to the
+    # cadence instead of queueing unbounded snapshots)
+    self._save_pool: ThreadPoolExecutor | None = None
+    self._pending_save: Future | None = None
     options = ocp.CheckpointManagerOptions(
         max_to_keep=max_to_keep,
         keep_period=keep_every_n_steps,
@@ -133,17 +157,91 @@ class Checkpointer:
             f"Checkpoint sanity check failed: non-finite values in {path}")
     raise ValueError("Checkpoint sanity check failed: non-finite values")
 
+  def _Goodput(self):
+    if self._goodput is None:
+      from lingvo_tpu.observe import goodput as goodput_lib
+      self._goodput = goodput_lib.Get()
+    return self._goodput
+
+  def _Submit(self, fn, *args) -> Future:
+    if self._save_pool is None:
+      self._save_pool = ThreadPoolExecutor(
+          max_workers=1, thread_name_prefix="ckpt-save")
+    return self._save_pool.submit(fn, *args)
+
   def Save(self, step: int, state: NestedMap, force: bool = False) -> bool:
-    """Saves if the policy says so (or force). Returns True if saved."""
+    """Saves if the policy says so (or force). Returns True if saved.
+    Synchronous: blocks through the orbax write (after barriering any
+    in-flight SaveAsync, preserving write order). The write still runs on
+    the save worker: orbax's CheckpointManager finalizes an async save
+    only from the thread that wrote it, so EVERY write goes through the
+    one worker to keep that thread identity stable."""
     if not force and not self.ShouldSave(step):
       return False
-    if self._sanity_checks:
-      self._SanityCheck(state)
-    import orbax.checkpoint as ocp
-    self._mgr.save(step, args=ocp.args.StandardSave(dict(state)))
-    self._last_save_time = time.time()
-    self._last_save_step = step
+    with self._Goodput().Track("checkpoint_save"):
+      self.WaitForPendingSave()
+      if self._sanity_checks and jax.process_count() > 1:
+        self._SanityCheck(state)   # collectives stay on the main thread
+      self._last_save_time = time.time()
+      self._last_save_step = step
+      self._Submit(self._WriteSnapshot, step, state).result()
     return True
+
+  def _SnapshotState(self, state: NestedMap) -> NestedMap:
+    """Decouples the to-be-saved values from the training pipeline. On
+    donating backends (non-CPU) each device leaf becomes an enqueued
+    device-side copy — ordered before any later dispatch that donates the
+    original buffers, and dispatched asynchronously, so the caller-side
+    cost is one enqueue per leaf, not a device sync. On CPU (no donation)
+    the immutable arrays are shared by reference. Either way the NestedMap
+    container is fresh: the executor mutates its own in place (pruning)."""
+    if jax.default_backend() == "cpu":
+      return state.Transform(lambda x: x)
+    import jax.numpy as jnp
+    return state.Transform(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x)
+
+  def SaveAsync(self, step: int, state: NestedMap,
+                force: bool = False) -> bool:
+    """Cadence save with the orbax write on a background worker. Only the
+    caller-side fence — waiting out the previous write plus the snapshot
+    enqueue — counts as `checkpoint_save` badput; the write itself overlaps
+    training. Returns True if a write was scheduled. Errors from the
+    scheduled write surface at the next WaitForPendingSave barrier
+    (Restore / Close / the final force-save / the next SaveAsync)."""
+    if not force and not self.ShouldSave(step):
+      return False
+    with self._Goodput().Track("checkpoint_save"):
+      self.WaitForPendingSave()
+      snap = self._SnapshotState(state)
+      if self._sanity_checks and jax.process_count() > 1:
+        # the multi-process check coordinates via process_allgather, which
+        # must stay on the main thread (worker-side collectives can
+        # interleave with program collectives and deadlock)
+        self._SanityCheck(snap)
+      # cadence marks advance at SUBMIT time: the decision "a save for
+      # this step exists" is made now, even though the bytes land later
+      self._last_save_time = time.time()
+      self._last_save_step = step
+      self._pending_save = self._Submit(self._WriteSnapshot, step, snap)
+    return True
+
+  def _WriteSnapshot(self, step: int, snap: NestedMap) -> None:
+    """Save-worker body of Save/SaveAsync (the ONLY _mgr.save caller)."""
+    if self._sanity_checks and jax.process_count() <= 1:
+      # single-process: no collectives involved — check off-thread so a
+      # full finiteness reduce doesn't sit on the training critical path
+      self._SanityCheck(snap)
+    import orbax.checkpoint as ocp
+    self._mgr.save(step, args=ocp.args.StandardSave(dict(snap)))
+
+  def WaitForPendingSave(self) -> None:
+    """Barrier for SaveAsync: blocks until the in-flight write (if any)
+    finishes and re-raises its error. Restore, Close, and the executor's
+    recovery/final-save paths all cross this before touching checkpoints."""
+    fut, self._pending_save = self._pending_save, None
+    if fut is not None:
+      fut.result()
 
   def LatestStep(self) -> int | None:
     return self._mgr.latest_step()
@@ -156,6 +254,8 @@ class Checkpointer:
     (ref Restore:354 'restore or init' semantics).
     """
     import orbax.checkpoint as ocp
+    self.WaitForPendingSave()   # never read around an in-flight write
+    self._mgr.wait_until_finished()  # nor around an orbax finalize/GC pass
     target = step if step is not None else self._mgr.latest_step()
     if target is None:
       return state_template, 0
@@ -175,9 +275,14 @@ class Checkpointer:
     return state, int(target)
 
   def WaitUntilFinished(self) -> None:
+    self.WaitForPendingSave()
     self._mgr.wait_until_finished()
 
   def Close(self) -> None:
+    self.WaitForPendingSave()
+    if self._save_pool is not None:
+      self._save_pool.shutdown(wait=True)
+      self._save_pool = None
     self._mgr.wait_until_finished()
     self._mgr.close()
 

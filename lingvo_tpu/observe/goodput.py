@@ -13,6 +13,17 @@ answers from the process-global registry:
   publishes everything as a lazy `goodput/*` registry section, so the
   numbers are current at every scrape without a publish step.
 
+  Under the PIPELINED executor (TrainProgram.pipeline_depth >= 1) the
+  train attribution moves from Run-wall windows to loop-COMPLETION
+  intervals (`_AttributePipelinedLoop`): device loops execute serially
+  however far ahead the host dispatches, so completion-to-completion
+  spans partition the wall; each span minus the infeed wait and compile
+  seconds that accrued inside it lands in `step`. `checkpoint_save` then
+  counts only the caller-side snapshot fence of an ACTUAL async write —
+  a cadence no-op contributes zero — so a shrinking `other_s` +
+  `checkpoint_save_s` against a fixed workload is exactly the badput the
+  pipeline reclaimed (docs/pipelined_executor.md).
+
 - **How fast relative to the hardware?** `PublishMfu` wires a
   `train/mfu` lazy gauge: the train-step executable's XLA cost analysis
   (flops/step, recorded by the programs' CompileLog/_RecordCompile or a
@@ -92,6 +103,13 @@ class GoodputTracker:
     a window to find how much compilation happened inside."""
     with self._lock:
       return self._buckets["compile"]
+
+  def Snapshot(self) -> dict:
+    """Raw bucket totals {bucket: seconds} at this instant — a cheap
+    before/after basis for windowed deltas (bench sections, tests)
+    without the wall/residual derivation Stats() adds."""
+    with self._lock:
+      return dict(self._buckets)
 
   @contextlib.contextmanager
   def Track(self, bucket: str):

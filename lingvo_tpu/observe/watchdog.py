@@ -4,8 +4,13 @@ Nobody was watching the watchers: a hung device step, a step-time
 regression, or a scheduler that admits but never retires all looked like
 "the process is up" from outside. `StallWatchdog` closes that gap:
 
-- **Heartbeats.** The train loop and `ServingLoop` call `Beat()` once per
-  completed step; the watchdog keeps an EMA of inter-beat time. `Check()`
+- **Heartbeats.** The train programs and `ServingLoop` call `Beat()` once
+  per COMPLETED loop/step — for pipelined training, from the telemetry
+  worker when a dispatched loop's device work + metric fetch lands (the
+  executor wires `Beat` via `SetLoopDoneCallback`), never from the
+  dispatch side: a pipelined host keeps dispatching against a hung
+  device, so dispatch-side beats would hold /healthz green through a
+  real stall. The watchdog keeps an EMA of inter-beat time. `Check()`
   — run by the /healthz scrape thread, a periodic checker thread, or a
   test — evaluates the trip conditions. The split matters: a hung step
   loop cannot self-report, so liveness must be evaluated on a thread the

@@ -44,7 +44,9 @@ class ExecutorTpu:
     stopped when the main loop exits. watchdog: None auto-creates a
     StallWatchdog when serve_port is set; True forces one; False
     disables; or pass a configured StallWatchdog. The watchdog beats
-    once per schedule Run, so /healthz flips when the train loop stalls.
+    once per COMPLETED program loop (telemetry-side, not dispatch-side),
+    so /healthz flips when the device stalls even while a pipelined host
+    keeps dispatching.
     """
     self._logdir = logdir
     os.makedirs(logdir, exist_ok=True)
@@ -117,13 +119,22 @@ class ExecutorTpu:
               metric_history=self._metric_history))
     # fleet-facing telemetry (observe/): checkpoint/recovery wall time
     # feeds the process-global goodput tracker; serve_port opens the
-    # status endpoints; the watchdog beats once per schedule Run
+    # status endpoints; the watchdog beats once per completed loop
     self._goodput = goodput_lib.Get()
     self.watchdog = None
     if isinstance(watchdog, observe.StallWatchdog):
       self.watchdog = watchdog
     elif watchdog or (watchdog is None and serve_port is not None):
       self.watchdog = observe.StallWatchdog(observe.Default())
+    if self.watchdog is not None:
+      # liveness follows loop COMPLETION (the telemetry worker fires the
+      # callback), not schedule-Run dispatch: a pipelined host dispatches
+      # freely while the device hangs, so dispatch-side beats would keep
+      # /healthz green through a real stall
+      for prog in self._SchedulePrograms():
+        set_cb = getattr(prog, "SetLoopDoneCallback", None)
+        if callable(set_cb):
+          set_cb(self.watchdog.Beat)
     self.status_server = None
     if serve_port is not None:
       self.status_server = observe.StatusServer(
@@ -294,6 +305,12 @@ class ExecutorTpu:
     try:
       return self._MainLoopBody(state, start_step)
     finally:
+      try:
+        # a fatal exit must not abandon an in-flight background write
+        # (non-daemon worker); its own error is secondary here
+        self._checkpointer.WaitForPendingSave()
+      except BaseException:  # noqa: BLE001
+        pass
       self._ShutdownPrograms()
       if self.status_server is not None:
         self.status_server.Stop()
@@ -301,23 +318,54 @@ class ExecutorTpu:
       if self.watchdog is not None:
         self.watchdog.Close()   # drop any still-armed flight recorder
 
+  def _PipelineDepth(self) -> int:
+    """The train schedule's dispatch-window depth, or 0 when the schedule
+    can't be pipelined: no deterministic StepsPerCycle (multi-task
+    sampling), no train program, or the program runs synchronously /
+    with pipeline_depth=0 (the kill switch)."""
+    sched = self._schedule
+    spc = getattr(sched, "StepsPerCycle", None)
+    if not callable(spc) or spc() <= 0:
+      return 0
+    tp = getattr(sched, "train_program", None)
+    if tp is None:
+      return 0
+    p = tp.p
+    if not (p.async_infeed and getattr(p, "defer_telemetry", False)):
+      return 0
+    return max(int(getattr(p, "pipeline_depth", 0) or 0), 0)
+
+  def _SyncHostSteps(self, step: int) -> None:
+    """Seeds every program's host-side step tracking at a device fence
+    (start, restore, recovery)."""
+    for prog in self._SchedulePrograms():
+      fn = getattr(prog, "SyncHostStep", None)
+      if callable(fn):
+        fn(step)
+
   def _MainLoopBody(self, state, start_step):
+    if self._PipelineDepth() >= 1:
+      return self._PipelinedMainLoopBody(state, start_step)
+    return self._LegacyMainLoopBody(state, start_step)
+
+  def _LegacyMainLoopBody(self, state, start_step):
+    """The pre-pipelining main loop (PR 5 shape), kept as the exact path
+    for pipeline_depth=0 / sync programs / multi-task schedules: one
+    blocking `device_get(state.step)` per cycle, lag-<=1 results."""
     from lingvo_tpu.core import retry as retry_lib
     step = start_step
     consecutive_failures = 0
     while step < self._max_steps:
       # Save applies the cadence policy itself; checking ShouldSave here
-      # too would run its multi-host broadcast twice per cycle
-      with self._goodput.Track("checkpoint_save"):
-        self._checkpointer.Save(step, state)
+      # too would run its multi-host broadcast twice per cycle. (Goodput
+      # attribution lives inside Save, gated on an actual write.)
+      self._checkpointer.Save(step, state)
       if self._mlperf is not None:
         self._mlperf.Print(self._mllog.BLOCK_START,
                            metadata={"step": step})
       try:
         state, results = self._schedule.Run(state)
         consecutive_failures = 0
-        if self.watchdog is not None:
-          self.watchdog.Beat()
       except BaseException as e:  # noqa: BLE001
         if self._mlperf is not None:
           # keep intervals balanced: close the block before retrying/raising
@@ -381,7 +429,7 @@ class ExecutorTpu:
           if not (isinstance(r, dict) and name.startswith("eval")):
             continue
           if "accuracy" in r:  # eval_accuracy is higher-is-better ONLY
-            self._mlperf.Print(ml_perf_log.EVAL_ACCURACY, r["accuracy"],
+            self._mlperf.Print(self._mllog.EVAL_ACCURACY, r["accuracy"],
                                metadata={"step": step, "program": name})
           if "loss" in r:
             self._mlperf.Print("eval_loss", r["loss"],
@@ -432,8 +480,7 @@ class ExecutorTpu:
       self._mlperf.Close()
     if not self._trial_done:
       self._trial.ReportDone()
-    with self._goodput.Track("checkpoint_save"):
-      self._checkpointer.Save(step, state, force=True)
+    self._checkpointer.Save(step, state, force=True)
     self._checkpointer.Close()
     # marker for follower jobs (evaler/decoder pollers): training is over —
     # process the final checkpoint and exit instead of idling to timeout
@@ -442,6 +489,195 @@ class ExecutorTpu:
                 "w") as f:
         f.write(str(step))
     return state
+
+  def _PipelinedMainLoopBody(self, state, start_step):
+    """The fully pipelined main loop (pipeline_depth >= 1): infeed,
+    compute, checkpointing, and cadence decisions run as independent
+    pipelines.
+
+    - Host-side step tracking: after a successful cycle the step is
+      `start + cycles x StepsPerCycle()` — no `device_get(state.step)`
+      on the steady-state path; the device counter is re-read only at
+      the fences that already exist (restore, recovery).
+    - The dispatch window lives in TrainProgram ($pipeline_depth loops'
+      telemetry may be unresolved at Run exit); this loop never blocks
+      on Run's stale return value.
+    - Checkpoint saves snapshot on this thread and write on a background
+      worker (Checkpointer.SaveAsync); restore/final-save/recovery cross
+      the WaitForPendingSave barrier.
+    - Cadence decisions (NaN-stop, early-stop, trial, mlperf markers)
+      consume the completed-loop stream via PollCompletedResults, so they
+      fire within <= pipeline_depth loops of the offending step; eval
+      results are fresh (the schedule flushes the train window at eval
+      boundaries) and the exit path flushes + re-runs the decisions on
+      the tail (docs/pipelined_executor.md).
+    """
+    from lingvo_tpu.core import retry as retry_lib
+    sched = self._schedule
+    steps_per_cycle = int(sched.StepsPerCycle())
+    step = start_step
+    self._SyncHostSteps(step)
+    consecutive_failures = 0
+    while step < self._max_steps:
+      # cadence save: ShouldSave runs inside (once — it may broadcast
+      # multi-host); the orbax write overlaps the cycles dispatched below.
+      # The save decision needs no telemetry, only the state reference,
+      # which is consistent by construction (in-flight but ordered).
+      self._checkpointer.SaveAsync(step, state)
+      if self._mlperf is not None:
+        self._mlperf.Print(self._mllog.BLOCK_START,
+                           metadata={"step": step})
+      try:
+        state, run_results = self._schedule.Run(state)
+        consecutive_failures = 0
+      except BaseException as e:  # noqa: BLE001
+        if self._mlperf is not None:
+          self._mlperf.Print(self._mllog.BLOCK_STOP,
+                             metadata={"step": step, "status": "error"})
+        if (not retry_lib.IsTransient(e) or
+            consecutive_failures >= self._max_train_retries):
+          raise
+        consecutive_failures += 1
+        delay = min(2.0 ** consecutive_failures, 30.0)
+        print(f"[executor] transient failure ({type(e).__name__}: {e}); "
+              f"restoring last checkpoint and retrying "
+              f"({consecutive_failures}/{self._max_train_retries}) "
+              f"in {delay:.0f}s", flush=True)
+        with self._goodput.Track("recovery"):
+          time.sleep(delay)
+          # drain the dispatch window (results straddling the failure are
+          # unreliable) and restart errored infeed producers
+          self._RecoverPrograms()
+        with self._goodput.Track("checkpoint_restore"):
+          # Restore crosses WaitForPendingSave: never read around an
+          # in-flight background write
+          state, step = self._checkpointer.Restore(
+              self._PlaceState(self._CreateTrainState()))
+        self._SyncHostSteps(step)  # fence: host arithmetic re-seeds here
+        continue
+      step += steps_per_cycle
+      state = self._MaybePrune(state, step)
+      # telemetry-driven cadence: decisions run over loops that COMPLETED
+      # (each exactly once, <= pipeline_depth stale), plus this cycle's
+      # inline eval/decode results (fresh — the schedule flushed the train
+      # window before running them). Run's returned train result is the
+      # same stream lagged, so it is deliberately ignored here.
+      completed = []
+      for name, r in (run_results or {}).items():
+        if isinstance(r, dict) and not name.startswith("train"):
+          completed.append((name, r))
+      for prog in self._SchedulePrograms():
+        poll = getattr(prog, "PollCompletedResults", None)
+        if not callable(poll):
+          continue
+        name = getattr(getattr(prog, "p", None), "name", "") or "train"
+        for r in poll():
+          completed.append((name, r))
+      if self._CadenceDecisions(step, completed):
+        break
+      if self._mlperf is not None:
+        self._mlperf.Print(self._mllog.BLOCK_STOP,
+                           metadata={"step": step})
+    # exit: land every in-flight loop, then run the SAME cadence pass over
+    # the tail so the final metrics/NaN/trial state is complete before the
+    # force save (the staleness contract's "complete final flush")
+    self._FlushPrograms()
+    tail = []
+    for prog in self._SchedulePrograms():
+      poll = getattr(prog, "PollCompletedResults", None)
+      if not callable(poll):
+        continue
+      name = getattr(getattr(prog, "p", None), "name", "") or "train"
+      for r in poll():
+        tail.append((name, r))
+    if tail:
+      self._CadenceDecisions(step, tail)
+    if self._mlperf is not None:
+      self._mlperf.Print(self._mllog.RUN_STOP,
+                         metadata={"status": "success", "step": step})
+      self._mlperf.Close()
+    if not self._trial_done:
+      self._trial.ReportDone()
+    # synchronous force save (barriers on any pending async write first)
+    self._checkpointer.Save(step, state, force=True)
+    self._checkpointer.Close()
+    if jax.process_index() == 0:
+      with open(os.path.join(self._checkpointer.train_dir, "FINISHED"),
+                "w") as f:
+        f.write(str(step))
+    return state
+
+  def _CadenceDecisions(self, step: int, completed: list) -> bool:
+    """One telemetry-driven cadence pass (pipelined loop): exports metric
+    rows, then NaN-stop, trial reporting, mlperf eval markers, early stop.
+    `completed` is [(program name, result dict)] — train rows carry their
+    own `at_step` (host-tracked), eval rows belong to the current `step`.
+    Returns True when the main loop must stop."""
+    import math as _math
+    rows: dict[int, dict] = {}
+    for name, r in completed:
+      at = (int(r["at_step"]) if isinstance(r, dict) and "at_step" in r
+            else step)
+      rows.setdefault(at, {})[name] = r
+    for at in sorted(rows):
+      self._ExportMetrics(at, rows[at])
+    nan_loss = any(
+        isinstance(r, dict) and "loss" in r
+        and not _math.isfinite(r["loss"])
+        for name, r in completed if name.startswith("train"))
+    if nan_loss:
+      if not self._trial_done:
+        self._trial.ReportDone(infeasible=True, reason="nan_loss")
+        self._trial_done = True
+      if self._mlperf is not None:
+        self._mlperf.Print(self._mllog.RUN_STOP,
+                           metadata={"status": "aborted",
+                                     "reason": "nan_loss"})
+        self._mlperf.Close()
+        self._mlperf = None
+      print("[executor] NaN/Inf train loss: reporting trial infeasible "
+            "and stopping", flush=True)
+      return True
+    stop_requested = False
+    for name, r in completed:
+      if isinstance(r, dict) and name.startswith(("eval", "decode")):
+        stop_requested |= bool(self._trial.ReportEvalMeasure(step, r))
+    if stop_requested or self._trial.ShouldStop():
+      print(f"[executor] trial requested early stop at step {step}",
+            flush=True)
+      return True
+    if self._mlperf is not None:
+      for name, r in completed:
+        if not (isinstance(r, dict) and name.startswith("eval")):
+          continue
+        if "accuracy" in r:  # eval_accuracy is higher-is-better ONLY
+          self._mlperf.Print(self._mllog.EVAL_ACCURACY, r["accuracy"],
+                             metadata={"step": step, "program": name})
+        if "loss" in r:
+          self._mlperf.Print("eval_loss", r["loss"],
+                             metadata={"step": step, "program": name})
+    if self._early_stop is not None and self._task is not None:
+      tp = self._task.p.train
+      for name, r in completed:
+        if name != tp.early_stop_program:
+          continue
+        if (isinstance(r, dict) and tp.early_stop_metric in r
+            and jax.process_index() == 0):  # single history writer
+          self._metric_history.ConditionalAppend(step,
+                                                 r[tp.early_stop_metric])
+      should_stop = (bool(self._early_stop.Stop(step))
+                     if jax.process_index() == 0 else False)
+      if jax.process_count() > 1:
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        should_stop = bool(multihost_utils.broadcast_one_to_all(
+            _np.asarray(should_stop)))
+      if should_stop:
+        print(f"[executor] early stop at step {step} "
+              f"(no {tp.early_stop_metric} improvement in "
+              f"{tp.early_stop_window} steps)", flush=True)
+        return True
+    return False
 
   def _ExportMetrics(self, step: int, results: dict[str, Any]):
     if jax.process_index() != 0:

@@ -40,6 +40,77 @@ _EOS = object()  # end-of-stream sentinel (never a valid batch)
 _LINGERING_LOCK = threading.Lock()
 _LINGERING: dict[Any, threading.Thread] = {}
 
+# One-shot multi-host producer-placement probe verdict (see
+# ProbeProducerPlacement). Cached per process: the answer is a property of
+# the runtime/backend pairing, not of any one program.
+_PROBE_LOCK = threading.Lock()
+_PROBE_VERDICT: bool | None = None
+
+
+def _DefaultPlacementProbe() -> None:
+  """Representative off-main-thread `make_array_from_process_local_data`
+  call: a tiny replicated array over every device. Raises (or hangs) on
+  runtimes where the collective array build is not thread-safe off the
+  main thread."""
+  import jax
+  import numpy as np
+  devs = np.asarray(jax.devices())
+  mesh = jax.sharding.Mesh(devs.reshape(-1), ("probe",))
+  sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+  arr = jax.make_array_from_process_local_data(
+      sharding, np.zeros((1,), np.float32), (1,))
+  jax.block_until_ready(arr)
+
+
+def ProbeProducerPlacement(probe_fn: Callable[[], None] | None = None,
+                           timeout_s: float = 20.0) -> bool:
+  """One-shot safety probe: may H2D placement run on the producer thread
+  under real multi-host?
+
+  Producer-side placement overlaps the H2D transfer with compute, but
+  `jax.make_array_from_process_local_data` builds a *global* array and some
+  runtime versions only support that from the main thread. Rather than
+  hard-coding the conservative consumer-side fallback forever, run ONE
+  representative call on a scratch thread with a join timeout; any
+  exception or hang means "not safe". Multi-process, the verdict is
+  all-reduced (process_allgather on the calling thread) so every host makes
+  the same producer-vs-consumer placement choice — hosts disagreeing would
+  skew per-host infeed latency and, worse, diverge any placement-dependent
+  collective setup.
+
+  The default probe's verdict is cached for the process; an injected
+  `probe_fn` (tests) bypasses the cache.
+  """
+  global _PROBE_VERDICT
+  import jax
+  with _PROBE_LOCK:
+    if probe_fn is None and _PROBE_VERDICT is not None:
+      return _PROBE_VERDICT
+    ok = [False]
+
+    def _Run():
+      try:
+        (probe_fn or _DefaultPlacementProbe)()
+        ok[0] = True
+      except BaseException:  # noqa: BLE001 - any failure means "not safe"
+        ok[0] = False
+
+    t = threading.Thread(target=_Run, daemon=True, name="placement-probe")
+    t.start()
+    t.join(timeout_s)
+    verdict = bool(ok[0]) and not t.is_alive()
+    if jax.process_count() > 1:
+      try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        verdicts = multihost_utils.process_allgather(np.asarray([verdict]))
+        verdict = bool(np.all(verdicts))
+      except BaseException:  # noqa: BLE001 - coordination failure: fall back
+        verdict = False
+    if probe_fn is None:
+      _PROBE_VERDICT = verdict
+    return verdict
+
 
 class DeviceInfeed:
   """Bounded background producer queue feeding device (or host) batches.
@@ -233,10 +304,11 @@ class DeviceInfeed:
 class DeferredTelemetry:
   """Single-worker executor for post-loop metric fetch + summary writes.
 
-  One worker => jobs complete in submission order. The consumer keeps at
-  most one loop in flight (`TrainProgram.Run` returns the most recent
-  COMPLETED loop's result), so results the executor consumes — NaN-stop,
-  trial reporting, early-stop — lag dispatch by at most one loop.
+  One worker => jobs complete in submission order. The consumer bounds the
+  in-flight window (`TrainProgram.Run` keeps at most `pipeline_depth`
+  unresolved loops, one for the legacy `pipeline_depth=0` path), so
+  results the executor consumes — NaN-stop, trial reporting, early-stop —
+  lag dispatch by at most that many loops (docs/pipelined_executor.md).
   """
 
   def __init__(self, name: str = "telemetry", registry: Any = None):

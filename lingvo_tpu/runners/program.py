@@ -10,6 +10,7 @@ weighted metric accumulators (ref `TpuEvalMetrics`). `SimpleProgramSchedule`
 
 from __future__ import annotations
 
+import collections
 import functools
 import json
 import os
@@ -85,9 +86,12 @@ class BaseProgram:
     p.Define("infeed_place_on_device", None,
              "Where H2D placement happens under async_infeed: True = on the "
              "producer thread (transfer overlaps compute too), False = "
-             "numpy in the thread, placement on the consumer (the "
-             "verified-safe multi-process variant), None = auto (True "
-             "single-process, False multi-process).")
+             "numpy in the thread, placement on the consumer, None = auto "
+             "(True single-process; multi-process, producer-side iff the "
+             "one-shot off-main-thread safety probe of "
+             "make_array_from_process_local_data passes — "
+             "infeed.ProbeProducerPlacement — else the numpy+consumer "
+             "fallback).")
     return p
 
   def __init__(self, params, task=None, input_generator=None):
@@ -108,6 +112,29 @@ class BaseProgram:
     self._telemetry = None
     self._pending_telemetry = None
     self._pending_consumed = True  # was the pending result already returned?
+    # k-deep dispatch window (pipeline_depth >= 1): unresolved telemetry
+    # futures, oldest first. The legacy lag-1 fields above stay the
+    # pipeline_depth=0 kill-switch path, byte-for-byte.
+    self._pending: collections.deque = collections.deque()
+    self._last_result: dict | None = None
+    self._last_result_consumed = True
+    # completed-but-unpolled results for the executor's telemetry-driven
+    # cadence decisions (NaN-stop etc.); every result that resolves through
+    # the window lands here exactly once until PollCompletedResults drains it
+    self._completed_unpolled: list = []
+    # executor hook fired when one dispatched loop's device work +
+    # telemetry completes (watchdog heartbeat); may run on the worker thread
+    self._loop_done_cb: Callable[[], None] | None = None
+    # host-side step tracking: after a successful loop the step is
+    # deterministic (start + loops x steps_per_loop); None = unseeded (the
+    # first pipelined Run seeds it from the concrete restored state, a
+    # fence that already exists)
+    self._host_step: int | None = None
+    # pipelined goodput attribution marks (completion-interval based;
+    # see _AttributePipelinedLoop): None = not yet in a pipelined window
+    self._pipe_t_mark: float | None = None
+    self._pipe_wait_mark = 0.0
+    self._pipe_compile_mark = 0.0
     from lingvo_tpu.core import summary_utils
     self._tb = summary_utils.SummaryWriter(
         self._program_dir, enabled=self.p.write_tensorboard)
@@ -279,11 +306,15 @@ class BaseProgram:
 
   def _PlaceInProducer(self) -> bool:
     """Auto policy for where H2D placement runs (see infeed_place_on_device):
-    multi-process defaults to numpy-in-thread + consumer placement so
-    `make_array_from_process_local_data` stays on the consumer thread."""
+    single-process always places on the producer; multi-process asks the
+    one-shot `make_array_from_process_local_data` safety probe and falls
+    back to numpy-in-thread + consumer placement when it fails."""
     if self.p.infeed_place_on_device is not None:
       return bool(self.p.infeed_place_on_device)
-    return jax.process_count() == 1
+    if jax.process_count() == 1:
+      return True
+    from lingvo_tpu.runners import infeed as infeed_lib
+    return infeed_lib.ProbeProducerPlacement()
 
   @staticmethod
   def _InputStatsOf(gen) -> dict:
@@ -297,19 +328,71 @@ class BaseProgram:
     except Exception:  # noqa: BLE001 - stats must never kill a train loop
       return {}
 
+  def SetLoopDoneCallback(self, cb: Callable[[], None] | None) -> None:
+    """Executor hook: `cb` fires each time one dispatched loop's device
+    work + telemetry completes (on the telemetry worker thread for deferred
+    loops, inline otherwise). The executor wires the stall watchdog's
+    Beat() here, so liveness tracks device COMPLETION, not host dispatch —
+    a hung device behind a free-running pipelined host stops beating."""
+    self._loop_done_cb = cb
+
+  def _NotifyLoopDone(self) -> None:
+    cb = self._loop_done_cb
+    if cb is not None:
+      try:
+        cb()
+      except BaseException:  # noqa: BLE001 - liveness must not kill the loop
+        pass
+
+  def SyncHostStep(self, step: int) -> None:
+    """Seeds host-side step tracking at a device fence (restore, recovery).
+    Between fences the pipelined paths advance the step arithmetically
+    instead of fetching `state.step` from the device."""
+    self._host_step = int(step)
+
+  def _PopPending(self) -> dict:
+    """Resolves the OLDEST pending loop (blocking); its result becomes the
+    newest completed result and joins the unpolled cadence stream."""
+    res = self._pending.popleft().result()[1]
+    self._last_result = res
+    self._last_result_consumed = False
+    self._completed_unpolled.append(res)
+    return res
+
+  def PollCompletedResults(self) -> list:
+    """Drains (without blocking) every result that completed since the last
+    poll — the executor's telemetry-driven cadence stream. Each result
+    appears exactly once; staleness is bounded by the dispatch window
+    (<= pipeline_depth unresolved loops at any Run exit)."""
+    while self._pending and self._pending[0].done():
+      self._PopPending()
+    out, self._completed_unpolled = self._completed_unpolled, []
+    return out
+
+  def PendingLoops(self) -> int:
+    """Unresolved dispatched loops (k-deep window + the legacy lag-1 slot)."""
+    return len(self._pending) + (1 if self._pending_telemetry is not None
+                                 else 0)
+
   def Flush(self):
-    """Waits for deferred telemetry and flushes the TB writer; returns the
-    pending Run result if no Run handed it out yet, else None. Called by
-    schedules at program boundaries and by the executor before the final
-    checkpoint, so summaries land in order and the lag-1 tail result still
-    reaches NaN-stop/metrics. No-op for fully-synchronous programs."""
+    """Waits for ALL deferred telemetry and flushes the TB writer; returns
+    the newest completed result if no Run handed it out yet, else None.
+    Called by schedules at program boundaries and by the executor at
+    decision boundaries (eval, save, stop) and before the final checkpoint,
+    so summaries land in order and the lagged tail result still reaches
+    NaN-stop/metrics. No-op for fully-synchronous programs."""
     out = None
-    if self._pending_telemetry is not None:
+    if self._pending_telemetry is not None:   # legacy lag-1 window
       res = self._pending_telemetry.result()[1]
       if not self._pending_consumed:
         out = res
       self._pending_telemetry = None
       self._pending_consumed = True
+    while self._pending:                      # k-deep window
+      self._PopPending()
+    if not self._last_result_consumed:
+      out = self._last_result
+      self._last_result_consumed = True
     self._tb.Flush()
     return out
 
@@ -324,6 +407,18 @@ class BaseProgram:
         fut.result()
       except BaseException:  # noqa: BLE001
         pass
+    while self._pending:
+      try:
+        self._pending.popleft().result()
+      except BaseException:  # noqa: BLE001
+        pass
+    # results straddling the failure are unreliable; the restore that
+    # follows re-seeds the host step and the goodput interval marks
+    self._last_result = None
+    self._last_result_consumed = True
+    self._completed_unpolled = []
+    self._host_step = None
+    self._pipe_t_mark = None
     if self._infeed is not None and not self._infeed.healthy:
       self._infeed.Reset()
 
@@ -371,6 +466,14 @@ class TrainProgram(BaseProgram):
              "recent COMPLETED loop's result (lags dispatch by <= 1 loop). "
              "False fetches synchronously after dispatch (infeed overlap "
              "only). Ignored when async_infeed is False.")
+    p.Define("pipeline_depth", 2,
+             "k-deep dispatch window under async_infeed + defer_telemetry: "
+             "Run may leave up to this many loops' telemetry unresolved, "
+             "so loop k+1 dispatches before loop k's metrics land and the "
+             "returned result is stale by at most this many loops. Also "
+             "switches to host-side step tracking (no device_get of "
+             "state.step between fences). 0 = the exact legacy lag-1 "
+             "behavior (kill switch; docs/pipelined_executor.md).")
     return p
 
   def _GetStepFn(self, state: NestedMap | None = None):
@@ -648,6 +751,7 @@ class TrainProgram(BaseProgram):
     result["global_steps_per_second"] = self._rate_tracker.Update(
         step, self.input_generator.GlobalBatchSize())
     self.WriteSummaries(step, result)
+    self._NotifyLoopDone()
     return state, result
 
   def _RunAsync(self, state: NestedMap) -> tuple[NestedMap, dict[str, float]]:
@@ -655,12 +759,24 @@ class TrainProgram(BaseProgram):
     pre-placed) from the infeed producer; the post-loop metric fetch +
     summary write run on the telemetry worker. Batch order is bit-identical
     to _RunSync; the returned result is the most recent COMPLETED loop's
-    (<= 1 loop stale; the first Run blocks for its own)."""
+    (<= pipeline_depth loops stale — <= 1 for the legacy pipeline_depth=0
+    window; the first Run blocks for its own)."""
     p = self.p
     t0 = time.time()
     self._MarkRunStart()
     infeed = self._GetInfeed()
     wait0 = infeed.wait_s
+    pipelined = p.defer_telemetry and int(p.pipeline_depth or 0) >= 1
+    if pipelined:
+      if self._host_step is None:
+        # the ONLY steady-path device fetch: seed host-side step tracking
+        # from the concrete restored/initial state, before this Run's
+        # dispatch makes `state.step` an in-flight value
+        self._host_step = int(jax.device_get(state.step))
+      if self._pipe_t_mark is None:
+        self._pipe_t_mark = t0
+        self._pipe_wait_mark = wait0
+        self._pipe_compile_mark = self._goodput.CompileSeconds()
     if p.on_device_loop:
       stacked = infeed.Get()
       if stacked is None:
@@ -700,40 +816,94 @@ class TrainProgram(BaseProgram):
     infeed_wait_s = infeed.wait_s - wait0
     queue_depth = infeed.QueueDepth()
     input_stats = self._InputStatsOf(self.input_generator)
-    step_arr = state.step
-    if _StateDonation():
-      # the NEXT Run's dispatch donates `state` (incl. .step) on
-      # accelerator backends; hand the worker an independent derived array
-      # so its deferred device_get can't hit a deleted buffer
-      step_arr = step_arr + 0
+    if pipelined:
+      # host-side step tracking: the loop just dispatched WILL end at this
+      # step (or fail, in which case recovery re-seeds from the device)
+      self._host_step += p.steps_per_loop
+      step_val: Any = self._host_step
+    else:
+      step_val = state.step
+      if _StateDonation():
+        # the NEXT Run's dispatch donates `state` (incl. .step) on
+        # accelerator backends; hand the worker an independent derived array
+        # so its deferred device_get can't hit a deleted buffer
+        step_val = step_val + 0
     job = functools.partial(
-        self._FinalizeLoop, step_arr, acc, stats_acc, t0,
-        host_overhead_s, infeed_wait_s, queue_depth, input_stats)
+        self._FinalizeLoop, step_val, acc, stats_acc, t0,
+        host_overhead_s, infeed_wait_s, queue_depth, input_stats,
+        pipelined=pipelined)
     if not p.defer_telemetry:
       result = job()[1]
       self._AttributeRunWall(t0, infeed_wait_s)
       return state, result
     fut = self._GetTelemetry().Submit(job)
-    prev, self._pending_telemetry = self._pending_telemetry, fut
-    # steady state: return loop k-1's result (its fetch overlapped this
-    # loop's dispatch); first Run after a Flush blocks for its own — and
-    # marks it consumed so Flush won't report it a second time
-    self._pending_consumed = prev is None
-    result = (prev if prev is not None else fut).result()[1]
-    self._AttributeRunWall(t0, infeed_wait_s)
-    return state, result
+    if not pipelined:
+      # pipeline_depth=0 kill switch: the exact PR 5 lag-1 window
+      prev, self._pending_telemetry = self._pending_telemetry, fut
+      # steady state: return loop k-1's result (its fetch overlapped this
+      # loop's dispatch); first Run after a Flush blocks for its own — and
+      # marks it consumed so Flush won't report it a second time
+      self._pending_consumed = prev is None
+      result = (prev if prev is not None else fut).result()[1]
+      self._AttributeRunWall(t0, infeed_wait_s)
+      return state, result
+    # k-deep dispatch window: sweep already-completed loops (free), then
+    # apply backpressure so at most pipeline_depth loops stay unresolved.
+    # Goodput attribution happens at loop completion
+    # (_AttributePipelinedLoop), not here: this Run's wall is near zero in
+    # steady state and says nothing about device time.
+    self._pending.append(fut)
+    while self._pending and self._pending[0].done():
+      self._PopPending()
+    while len(self._pending) > int(p.pipeline_depth):
+      self._PopPending()
+    if self._last_result is None:
+      self._PopPending()   # very first loop (or first after recovery)
+    self._last_result_consumed = True
+    return state, self._last_result
 
-  def _FinalizeLoop(self, step_arr, acc, stats_acc, t_start,
+  def _AttributePipelinedLoop(self) -> float:
+    """Pipelined goodput attribution, run on the telemetry worker at loop
+    COMPLETION: loops execute serially on device however far ahead the
+    host dispatches, so completion-to-completion intervals partition the
+    wall into per-loop spans. Each span minus the infeed wait and
+    lazy-compile seconds that accrued inside it is productive step time.
+    Replaces _AttributeRunWall on this path — with a k-deep window the
+    Run wall is near zero and measures nothing. Returns the interval (the
+    per-loop wall basis for rate metrics)."""
+    now = time.time()
+    prev_t = self._pipe_t_mark if self._pipe_t_mark is not None else now
+    self._pipe_t_mark = now
+    wait_now = self._infeed.wait_s if self._infeed is not None else 0.0
+    wait_d = max(wait_now - self._pipe_wait_mark, 0.0)
+    self._pipe_wait_mark = wait_now
+    comp_now = self._goodput.CompileSeconds()
+    comp_d = max(comp_now - self._pipe_compile_mark, 0.0)
+    self._pipe_compile_mark = comp_now
+    interval = max(now - prev_t, 1e-9)
+    self._goodput.Add("infeed_wait", min(wait_d, interval))
+    self._goodput.Add("step", max(interval - wait_d - comp_d, 0.0))
+    return interval
+
+  def _FinalizeLoop(self, step_val, acc, stats_acc, t_start,
                     host_overhead_s, infeed_wait_s, queue_depth,
-                    input_stats) -> tuple[int, dict[str, float]]:
+                    input_stats, pipelined: bool = False,
+                    ) -> tuple[int, dict[str, float]]:
     """Telemetry-worker job: device_get of one loop's metrics + summary
     write. The np.asarray inside FinalizeMetrics synchronizes on the loop's
-    completion, so `wall` covers dispatch through device completion."""
+    completion, so `wall` covers dispatch through device completion.
+    step_val is a host int under host-side step tracking (pipelined), else
+    the loop's device step counter."""
     p = self.p
     result = metrics_lib.FinalizeMetrics(acc) if acc else {}
     if stats_acc:
       result.update(metrics_lib.FinalizeMetrics(stats_acc))
     wall = max(time.time() - t_start, 1e-9)
+    if pipelined:
+      # dispatch->completion spans queue time behind earlier in-flight
+      # loops; the completion-to-completion interval is the honest
+      # per-loop wall (and feeds the goodput step bucket)
+      wall = self._AttributePipelinedLoop()
     result["steps_per_second"] = p.steps_per_loop / wall
     result["examples_per_second"] = (
         p.steps_per_loop * self.input_generator.GlobalBatchSize() / wall)
@@ -742,13 +912,15 @@ class TrainProgram(BaseProgram):
     result["infeed_queue_depth"] = queue_depth
     for k, v in input_stats.items():
       result[f"input_{k}"] = v
-    step = int(jax.device_get(step_arr))
+    step = (int(step_val) if isinstance(step_val, int)
+            else int(jax.device_get(step_val)))
     result["global_steps_per_second"] = self._rate_tracker.Update(
         step, self.input_generator.GlobalBatchSize())
     self.WriteSummaries(step, result)
     # stamped AFTER the summary write (the jsonl rows are keyed by step
-    # already): lets executor metrics rows disambiguate the <=1-loop lag
+    # already): lets executor metrics rows disambiguate the bounded lag
     result["at_step"] = step
+    self._NotifyLoopDone()
     return step, result
 
 
@@ -840,6 +1012,7 @@ class EvalProgram(BaseProgram):
     _MaybeResetFiniteStream(gen)
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)
+    self._NotifyLoopDone()
     return state, result
 
 
@@ -931,6 +1104,7 @@ class DecodeProgram(BaseProgram):
     _MaybeResetFiniteStream(gen)
     step = int(jax.device_get(state.step))
     self.WriteSummaries(step, result)
+    self._NotifyLoopDone()
     return state, result
 
 
@@ -1075,6 +1249,18 @@ class SimpleProgramSchedule:
     if self.train_program:
       out.append(self.train_program)
     return out + list(self.eval_programs)
+
+  def StepsPerCycle(self) -> int:
+    """Optimizer steps one Run() advances the train state by — the
+    executor's host-side step arithmetic (pipelined main loop) relies on
+    this being deterministic. 0 = no train program (the executor falls
+    back to device-step fetching). Schedules without this method (e.g.
+    MultiTaskProgramSchedule, whose per-cycle step count depends on the
+    sampled task) are never pipelined."""
+    if self.train_program is None:
+      return 0
+    return (max(1, self.p.train_executions_per_eval)
+            * int(self.train_program.p.steps_per_loop))
 
   def Run(self, state: NestedMap) -> tuple[NestedMap, dict[str, Any]]:
     results: dict[str, Any] = {}

@@ -1,0 +1,381 @@
+"""Fully pipelined executor (ISSUE 15): k-deep dispatch window, host-side
+step tracking, async checkpoint save, telemetry-driven cadence.
+
+Contracts under test:
+- loss trajectories are BITWISE identical sync vs depth-0 vs depth-1 vs
+  depth-2 at the same seed (pipelining reorders telemetry, never math);
+- `pipeline_depth=0` is the exact legacy lag-1 path (kill switch);
+- NaN-stop fires within <= pipeline_depth loops of the offending loop;
+- no blocking `jax.device_get` on the steady-state cycle path;
+- transient-failure recovery drains the dispatch window and resumes;
+- SaveAsync/Restore barrier ordering + worker-error surfacing;
+- the goodput `checkpoint_save` bucket counts only actual writes;
+- the watchdog beats on loop COMPLETION, so a stalled device flips
+  /healthz even while the pipelined host keeps dispatching;
+- the producer-placement probe (satellite of this PR).
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from lingvo_tpu.core import checkpointer as checkpointer_lib
+from lingvo_tpu.core.nested_map import NestedMap
+from lingvo_tpu.observe import watchdog as watchdog_lib
+from lingvo_tpu.runners import executor as executor_lib
+from lingvo_tpu.runners import infeed as infeed_lib
+from lingvo_tpu.runners import program as program_lib
+
+from tests.test_executor_hardening import (_RegressionInput, _TaskParams)
+
+
+def _MakeExecutor(logdir, *, pipeline_depth=2, async_infeed=True,
+                  max_steps=30, steps_per_loop=5, save_interval=10,
+                  input_gen=None, **ex_kw):
+  task_p = _TaskParams(max_steps=max_steps, steps_per_loop=steps_per_loop,
+                       save_interval=save_interval)
+  task = task_p.Instantiate()
+  task.FinalizePaths()
+  train_p = program_lib.TrainProgram.Params().Set(
+      task=task_p, logdir=logdir, steps_per_loop=steps_per_loop,
+      async_infeed=async_infeed, pipeline_depth=pipeline_depth)
+  sched = program_lib.SimpleProgramSchedule(
+      program_lib.SimpleProgramSchedule.Params().Set(train_program=train_p),
+      task=task,
+      input_generators={"Train": input_gen or _RegressionInput(seed=0)})
+  ex = executor_lib.ExecutorTpu(task_p, logdir, schedule=sched, task=task,
+                                **ex_kw)
+  return ex, sched
+
+
+def _Summaries(logdir):
+  with open(os.path.join(logdir, "train", "summaries.jsonl")) as f:
+    return [json.loads(l) for l in f]
+
+
+class TestBitwiseTrajectory:
+
+  def test_trajectory_identical_across_depths(self, tmp_path):
+    """Same seed => bitwise-equal per-loop losses and final weights for
+    sync, depth-0 (legacy lag-1), depth-1, and depth-2 executors."""
+    runs = {}
+    for tag, kw in [("sync", dict(async_infeed=False)),
+                    ("depth0", dict(pipeline_depth=0)),
+                    ("depth1", dict(pipeline_depth=1)),
+                    ("depth2", dict(pipeline_depth=2))]:
+      logdir = str(tmp_path / tag)
+      ex, _ = _MakeExecutor(logdir, **kw)
+      state = ex.Start()
+      rows = _Summaries(logdir)
+      runs[tag] = (
+          [(r["step"], r["loss"]) for r in rows],
+          jax.device_get(state.theta),
+      )
+    ref_traj, ref_theta = runs["sync"]
+    assert [s for s, _ in ref_traj] == [5, 10, 15, 20, 25, 30]
+    for tag in ("depth0", "depth1", "depth2"):
+      traj, theta = runs[tag]
+      assert traj == ref_traj, tag  # bitwise: JSON round-trips exactly
+      for (pa, la), (pb, lb) in zip(ref_theta.FlattenItems(),
+                                    theta.FlattenItems()):
+        assert pa == pb
+        assert np.array_equal(la, lb), (tag, pa)
+
+  def test_kill_switch_uses_legacy_window(self, tmp_path):
+    """pipeline_depth=0 runs the byte-exact PR 5 path: the legacy lag-1
+    slot is exercised, the k-deep deque stays untouched, and host-side
+    step tracking never engages (the executor still fetches the device
+    step every cycle)."""
+    ex, sched = _MakeExecutor(str(tmp_path), pipeline_depth=0)
+    assert ex._PipelineDepth() == 0
+    seen = {"legacy": 0}
+    prog = sched.train_program
+    orig_run = prog._RunAsync
+
+    def _Spy(state):
+      out = orig_run(state)
+      if prog._pending_telemetry is not None:
+        seen["legacy"] += 1
+      return out
+
+    prog._RunAsync = _Spy
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 30
+    assert seen["legacy"] > 0          # lag-1 slot in use
+    assert not prog._pending           # k-deep window never engaged
+    assert prog._host_step is None     # host step tracking never seeded
+
+  def test_pipelined_keeps_window_depth(self, tmp_path):
+    """At depth 2 the dispatch window really goes >1 deep and backpressure
+    caps it: PendingLoops() never exceeds pipeline_depth at Run exit."""
+    ex, sched = _MakeExecutor(str(tmp_path), pipeline_depth=2, max_steps=40)
+    prog = sched.train_program
+    depths = []
+    orig_run = prog._RunAsync
+
+    def _Spy(state):
+      out = orig_run(state)
+      depths.append(prog.PendingLoops())
+      return out
+
+    prog._RunAsync = _Spy
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 40
+    assert max(depths) <= 2
+    # the first Run blocks for its own result (window 0); later runs are
+    # free to leave loops in flight
+    assert depths[0] == 0
+
+
+class TestSteadyStateDeviceFetch:
+
+  def test_no_device_get_on_cycle_path(self, tmp_path, monkeypatch):
+    """The pipelined executor never fetches the device step on the cycle
+    path: host tracking is seeded from the restore fence's already-host
+    step, and every later step is arithmetic. (The program-side
+    device_get seed is only a fallback for direct Run() callers.)"""
+    calls = []
+    real = jax.device_get
+
+    def _Counted(x):
+      calls.append(x)
+      return real(x)
+
+    monkeypatch.setattr(jax, "device_get", _Counted)
+    ex, _ = _MakeExecutor(str(tmp_path), pipeline_depth=2, max_steps=50,
+                          save_interval=10)
+    ex.Start()
+    monkeypatch.undo()
+    assert len(calls) == 0, [type(c) for c in calls]
+
+
+class TestCadenceStaleness:
+
+  @pytest.mark.parametrize("depth,max_step", [(1, 15), (2, 20)])
+  def test_nan_stop_within_depth_loops(self, tmp_path, depth, max_step):
+    """NaN enters at loop 2 (steps 6-10); the stop decision lands within
+    <= pipeline_depth loops of it."""
+
+    class _NanInput(_RegressionInput):
+      def __init__(self, nan_from_pull, **kw):
+        super().__init__(**kw)
+        self.pulls = 0
+        self._nan_from = nan_from_pull
+
+      def GetPreprocessedInputBatch(self):
+        self.pulls += 1
+        b = super().GetPreprocessedInputBatch()
+        if self.pulls >= self._nan_from:
+          b.y = b.y + np.float32("nan")
+        return b
+
+    ex, _ = _MakeExecutor(str(tmp_path), pipeline_depth=depth,
+                          max_steps=100, save_interval=100,
+                          input_gen=_NanInput(6, seed=0),
+                          max_train_retries=0)
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) <= max_step
+
+  def test_trial_stop_fires_at_cycle_boundary(self, tmp_path):
+    """trial.ShouldStop is polled every cycle with the host-tracked step,
+    so a stop request halts the pipelined run at the next boundary."""
+    from lingvo_tpu.core import base_trial
+
+    class _StopAfter3(base_trial.NoOpTrial):
+      def __init__(self):
+        self.calls = 0
+
+      def ShouldStop(self):
+        self.calls += 1
+        return self.calls >= 3
+
+    trial = _StopAfter3()
+    ex, _ = _MakeExecutor(str(tmp_path), pipeline_depth=2, max_steps=100,
+                          save_interval=100, trial=trial)
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 15  # stopped at cycle 3
+
+  def test_recovery_drains_window_and_completes(self, tmp_path):
+    """A transient producer death mid-window: recovery drains the k-deep
+    dispatch window, restores the checkpoint (crossing the async-save
+    barrier), re-seeds the host step, and the run still finishes."""
+
+    class _FailingInput(_RegressionInput):
+      def __init__(self, fail_at, **kw):
+        super().__init__(**kw)
+        self.pulls = 0
+        self._fail_at = fail_at
+
+      def GetPreprocessedInputBatch(self):
+        self.pulls += 1
+        if self.pulls == self._fail_at:
+          raise RuntimeError("UNAVAILABLE: reader died")
+        return super().GetPreprocessedInputBatch()
+
+    gen = _FailingInput(17, seed=0)
+    ex, sched = _MakeExecutor(str(tmp_path), pipeline_depth=2, max_steps=30,
+                              save_interval=5, input_gen=gen)
+    state = ex.Start()
+    assert int(jax.device_get(state.step)) == 30
+    assert gen.pulls > 17                       # producer restarted
+    assert not sched.train_program._pending     # window fully drained
+
+
+class TestAsyncCheckpointSave:
+
+  def _State(self, v=1.0):
+    import jax.numpy as jnp
+    return NestedMap(theta=NestedMap(w=jnp.full((4,), v, jnp.float32)),
+                     step=jnp.asarray(7, jnp.int32))
+
+  def test_save_async_visible_after_barrier(self, tmp_path):
+    ck = checkpointer_lib.Checkpointer(str(tmp_path), save_interval_steps=1)
+    state = self._State(3.0)
+    assert ck.SaveAsync(7, state)
+    # Restore crosses the WaitForPendingSave barrier: the write is visible
+    restored, step = ck.Restore(self._State(0.0))
+    assert step == 7
+    assert np.array_equal(np.asarray(restored.theta.w),
+                          np.full((4,), 3.0, np.float32))
+    ck.Close()
+
+  def test_cadence_noop_schedules_nothing(self, tmp_path):
+    ck = checkpointer_lib.Checkpointer(str(tmp_path),
+                                       save_interval_steps=10)
+    st = self._State()
+    assert ck.SaveAsync(10, st)
+    assert not ck.SaveAsync(13, st)   # off-cadence: no write scheduled
+    assert not ck.SaveAsync(10, st)   # same step: no duplicate write
+    ck.Close()
+    assert ck.LatestStep() == 10
+
+  def test_worker_error_surfaces_at_barrier(self, tmp_path):
+    ck = checkpointer_lib.Checkpointer(str(tmp_path), save_interval_steps=1)
+    import jax.numpy as jnp
+    bad = NestedMap(theta=NestedMap(w=jnp.full((4,), np.nan, jnp.float32)),
+                    step=jnp.asarray(1, jnp.int32))
+    assert ck.SaveAsync(1, bad)   # snapshot + submit succeed...
+    with pytest.raises(ValueError, match="non-finite"):
+      ck.WaitForPendingSave()     # ...the sanity failure lands at the fence
+    # the barrier is one-shot: after surfacing, the checkpointer is usable
+    assert ck.SaveAsync(2, self._State())
+    ck.Close()
+    assert ck.LatestStep() == 2
+
+  def test_goodput_counts_only_actual_writes(self, tmp_path):
+    class _Tracker:
+      def __init__(self):
+        self.entered = []
+
+      def Track(self, bucket):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _Cm():
+          self.entered.append(bucket)
+          yield
+        return _Cm()
+
+    tr = _Tracker()
+    ck = checkpointer_lib.Checkpointer(str(tmp_path),
+                                       save_interval_steps=10, goodput=tr)
+    st = self._State()
+    assert not ck.Save(3, st)        # cadence no-op: zero badput entries
+    assert not ck.SaveAsync(7, st)
+    assert tr.entered == []
+    assert ck.Save(10, st)
+    assert ck.SaveAsync(20, st)
+    assert tr.entered == ["checkpoint_save", "checkpoint_save"]
+    ck.Close()
+
+
+class TestWatchdogBeatsOnCompletion:
+
+  def test_stalled_device_flips_healthz(self, tmp_path):
+    """Dispatch keeps running while loop COMPLETION stalls: no beats =>
+    the watchdog trips no_heartbeat within its window, even though the
+    pipelined host is still dispatching. Completion resumes => healthy."""
+    clock = [0.0]
+    wd = watchdog_lib.StallWatchdog(stall_factor=10.0, min_interval_s=1.0,
+                                    clock=lambda: clock[0])
+    task_p = _TaskParams(max_steps=1000, steps_per_loop=5,
+                         save_interval=1000)
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    prog = program_lib.TrainProgram(
+        program_lib.TrainProgram.Params().Set(
+            task=task_p, logdir=str(tmp_path), steps_per_loop=5,
+            pipeline_depth=2),
+        task=task, input_generator=_RegressionInput(seed=0))
+    prog.SetLoopDoneCallback(wd.Beat)
+    gate = threading.Event()
+    gate.set()
+    orig_finalize = prog._FinalizeLoop
+
+    def _GatedFinalize(*a, **kw):
+      gate.wait(timeout=30)
+      return orig_finalize(*a, **kw)
+
+    prog._FinalizeLoop = _GatedFinalize
+    try:
+      state = task.CreateTrainState(jax.random.PRNGKey(0))
+      state, _ = prog.Run(state)            # first loop completes -> beat
+      deadline = time.time() + 10
+      while wd.Stats()["beats"] < 1 and time.time() < deadline:
+        time.sleep(0.01)
+      beats_before = wd.Stats()["beats"]
+      assert beats_before >= 1
+      gate.clear()                          # "device" stalls from here on
+      state, _ = prog.Run(state)            # dispatch still succeeds...
+      assert wd.Stats()["beats"] == beats_before  # ...but must NOT beat
+      clock[0] += 60.0                      # stall_factor x interval passes
+      wd.Check()
+      assert not wd.healthy                 # /healthz flips within window
+      gate.set()                            # device recovers
+      prog.Flush()
+      assert wd.Stats()["beats"] > beats_before
+      wd.Check()
+      assert wd.healthy
+    finally:
+      gate.set()
+      prog.Shutdown()
+
+
+class TestPlacementProbe:
+
+  def test_passing_probe_returns_true(self):
+    assert infeed_lib.ProbeProducerPlacement(probe_fn=lambda: None)
+
+  def test_failing_probe_returns_false(self):
+    def _Boom():
+      raise RuntimeError("off-main-thread placement unsupported")
+
+    assert not infeed_lib.ProbeProducerPlacement(probe_fn=_Boom)
+
+  def test_hanging_probe_returns_false(self):
+    ev = threading.Event()
+    try:
+      assert not infeed_lib.ProbeProducerPlacement(
+          probe_fn=lambda: ev.wait(30), timeout_s=0.2)
+    finally:
+      ev.set()   # unblock the daemon probe thread
+
+  def test_knob_overrides_probe(self, tmp_path):
+    task_p = _TaskParams()
+    task = task_p.Instantiate()
+    task.FinalizePaths()
+    prog = program_lib.TrainProgram(
+        program_lib.TrainProgram.Params().Set(
+            task=task_p, logdir=str(tmp_path),
+            infeed_place_on_device=False),
+        task=task, input_generator=_RegressionInput())
+    assert prog._PlaceInProducer() is False   # explicit knob wins
+    prog.p.infeed_place_on_device = True
+    assert prog._PlaceInProducer() is True
+    prog.p.infeed_place_on_device = None      # auto: single-process => True
+    assert prog._PlaceInProducer() is True
