@@ -1268,6 +1268,146 @@ def _BenchPrefixCache(jax, jnp, model_registry, on_tpu):
   }
 
 
+def _BenchRaggedStep(jax, jnp, model_registry, on_tpu, budget=None):
+  """One ragged step program vs the padded three-program engine (ISSUE 17).
+
+  The same seeded mixed-length greedy stream (SelfDraft speculation on)
+  is played against two engines that differ ONLY in `step_mode`:
+  'ragged' packs every live row into one [T]-token program where each
+  token is real work; 'legacy' alternates the padded [B, chunk] mixed
+  program, the [B, 1] decode program and the [B, k+1] verify program.
+  Two arms vary prompt-length VARIANCE (the padding driver: a ragged
+  chunk pads every short row to the longest, and prefill steps starve
+  spec cycles). Acceptance keys, on the high-variance arm:
+  `waste_per_step_ratio` (padded-waste tokens per step, legacy/ragged;
+  bar >= 2x), `tokens_per_sec_ratio` (bar >= 1.15x), `decode_p99_ms`
+  (ragged p99 decode-step latency must not degrade as variance grows
+  while legacy's does), and `streams_identical` per arm (the collapse
+  may never move a token). `budget` overrides the ragged engine's
+  per-step prefill token budget (tools/ragged_sweep.py ladders it).
+  """
+  from lingvo_tpu.serving import engine as engine_lib
+  from lingvo_tpu.serving import scheduler as scheduler_lib
+  from lingvo_tpu.serving import spec_decode
+
+  if on_tpu:
+    n_req, b_slots, page, max_seq, chunk = 32, 8, 128, 2048, 64
+    lo_band, hi_band, o_lo, o_hi = (96, 128), (8, 768), 32, 96
+  else:
+    n_req, b_slots, page, max_seq, chunk = 12, 4, 8, 96, 8
+    lo_band, hi_band, o_lo, o_hi = (10, 14), (2, 48), 8, 16
+  spec_k = 3
+
+  mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  mp.task.use_rotary = True
+  if on_tpu:
+    mp.task.model_dim, mp.task.num_heads, mp.task.hidden_dim = 512, 4, 1024
+  else:
+    mp.task.model_dim, mp.task.num_layers = 256, 4
+    mp.task.num_heads, mp.task.hidden_dim = 4, 512
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  vocab = task.p.vocab_size
+
+  full_pages = -(-(hi_band[1] + o_hi) // page)
+  num_pages = b_slots * full_pages   # roomy pool: step SHAPE is the subject
+
+  def _MakeStream(band, seed):
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, vocab, rng.randint(band[0], band[1] + 1))
+               .astype(np.int32) for _ in range(n_req)]
+    return prompts, rng.randint(o_lo, o_hi + 1, n_req)
+
+  def _Play(mode, prompts, max_news):
+    eng = engine_lib.ServingLoop(
+        task, theta, page_size=page, num_pages=num_pages,
+        max_batch=b_slots, max_seq_len=max_seq, prefill_chunk=chunk,
+        spec=spec_decode.SelfDraft(k=spec_k, num_layers=1),
+        step_mode=mode,
+        prefill_token_budget=budget if mode == "ragged" else None)
+    # warm every compiled program (legacy: mixed + decode + verify) so
+    # the timed stream measures steady state, not compiles
+    warm = np.zeros((2, 2 * chunk), np.int32)
+    warm[:] = np.arange(1, 2 * chunk + 1)
+    eng.RunBatch(warm, np.array([2 * chunk, 2], np.int32), 6)
+    handles = [eng.Submit(p, int(m), eos_id=None)
+               for p, m in zip(prompts, max_news)]
+    step_ms, decode_live = [], []
+    t0 = time.perf_counter()
+    while eng.sched.HasWork():
+      decode_live.append(any(
+          s is not None and s.state is scheduler_lib.SeqState.DECODE
+          for s in eng.sched.slots))
+      t1 = time.perf_counter()
+      eng.StepOnce()
+      step_ms.append((time.perf_counter() - t1) * 1e3)
+    wall = time.perf_counter() - t0
+    streams = [h.Result(timeout=0) for h in handles]
+    stats = eng.Stats()
+    # device tokens dispatched per step vs tokens that were real work
+    if mode == "ragged":
+      dispatched = stats["steps"] * eng._ragged_t
+    else:
+      verify = stats["spec_cycles"]
+      pure = stats["decode_steps"] - verify
+      dispatched = (stats["mixed_steps"] * b_slots * chunk
+                    + pure * b_slots + verify * b_slots * (spec_k + 1))
+    useful = (stats["prompt_tokens"] + stats["tokens_emitted"]
+              + stats["draft_tokens"])
+    dp99 = [t for t, d in zip(step_ms, decode_live) if d]
+    return {
+        "streams": streams,
+        "wall_s": wall,
+        "steps": stats["steps"],
+        "tokens_per_sec": sum(len(s) for s in streams) / wall,
+        "waste_per_step": (dispatched - useful) / max(stats["steps"], 1),
+        "decode_p99_ms": float(np.percentile(dp99, 99)) if dp99 else 0.0,
+        "spec_cycles": stats["spec_cycles"],
+        "step_programs": stats["compile"]["step_programs"],
+    }
+
+  arms = {}
+  for arm, band, seed in (("low_var", lo_band, 1), ("high_var", hi_band, 2)):
+    prompts, max_news = _MakeStream(band, seed)
+    r = _Play("ragged", prompts, max_news)
+    l = _Play("legacy", prompts, max_news)
+    arms[arm] = {
+        "prompt_len_band": list(band),
+        "streams_identical": r.pop("streams") == l.pop("streams"),
+        "ragged": {k: round(v, 3) if isinstance(v, float) else v
+                   for k, v in r.items()},
+        "legacy": {k: round(v, 3) if isinstance(v, float) else v
+                   for k, v in l.items()},
+        "tokens_per_sec_ratio": round(
+            r["tokens_per_sec"] / max(l["tokens_per_sec"], 1e-9), 3),
+        "waste_per_step_ratio": round(
+            l["waste_per_step"] / max(r["waste_per_step"], 1e-9), 3),
+    }
+  hv, lv = arms["high_var"], arms["low_var"]
+  return {
+      "requests": n_req, "slots": b_slots, "page_size": page,
+      "prefill_chunk": chunk, "spec_k": spec_k,
+      "prefill_token_budget": budget or chunk,
+      "arms": arms,
+      # acceptance: waste >= 2x lower, throughput >= 1.15x, and ragged
+      # decode p99 must not blow up with prompt variance like legacy's
+      "waste_ok": hv["waste_per_step_ratio"] >= 2.0,
+      "throughput_ok": hv["tokens_per_sec_ratio"] >= 1.15,
+      "decode_p99_ok": (hv["ragged"]["decode_p99_ms"]
+                        <= 1.10 * hv["legacy"]["decode_p99_ms"]),
+      "identical_ok": (hv["streams_identical"]
+                       and lv["streams_identical"]),
+      # the count-based waste ratio and byte-identity are valid anywhere;
+      # the TIME bars (throughput, p99) only measure the claim on TPU,
+      # where padded lanes cost real cycles and the Pallas kernel runs —
+      # the CPU XLA twin pays its gathers without the lane win
+      "valid_for_perf": bool(on_tpu),
+  }
+
+
 def _BenchFusedXent(jax, jnp, model_registry, on_tpu):
   """Dense vs fused blockwise LM-head xent (ops/fused_xent.py): full
   train-step time and peak memory at vocab 32k / 128k.
@@ -2100,6 +2240,8 @@ def main():
        lambda: _BenchQuantServing(jax, jnp, model_registry, on_tpu)),
       ("prefix_cache",
        lambda: _BenchPrefixCache(jax, jnp, model_registry, on_tpu)),
+      ("ragged_step",
+       lambda: _BenchRaggedStep(jax, jnp, model_registry, on_tpu)),
       ("fused_xent",
        lambda: _BenchFusedXent(jax, jnp, model_registry, on_tpu)),
       ("input_pipeline",

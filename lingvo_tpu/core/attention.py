@@ -721,6 +721,95 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       ctx, _ = self._Atten(theta, q, k_dense, v_dense, mask)
     return self._PostProj(theta, ctx), new_states
 
+  def RaggedStep(self, theta, query_vec, cached_states: NestedMap,
+                 block_tables, rows):
+    """One PACKED continuous-batching step (core/ragged.py RaggedRows).
+
+    query_vec: [1, T, D] — all rows' tokens flattened on one token axis;
+    token t belongs to slot rows.row_of[t] and lands at global kv slot
+    rows.pos[t] through that row's block table. Decode rows contribute one
+    token, prefill chunks and spec-verify windows several — the single
+    program the engine compiles instead of three (decode / mixed /
+    verify). Padding tokens (rows.valid == False) scatter to the trash
+    page and emit garbage the engine discards. Returns ([1, T, D],
+    updated states). Same numerics per token as PagedStep — the ragged
+    op twins (ops/ragged_block_attend.py) carry the bitwise proof at the
+    op level.
+    """
+    from lingvo_tpu.ops import block_decode
+    from lingvo_tpu.ops import ragged_block_attend
+    p = self.p
+    assert p.rel_pos_emb_dim <= 0, (
+        "RaggedStep computes positions from rows.pos; the T5 relative "
+        "bias would use wrong buckets")
+    k_pool, v_pool = cached_states.key, cached_states.value
+    np_total, page_size = k_pool.shape[0], k_pool.shape[1]
+    b, t_pages = block_tables.shape
+    t = query_vec.shape[1]
+    pos = rows.pos.astype(jnp.int32)                               # [T]
+    valid = rows.valid
+    row = jnp.clip(rows.row_of.astype(jnp.int32), 0, b - 1)
+    q = self._HeadsProj(theta, "query", query_vec)                 # [1,T,N,H]
+    k_new = self._HeadsProj(theta, "key", query_vec)
+    v_new = self._HeadsProj(theta, "value", query_vec)
+    if p.use_rotary_position_emb:
+      rt = self.ChildTheta(theta, "rotary")
+      posf = pos[None].astype(jnp.float32)
+      q = self.rotary.FProp(rt, q, position=posf)
+      k_new = self.rotary.FProp(rt, k_new, position=posf)
+    q = self._ScaleQuery(theta, q)
+    # scatter each token's K/V through ITS row's block table before the
+    # read (later tokens of the same prefill chunk attend to earlier ones);
+    # padding tokens write to the trash page (pool page np_total - 1)
+    logical = jnp.clip(pos // page_size, 0, t_pages - 1)
+    phys = jnp.clip(block_tables.astype(jnp.int32),
+                    0, np_total - 1)[row, logical]                 # [T]
+    phys = jnp.where(valid, phys, np_total - 1)
+    off = jnp.where(valid, pos % page_size,
+                    jnp.arange(t, dtype=jnp.int32) % page_size)
+    quantized = "key_scale" in cached_states
+    k_scale = v_scale = None
+    if quantized:
+      k_new, k_s = kv_quant.QuantizeKv(k_new)              # int8, [1,T,N]
+      v_new, v_s = kv_quant.QuantizeKv(v_new)
+      k_scale = cached_states.key_scale.at[phys, :, off].set(k_s[0])
+      v_scale = cached_states.value_scale.at[phys, :, off].set(v_s[0])
+    k_pool = k_pool.at[phys, off].set(k_new[0].astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, off].set(v_new[0].astype(v_pool.dtype))
+    new_states = NestedMap(key=k_pool, value=v_pool)
+    if quantized:
+      new_states.key_scale = k_scale
+      new_states.value_scale = v_scale
+    eligible = (self.QuantizedDecodeEligible(page_size) if quantized
+                else self.BlockDecodeEligible(page_size))
+    # token t attends over its row's slots [0, pos[t]]; q_end = 0 marks
+    # padding (the ragged op emits exact zeros there)
+    q_end = jnp.where(valid, pos + 1, 0)
+    if eligible:
+      ctx = ragged_block_attend.RaggedAttend(
+          q[0], k_pool, v_pool, block_tables, row, q_end,
+          page_size=page_size, k_scale=k_scale, v_scale=v_scale)[None]
+    else:
+      # gather-dense fallback at token granularity: each token is a batch
+      # row of one query over its row's materialized cache view (handles
+      # logit cap / dropout / prob quant exactly like PagedStep's)
+      k_dense = block_decode.GatherPages(k_pool, block_tables)
+      v_dense = block_decode.GatherPages(v_pool, block_tables)
+      if quantized:
+        k_dense = kv_quant.DequantKv(
+            k_dense, block_decode.GatherScales(k_scale, block_tables))
+        v_dense = kv_quant.DequantKv(
+            v_dense, block_decode.GatherScales(v_scale, block_tables))
+      slot = jnp.arange(t_pages * page_size)[None, None, None, :]
+      # padding tokens see slot 0 only (garbage, but never an all-masked
+      # softmax row)
+      horizon = jnp.where(valid, pos, 0)
+      mask = jnp.where(slot <= horizon[:, None, None, None], 0.0, _NEG_INF)
+      ctx, _ = self._Atten(theta, q[0][:, None], k_dense[row],
+                           v_dense[row], mask)
+      ctx = ctx[:, 0][None]
+    return self._PostProj(theta, ctx), new_states
+
 
 class LocalSelfAttention(MultiHeadedAttention):
   """Blocked sliding-window self-attention (ref

@@ -1,0 +1,95 @@
+"""Ragged row descriptors: the shared contract of the unified serving step.
+
+One compiled serving program replaces the three legacy step shapes (pure
+decode, mixed prefill+decode, spec-verify). Its activations are PACKED on
+a single token axis of static width T: a decode row contributes 1 token,
+a prefill row a chunk of tokens, a spec-verify row its last committed
+token plus k drafted tokens — and every layer sees the same flat [1, T, D]
+activation with per-token routing metadata instead of a padded [B, C, D]
+grid. `RaggedRows` is that metadata: a pytree of device arrays (no static
+members, so one jit signature covers every admit/decode/spec/retire mix).
+
+Two views of the same pack:
+
+- the TOKEN view (`row_of`, `col_of`, `pos`, `valid`, all [T]): what
+  attention needs — each token scatters its K/V through its row's block
+  table at global slot `pos` and attends over its own prefix. Padding
+  tokens (`valid == False`) write to the trash page and produce garbage
+  outputs the engine discards.
+- the ROW view (`row_q_pos`, `row_len` [B]; `row_cols` [B, wmax]): what
+  O(1)-state mixers need — ssm.GatedSSMLayer gathers its [B, wmax, D]
+  per-row chunk via `row_cols`, runs the existing PagedStep recurrence
+  (which already handles per-row lengths), and scatters results back to
+  the token axis. wmax is implicit in `row_cols`' shape, so it stays a
+  shape-static fact without being a python-level argument.
+
+Invariants the builder (serving/scheduler.py BuildRaggedStep) maintains:
+
+- row b's tokens occupy columns 0 .. row_len[b]-1 in kv order; token t
+  has `pos[t] == row_q_pos[row_of[t]] + col_of[t]`.
+- `row_cols[b, j]` is the token index of row b's j-th token for
+  j < row_len[b] and an arbitrary VALID index (0) past it — gathered
+  garbage is masked by the consumer via row_len, never read unmasked.
+- rows with 0 tokens this step (live but out of budget, or empty slots)
+  have row_len == 0 and row_q_pos == the sequence position (NOT 0 —
+  q_pos == 0 is the SSM state-reset trigger); empty slots use
+  row_q_pos == 1.
+- `valid` padding tokens carry row_of/pos clipped into range so device
+  gathers stay in bounds.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class RaggedRows(NamedTuple):
+  """Per-token + per-row routing for one packed ragged step.
+
+  All members are arrays (a jit-transparent pytree). T = packed token
+  width, B = engine slots, wmax = widest row this program admits.
+  """
+  row_of: jnp.ndarray    # [T] int32  slot index of each token
+  col_of: jnp.ndarray    # [T] int32  token's column within its row
+  pos: jnp.ndarray       # [T] int32  global kv slot the token writes/reads
+  valid: jnp.ndarray     # [T] bool   False = padding token
+  row_q_pos: jnp.ndarray  # [B] int32  row's first-token global position
+  row_len: jnp.ndarray    # [B] int32  tokens the row carries this step
+  row_cols: jnp.ndarray   # [B, wmax] int32  token-axis gather indices
+
+
+def BuildRaggedRows(row_lens, row_q_pos, t: int, wmax: int) -> RaggedRows:
+  """Host-side builder: per-row (q_pos, len) -> a packed RaggedRows.
+
+  row_lens/row_q_pos: [B] ints. Rows are packed in slot order; the caller
+  guarantees sum(row_lens) <= t and max(row_lens) <= wmax. Returns numpy
+  arrays (the engine ships them device-side per step like StepBatch).
+  """
+  row_lens = np.asarray(row_lens, np.int32)
+  row_q_pos = np.asarray(row_q_pos, np.int32)
+  b = row_lens.shape[0]
+  assert int(row_lens.sum()) <= t, (row_lens, t)
+  assert int(row_lens.max(initial=0)) <= wmax, (row_lens, wmax)
+  row_of = np.zeros((t,), np.int32)
+  col_of = np.zeros((t,), np.int32)
+  pos = np.zeros((t,), np.int32)
+  valid = np.zeros((t,), bool)
+  row_cols = np.zeros((b, wmax), np.int32)
+  cursor = 0
+  for i in range(b):
+    n = int(row_lens[i])
+    if n == 0:
+      continue
+    sl = slice(cursor, cursor + n)
+    row_of[sl] = i
+    col_of[sl] = np.arange(n)
+    pos[sl] = row_q_pos[i] + np.arange(n)
+    valid[sl] = True
+    row_cols[i, :n] = np.arange(cursor, cursor + n)
+    cursor += n
+  return RaggedRows(row_of=row_of, col_of=col_of, pos=pos, valid=valid,
+                    row_q_pos=row_q_pos, row_len=row_lens,
+                    row_cols=row_cols)

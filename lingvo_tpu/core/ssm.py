@@ -325,3 +325,26 @@ class GatedSSMLayer(base_layer.BaseLayer):
           lowering=self.p.scan_lowering)
     out = self._Finish(theta, y, v, gate)
     return out, NestedMap(state=s_new)
+
+  def RaggedStep(self, theta, query_vec, cached_states: NestedMap,
+                 block_tables, rows, collect_col_states: bool = False):
+    """Packed-token step (core/ragged.py RaggedRows): query_vec [1, T, D].
+
+    The O(1) recurrence is inherently per-row, so the ragged step is the
+    EXISTING PagedStep on a row view of the pack: gather each slot's chunk
+    off the token axis through rows.row_cols ([B, wmax, D]), run the
+    per-row-length scan (rows.row_len masks the tail as identity steps —
+    including whole rows with 0 tokens this step), scatter outputs back to
+    token order. rows.row_q_pos carries the slot-reuse reset trigger
+    (q_pos == 0), which is why 0-token live rows ride with their true
+    sequence position, never 0.
+    """
+    del block_tables
+    x_rows = query_vec[0][rows.row_cols]             # [B, wmax, D]
+    wmax = x_rows.shape[1]
+    out_rows, new_states = self.PagedStep(
+        theta, x_rows, cached_states, None, rows.row_q_pos, rows.row_len,
+        collect_col_states=collect_col_states)
+    row = jnp.clip(rows.row_of.astype(jnp.int32), 0, x_rows.shape[0] - 1)
+    col = jnp.clip(rows.col_of.astype(jnp.int32), 0, wmax - 1)
+    return out_rows[row, col][None], new_states

@@ -183,6 +183,21 @@ class TransformerAttentionLayer(base_layer.BaseLayer):
           theta.atten, x, cached_states, block_tables, q_pos, in_len)
     return query_vec + out, new_states
 
+  def RaggedStep(self, theta, query_vec, cached_states, block_tables, rows,
+                 ssm_col_states: bool = False):
+    """Packed-token continuous-batching step (core/ragged.py RaggedRows);
+    query_vec [1, T, D]. Same pre-LN/residual wrapper and spec-verify
+    dispatch as PagedStep — only the inner mixer contract changes."""
+    x = self.ln.FProp(theta.ln, query_vec)
+    if ssm_col_states and hasattr(self.atten, "StateBytesPerSlot"):
+      out, new_states = self.atten.RaggedStep(
+          theta.atten, x, cached_states, block_tables, rows,
+          collect_col_states=True)
+    else:
+      out, new_states = self.atten.RaggedStep(
+          theta.atten, x, cached_states, block_tables, rows)
+    return query_vec + out, new_states
+
 
 class TransformerLayer(base_layer.BaseLayer):
   """Self-atten (+ optional cross-atten) + FFN (ref `TransformerLayer:6265`)."""
@@ -289,6 +304,14 @@ class TransformerLayer(base_layer.BaseLayer):
     out = self.fflayer.FProp(theta.fflayer, x)
     return out, NestedMap(self_atten=new_sa)
 
+  def RaggedStep(self, theta, inputs, cached_states, block_tables, rows,
+                 ssm_col_states: bool = False):
+    x, new_sa = self.self_atten.RaggedStep(
+        theta.self_atten, inputs, cached_states.self_atten, block_tables,
+        rows, ssm_col_states=ssm_col_states)
+    out = self.fflayer.FProp(theta.fflayer, x)
+    return out, NestedMap(self_atten=new_sa)
+
 
 class StackedTransformerLayers(base_layer.BaseLayer):
   """N distinct transformer layers (ref `StackedTransformerLayers:7116`)."""
@@ -392,6 +415,20 @@ class StackedTransformerLayers(base_layer.BaseLayer):
       x, ns = layer.PagedStep(theta.x_layers[i], x,
                               cached_states.x_layers[i], block_tables, q_pos,
                               in_len, **kw)
+      new_states.x_layers.append(ns)
+    if self.p.final_ln:
+      x = self.final_ln.FProp(theta.final_ln, x)
+    return x, new_states
+
+  def RaggedStep(self, theta, inputs, cached_states, block_tables, rows,
+                 ssm_col_states: bool = False):
+    kw = {"ssm_col_states": True} if ssm_col_states else {}
+    x = inputs
+    new_states = NestedMap(x_layers=[])
+    for i, layer in enumerate(self.x_layers):
+      x, ns = layer.RaggedStep(theta.x_layers[i], x,
+                               cached_states.x_layers[i], block_tables,
+                               rows, **kw)
       new_states.x_layers.append(ns)
     if self.p.final_ln:
       x = self.final_ln.FProp(theta.final_ln, x)
@@ -547,6 +584,20 @@ class RepeatedTransformerLayer(base_layer.BaseLayer):
       theta_i, states_i = per_layer
       x, new_states = self.body.PagedStep(theta_i, carry, states_i,
                                           block_tables, q_pos, in_len, **kw)
+      return x, new_states
+
+    out, new_states = jax.lax.scan(_Body, inputs,
+                                   (theta.body, cached_states.body))
+    return out, NestedMap(body=new_states)
+
+  def RaggedStep(self, theta, inputs, cached_states, block_tables, rows,
+                 ssm_col_states: bool = False):
+    kw = {"ssm_col_states": True} if ssm_col_states else {}
+
+    def _Body(carry, per_layer):
+      theta_i, states_i = per_layer
+      x, new_states = self.body.RaggedStep(theta_i, carry, states_i,
+                                           block_tables, rows, **kw)
       return x, new_states
 
     out, new_states = jax.lax.scan(_Body, inputs,

@@ -457,6 +457,33 @@ class TransformerLm(base_model.BaseTask):
       logits = self.emb.Logits(theta.emb, x)
     return logits, new_states
 
+  def RaggedStep(self, theta, ids, states, block_tables, rows,
+                 ssm_col_states: bool = False):
+    """Packed-token continuous-batching step: ids [1, T] ->
+    (logits [1, T, vocab], states).
+
+    The ONE compiled serving program: token t belongs to engine slot
+    rows.row_of[t] at global kv slot rows.pos[t] (core/ragged.py
+    RaggedRows) — a decode row is 1 token, a prefill chunk several with
+    ascending positions, a spec-verify window row_k + 1, and padding
+    tokens (rows.valid == False) emit garbage logits the engine never
+    samples from. Position policy matches PagedStep: rotary positions are
+    the global slot indices, no absolute pos_emb (serve rotary models).
+    ssm_col_states as in PagedStep (per-column state trajectories for
+    spec-verify rollback, shaped [B, wmax, ...] here).
+    """
+    x = self.emb.EmbLookup(theta.emb, ids)
+    x, new_states = self.stack.RaggedStep(theta.stack, x, states,
+                                          block_tables, rows,
+                                          ssm_col_states=ssm_col_states)
+    x = self.final_ln.FProp(theta.final_ln, x)
+    if self.p.softmax_num_sampled > 0:
+      logits = self.sampled_softmax.Logits(
+          self.ChildTheta(theta, "sampled_softmax"), x)
+    else:
+      logits = self.emb.Logits(theta.emb, x)
+    return logits, new_states
+
   def PagedStepPrefix(self, theta, ids, states, block_tables, q_pos, in_len,
                       num_layers: int):
     """Early-exit PagedStep: run only the first num_layers of the stack,

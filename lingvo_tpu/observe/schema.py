@@ -81,7 +81,7 @@ GSHARD_TELEMETRY_KEYS = (
     "decode_state_bytes_per_seq",
     "kv_cache_dtype", "kv_bytes_per_token", "serve_int8_weights",
     "draft_tokens", "accepted_tokens", "accepted_len_hist",
-    "prefix_hit_tokens", "prefix_cache",
+    "prefix_hit_tokens", "prefix_cache", "step_programs",
 )
 
 # Keys both serving surfaces advertise (values must mean the same thing).
@@ -113,13 +113,28 @@ def TelemetryFromRegistry(registry, prefix: str = "serving/") -> dict:
       **{k: snap[prefix + k] for k in GSHARD_TELEMETRY_KEYS})
 
 
+# -- compiled-step-program census ---------------------------------------------
+
+# Names under which serving surfaces register per-step compiled programs
+# with observe.CompileLog. "ragged" is the unified single-program step;
+# decode/mixed/spec_verify are the legacy trio (step_mode='legacy').
+# Draft programs deliberately don't count: the census answers "how many
+# distinct shapes does one serving iteration dispatch through".
+STEP_PROGRAM_NAMES = frozenset({"ragged", "decode", "mixed", "spec_verify"})
+
+# The census key both serving surfaces expose: engine
+# Stats()["compile"]["step_programs"] and GShardDecode telemetry's
+# "step_programs" (2 per length bucket there — prefill + sample).
+COMPILE_CENSUS_KEY = "step_programs"
+
+
 # -- sub-surface key sets ----------------------------------------------------
 
 # serving/scheduler.py Scheduler.Stats()
 SCHEDULER_STATS_KEYS = frozenset({
     "slots", "slots_live", "slots_prefill", "slots_live_peak", "queue_depth",
     "admitted", "finished", "cancelled", "rejected_overlong",
-    "needs_kv_pages",
+    "needs_kv_pages", "prefix_ordered_admissions",
 })
 
 # serving/kv_cache.py PageAllocator.Stats() (page_bytes/pool_bytes only

@@ -303,6 +303,10 @@ class GShardDecode:
         # synchronous driver re-prefills every prompt, so no cache exists
         prefix_hit_tokens=0,
         prefix_cache=observe_schema.DisabledPrefixCacheStats(),
+        # compiled-step-program census, mirrored with the serving engine's
+        # Stats()["compile"]["step_programs"]: this driver compiles a
+        # (prefill, sample) program pair per (p_len, t_max) bucket
+        step_programs=2 * len(self._decode_fns),
     ))
     self._decodes.Inc()
     # the dict every result record carries is rebuilt FROM the registry —

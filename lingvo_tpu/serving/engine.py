@@ -1,32 +1,43 @@
 """ServingLoop: the continuous-batching serving engine driver.
 
-Glues the three layers below into a running service:
+Glues the four layers below into a running service:
 
-    ops/block_decode.py      the ragged paged attention kernels
-    serving/kv_cache.py      host-side page ownership
-    serving/scheduler.py     admission / step building / retirement
+    ops/ragged_block_attend.py   the packed-token paged attention kernel
+    ops/block_decode.py          the legacy-shape paged attention kernels
+    serving/kv_cache.py          host-side page ownership
+    serving/scheduler.py         admission / step building / retirement
 
-Device-side there are exactly TWO compiled programs, both shape-static:
-the pure decode step (`[B, 1]` token per live row) and the mixed step
-(`[B, prefill_chunk]`, prefilling rows consume prompt chunks while decode
-rows ride along with in_len == 1). Admission and eviction only rewrite
-int32 block tables between calls, so sequences enter and leave mid-flight
-with zero recompilation — the property that lets short requests overtake
-long ones instead of idling behind them (the batch-synchronous
-`GShardDecode` failure mode this engine replaces).
+Device-side there is ONE compiled step program (step_mode='ragged', the
+default): every serving iteration packs its work onto a single static
+[T] token axis (core/ragged.py) — a plain decode row contributes 1
+token, a prefilling row a token-budgeted prompt chunk, a speculating row
+its feedback token plus k draft tokens — and dispatches the same
+program. The legacy engine needed a separate compiled shape per step
+kind (pure decode `[B, 1]`, mixed `[B, prefill_chunk]`, spec-verify
+`[B, k+1]`), which cost extra compiles, forced whole-batch padding to
+the widest row, and serialized speculation behind prefill; the packed
+axis removes all three. Admission and eviction only rewrite int32 block
+tables between calls, so sequences enter and leave mid-flight with zero
+recompilation — the property that lets short requests overtake long
+ones instead of idling behind them (the batch-synchronous `GShardDecode`
+failure mode this engine replaces). `step_mode='legacy'` keeps the old
+two-to-three-program engine as the comparison baseline; its byte-exact
+equivalence to ragged mode at temperature 0 is asserted in tests.
 
-Speculative decoding (serving/spec_decode.py) adds a THIRD compiled step
-program when a draft source is configured (`spec=SelfDraft(...)` or
-`spec=ModelDraft(...)`): on pure-decode iterations the engine runs a
-draft pass proposing k tokens per row, then ONE ragged `[B, k+1]` VERIFY
-step — the mixed-step machinery re-used as "k+1 causal queries against a
-paged prefix" — and commits each row's accepted prefix plus a
-bonus/correction token, rolling write cursors back over rejected tails.
-At temperature 0 the output streams are token-identical to the non-spec
-engine (greedy acceptance keeps exactly the argmax prefix); at
-temperature > 0 residual speculative sampling preserves each request's
-seeded output distribution. Per-request `spec_k` on Submit() opts
-individual requests out (0) or caps their draft length.
+Speculative decoding (serving/spec_decode.py) configures a draft source
+(`spec=SelfDraft(...)` or `spec=ModelDraft(...)`): each iteration where
+at least one decode row speculates runs a draft pass proposing k tokens
+per such row, then the SAME unified step verifies them — spec rows are
+just width-(k+1) rows whose gathered logits flow through
+`SpecVerifyTokens` inside the one program — and commits each row's
+accepted prefix plus a bonus/correction token, rolling write cursors
+back over rejected tails. Prefilling neighbors ride the same step, so
+spec cycles no longer wait for pure-decode iterations. At temperature 0
+the output streams are token-identical to the non-spec engine (greedy
+acceptance keeps exactly the argmax prefix); at temperature > 0
+residual speculative sampling preserves each request's seeded output
+distribution. Per-request `spec_k` on Submit() opts individual requests
+out (0) or caps their draft length.
 
 Sampling: temperature 0 (default) is pure argmax — token-identical to
 batch-synchronous `GShardDecode`, the parity bar asserted in tests. With
@@ -66,6 +77,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lingvo_tpu import observe
+from lingvo_tpu.core import ragged as ragged_lib
 from lingvo_tpu.core import sampling
 from lingvo_tpu.observe import schema as observe_schema
 from lingvo_tpu.quant import kv as kv_quant
@@ -139,7 +151,9 @@ class ServingLoop:
                sample_seed: int = 0, kv_cache_dtype: Optional[str] = None,
                serve_int8_weights: bool = False, spec=None,
                prefix_cache=None, trace=True, metrics_registry=None,
-               serve_port: Optional[int] = None, watchdog=None):
+               serve_port: Optional[int] = None, watchdog=None,
+               step_mode: str = "ragged",
+               prefill_token_budget: Optional[int] = None):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
@@ -178,6 +192,15 @@ class ServingLoop:
     configured StallWatchdog (capture logdir, injectable clock); the
     engine heartbeats it per step and feeds it queue observations, and
     /healthz runs its Check() at scrape time.
+    step_mode: 'ragged' (default) serves every iteration through ONE
+    compiled packed-token program (core/ragged.py) — prefill chunks,
+    plain decode rows, and spec-verify rows share each step; 'legacy'
+    keeps the previous two-to-three-program engine (the byte-identity
+    and bench baseline this PR's tests compare against).
+    prefill_token_budget: ragged mode only — prompt tokens the packed
+    step reserves beyond the worst-case decode tokens (defaults to
+    prefill_chunk); decode capacity left idle by empty slots flows to
+    prefill on top of it.
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -279,6 +302,19 @@ class ServingLoop:
           page_size=page_size, prefill_chunk=prefill_chunk,
           temperature=self.temperature, top_k=self.top_k,
           sample_seed=self.sample_seed, compile_log=self._compile_log)
+    # unified ragged step geometry: T packed tokens cover every slot's
+    # worst-case decode width (1 + draft k) plus a prefill token budget;
+    # wmax is the widest single row the one compiled program admits
+    if step_mode not in ("ragged", "legacy"):
+      raise ValueError(
+          "step_mode must be 'ragged' or 'legacy', got %r" % (step_mode,))
+    self.step_mode = step_mode
+    self.prefill_token_budget = int(prefill_token_budget or prefill_chunk)
+    spec_width = (self.spec.k + 1) if self.spec is not None else 1
+    self._ragged_t = max_batch * spec_width + self.prefill_token_budget
+    self._ragged_wmax = max(spec_width, self.prefill_token_budget)
+    self._ragged_fn = self._BuildRaggedFn(task, donate)
+    self._zero_qlogits = None   # lazy [B, k, V] f32 (no-draft spec steps)
     # silent-fallback visibility: classify ONCE which attention path the
     # compiled step will take, and count ineligible (dense-fallback) steps
     self.paged_path = self._ClassifyPath()
@@ -371,6 +407,86 @@ class ServingLoop:
       suffix = ""
     base = "pallas" if jax.default_backend() == "tpu" else "xla"
     return base + suffix
+
+  # -- the unified ragged step program ---------------------------------------
+
+  def _BuildRaggedFn(self, task, donate):
+    """Jits THE serving step: packed-token forward + sampling (+ verify).
+
+    One program covers every iteration shape the legacy engine needed
+    two-to-three programs for: prefill chunks, plain decode rows, and
+    spec-verify rows are just rows of different length on the same [T]
+    token axis (core/ragged.py). Sampling is per TOKEN with each token
+    broadcasting its row's (seed, output-position) stream — bitwise the
+    legacy per-column draws, which sampled every chunk column with the
+    same row stream. When a draft source is configured the verify lane
+    is always computed (static structure): rows with row_k == 0 flow
+    through SpecVerifyTokens as all-invalid and their column-0 output
+    is exactly the plain draw, so no-draft steps run the SAME program
+    with zero q_logits rather than a second compiled shape.
+    """
+    temp, topk = self.temperature, self.top_k
+    base_key = self.sample_seed
+    b = self.max_batch
+    spec_k = self.spec.k if self.spec is not None else 0
+    collect = self.spec is not None and self.mixers["num_ssm"] > 0
+
+    if spec_k == 0:
+      def _RaggedStep(theta, states, tok_ids, rows, tables, seeds, pos):
+        logits, new_states = task.RaggedStep(theta, tok_ids[None], states,
+                                             tables, rows)
+        logits = logits[0]                                     # [T, V]
+        key = jax.random.PRNGKey(base_key)
+        row = jnp.clip(rows.row_of, 0, b - 1)
+        sampled = sampling.SampleFromLogits(
+            logits, key, temperature=temp, top_k=topk,
+            row_seeds=seeds[row], positions=pos[row])
+        return sampled, new_states
+    else:
+      def _RaggedStep(theta, states, tok_ids, rows, tables, seeds, pos,
+                      row_k, q_logits):
+        logits, new_states = task.RaggedStep(theta, tok_ids[None], states,
+                                             tables, rows,
+                                             ssm_col_states=collect)
+        logits = logits[0]                                     # [T, V]
+        key = jax.random.PRNGKey(base_key)
+        row = jnp.clip(rows.row_of, 0, b - 1)
+        sampled = sampling.SampleFromLogits(
+            logits, key, temperature=temp, top_k=topk,
+            row_seeds=seeds[row], positions=pos[row])
+        # verify lane: each row's first spec_k+1 token columns, gathered
+        # back to [B, k+1] — prefill/no-draft rows gather garbage that
+        # draft_valid masks out of acceptance entirely
+        vcols = rows.row_cols[:, :spec_k + 1]
+        v_logits = logits[vcols]
+        d_toks = tok_ids[vcols[:, 1:]]
+        draft_valid = (jnp.arange(spec_k, dtype=jnp.int32)[None]
+                       < row_k[:, None])
+        out, alen = sampling.SpecVerifyTokens(
+            v_logits, d_toks, q_logits, key, temperature=temp, top_k=topk,
+            row_seeds=seeds, row_pos=pos, draft_valid=draft_valid)
+        if collect:
+          # SSM trajectory restore: spec rows roll back to the accepted
+          # column; every other row keeps the state after its LAST real
+          # token (columns past row_len are identity steps, so the
+          # clipped index is exact for 0-token rows too)
+          restore = jnp.where(row_k > 0, alen,
+                              jnp.clip(rows.row_len - 1, 0, None))
+          new_states = spec_decode._SelectAcceptedCols(new_states, restore)
+        return sampled, out, alen, new_states
+
+    return jax.jit(_RaggedStep, donate_argnums=donate)
+
+  def _ZeroQLogits(self):
+    """All-zero draft logits for spec-engine steps where no row drafted
+    (still prefilling): the verify lane runs with draft_valid all-False,
+    so the values are never consumed — they only pin the one compiled
+    signature."""
+    if self._zero_qlogits is None:
+      self._zero_qlogits = jnp.zeros(
+          (self.max_batch, self.spec.k, self._task.p.vocab_size),
+          jnp.float32)
+    return self._zero_qlogits
 
   # -- prefix-cache support --------------------------------------------------
 
@@ -549,32 +665,129 @@ class ServingLoop:
   def StepOnce(self) -> int:
     """One admit → device step → commit iteration; returns #events.
 
-    With a draft source configured, pure-decode iterations where at least
-    one row speculates become draft → verify → commit cycles; mixed steps
-    (and all-opted-out batches) take the unchanged legacy path."""
-    with self._lock:
-      self.sched.EvictCancelled()
-      admitted = self.sched.Admit()
-      for seq in admitted:
-        h = self._handles.get(seq.id)
-        if h is not None and h.admit_time is None:
-          h.admit_time = time.perf_counter()
-        pages = 0
-        if self.sched.needs_kv_pages:
-          try:
-            pages = len(self.alloc.PagesOf(seq.id))
-          except KeyError:
-            pages = 0
-        self._pages_of[seq.id] = pages
-        if seq.reused_tokens > 0:
-          self._counters["prefix_hit_tokens"].Inc(seq.reused_tokens)
-          if self.trace is not None:
-            self.trace.PrefixHit(seq.id, seq.reused_tokens)
+    Ragged mode (default): every iteration — any mix of prefill chunks,
+    plain decode rows, and spec-verify rows — launches the ONE compiled
+    packed-token program; with a draft source, rows that speculate get a
+    draft pass first while prefilling neighbors ride the same step.
+    Legacy mode: pure-decode iterations where at least one row
+    speculates become draft → verify → commit cycles; mixed steps (and
+    all-opted-out batches) take the two-program path."""
+    if self.step_mode == "ragged":
+      return self._StepOnceRagged()
+    return self._StepOnceLegacy()
+
+  def _AdmitPhase(self):
+    """Evict + admit + per-admission bookkeeping (caller holds the lock)."""
+    self.sched.EvictCancelled()
+    admitted = self.sched.Admit()
+    for seq in admitted:
+      h = self._handles.get(seq.id)
+      if h is not None and h.admit_time is None:
+        h.admit_time = time.perf_counter()
+      pages = 0
+      if self.sched.needs_kv_pages:
+        try:
+          pages = len(self.alloc.PagesOf(seq.id))
+        except KeyError:
+          pages = 0
+      self._pages_of[seq.id] = pages
+      if seq.reused_tokens > 0:
+        self._counters["prefix_hit_tokens"].Inc(seq.reused_tokens)
         if self.trace is not None:
-          self.trace.Admit(seq.id, seq.slot, pages)
-      if self.prefix_cache is not None and admitted:
-        # split shared pages the new rows will write into BEFORE any step
-        self._RunCow(admitted)
+          self.trace.PrefixHit(seq.id, seq.reused_tokens)
+      if self.trace is not None:
+        self.trace.Admit(seq.id, seq.slot, pages)
+    if self.prefix_cache is not None and admitted:
+      # split shared pages the new rows will write into BEFORE any step
+      self._RunCow(admitted)
+
+  def _StepOnceRagged(self) -> int:
+    """One iteration through the unified ragged step program."""
+    with self._lock:
+      self._AdmitPhase()
+      spec_k = self.spec.k if self.spec is not None else 0
+      batch = self.sched.BuildRaggedStep(self._ragged_t, self._ragged_wmax,
+                                         spec_k=spec_k)
+      if batch is None:
+        return 0
+      tables = np.array(self.sched.block_tables)  # freeze under the lock
+      window = self._profile_window
+      if window is not None:
+        window.Start()
+    desc = batch.rows_desc
+    q_logits = None
+    if self.spec is not None:
+      if batch.any_spec:
+        # draft outside the lock (device work), exactly like the legacy
+        # spec cycle; the RaggedBatch speaks the StepBatch protocol with
+        # in_len > 0 only on drafting rows, so prefill rows ride the
+        # step without activating the draft pass
+        d_toks, q_logits = self.spec.Draft(self._theta, self._states,
+                                           batch, tables)
+        # one dtype for both the drafted and the no-draft (zeros) case:
+        # the verify program must keep a single compiled signature
+        q_logits = q_logits.astype(jnp.float32)
+        for i in range(self.max_batch):
+          rk = int(batch.row_k[i])
+          if rk > 0:
+            batch.tok_ids[desc.row_cols[i, 1:1 + rk]] = d_toks[i, :rk]
+      else:
+        q_logits = self._ZeroQLogits()
+    rows_dev = ragged_lib.RaggedRows(*(jnp.asarray(m) for m in desc))
+    args = [self._theta, self._states, jnp.asarray(batch.tok_ids),
+            rows_dev, jnp.asarray(tables), jnp.asarray(batch.row_seeds),
+            jnp.asarray(batch.row_pos)]
+    out = alen = None
+    if self.spec is not None:
+      args += [jnp.asarray(batch.row_k), q_logits]
+      sampled, out, alen, new_states = self._compile_log.Call(
+          "ragged", self._ragged_fn, *args)
+      out, alen = np.asarray(out), np.asarray(alen)
+    else:
+      sampled, new_states = self._compile_log.Call(
+          "ragged", self._ragged_fn, *args)
+    self._states = new_states
+    sampled = np.asarray(sampled)
+    with self._lock:
+      if self.trace is not None and batch.mixed:
+        # emit prefill-chunk spans BEFORE commit advances the cursors
+        for i, seq in enumerate(batch.rows):
+          n = int(desc.row_len[i])
+          if (seq is not None
+              and seq.state is scheduler_lib.SeqState.PREFILL and n > 0):
+            self.trace.PrefillChunk(seq.id, n)
+      events = self.sched.CommitRaggedStep(batch, sampled, out, alen)
+      self._counters["steps"].Inc()
+      self._counters["mixed_steps" if batch.mixed else "decode_steps"].Inc()
+      self._counters["prompt_tokens"].Inc(batch.prompt_tokens)
+      if self.paged_path == "dense":
+        self._counters["dense_fallback_steps"].Inc()
+      if self._kv_quantized:
+        self._counters["quantized_steps"].Inc()
+      if batch.any_spec:
+        self._counters["spec_cycles"].Inc()
+        for i, seq in enumerate(batch.rows):
+          rk = int(batch.row_k[i])
+          if (seq is None or rk == 0
+              or seq.state is scheduler_lib.SeqState.CANCELLED):
+            continue
+          m = min(int(alen[i]), rk)
+          self._counters["draft_tokens"].Inc(rk)
+          self._counters["accepted_tokens"].Inc(m)
+          self.spec.accepted_len_hist[m] += 1
+          if self.trace is not None:
+            self.trace.SpecVerify(seq.id, rk, m)
+            if rk - m > 0:
+              self.trace.Rollback(seq.id, rk - m)
+      self._PushEvents(events)
+      self._TickProfile()
+      self._BeatWatchdog()
+    return len(events)
+
+  def _StepOnceLegacy(self) -> int:
+    """One iteration through the legacy two-to-three-program engine."""
+    with self._lock:
+      self._AdmitPhase()
       vbatch = None
       if self.spec is not None:
         vbatch = self.sched.BuildVerifyStep(self.spec.k)
@@ -595,14 +808,6 @@ class ServingLoop:
         jnp.asarray(batch.row_pos))
     self._states = new_states
     sampled = np.asarray(sampled)
-    if self.spec is not None and batch.mixed:
-      # independent-draft ride-along: the draft state consumes the same
-      # prompt chunks the target just cached (before CommitStep mutates
-      # the rows' state/cursors)
-      prefill_rows = np.array([
-          s is not None and s.state is scheduler_lib.SeqState.PREFILL
-          for s in batch.rows])
-      self.spec.ConsumeStep(batch, prefill_rows)
     with self._lock:
       if self.trace is not None and batch.mixed:
         # emit prefill-chunk spans BEFORE CommitStep advances the cursors:
@@ -777,5 +982,12 @@ class ServingLoop:
         stats["trace"] = self.trace.Stats()
       if self.watchdog is not None:
         stats["watchdog"] = self.watchdog.Stats()
-      stats["compile"] = self._compile_log.Records()
+      records = self._compile_log.Records()
+      # compiled-step-program census: how many distinct per-step programs
+      # this engine has actually compiled (ragged mode: exactly 1 across
+      # any admit/decode/spec/retire mix — the tentpole's acceptance bar;
+      # legacy mode: up to 3). Draft programs are NOT step programs.
+      records[observe_schema.COMPILE_CENSUS_KEY] = sum(
+          1 for n in records if n in observe_schema.STEP_PROGRAM_NAMES)
+      stats["compile"] = records
     return stats

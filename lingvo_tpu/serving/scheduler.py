@@ -43,6 +43,7 @@ from typing import Optional
 
 import numpy as np
 
+from lingvo_tpu.core import ragged
 from lingvo_tpu.serving import kv_cache
 
 
@@ -137,6 +138,45 @@ class StepBatch:
     self.row_k = row_k          # [B] int32 or None
 
 
+class RaggedBatch:
+  """One packed ragged device step (numpy; the engine jits over it).
+
+  The unified replacement for all three StepBatch shapes: a decode row
+  carries 1 + row_k tokens (row_k > 0 is the spec-verify lane), a
+  prefill row a token-budgeted chunk, and every composition launches
+  through the SAME compiled program. `rows_desc` is the
+  core/ragged.RaggedRows routing pytree; `tok_ids` is the matching
+  packed [T] token stream — draft columns hold 0 until the engine fills
+  proposals at rows_desc.row_cols[i, 1:1+row_k[i]].
+
+  The row-level view (ids / q_pos / in_len / rows / row_seeds / row_pos
+  / row_k) deliberately speaks the StepBatch protocol so
+  spec_decode.SpecRunner.Draft consumes a RaggedBatch unchanged. in_len
+  is nonzero ONLY for rows that draft this step, so the draft pass
+  activates exactly those — prefill rows ride the same device step
+  without drafting, which is what lets spec cycles proceed while
+  admissions are still prefilling (the legacy engine had to finish every
+  prefill before its first verify step).
+  """
+
+  def __init__(self, tok_ids, rows_desc: ragged.RaggedRows, rows,
+               mixed: bool, prompt_tokens: int, row_seeds, row_pos,
+               row_k, any_spec: bool, ids0):
+    self.tok_ids = tok_ids        # [T] int32 packed token stream
+    self.rows_desc = rows_desc    # core/ragged.RaggedRows (numpy members)
+    self.rows = rows              # slot -> Sequence or None, frozen at build
+    self.mixed = mixed            # True if any prompt token rode this step
+    self.prompt_tokens = prompt_tokens
+    self.row_seeds = row_seeds    # [B] int32
+    self.row_pos = row_pos        # [B] int32
+    self.row_k = row_k            # [B] int32 draft slots this step
+    self.any_spec = any_spec      # host fast-path: Draft is skipped if False
+    # -- StepBatch-protocol adapter for the draft source ----------------
+    self.ids = ids0               # [B, 1] int32: column-0 feedback token
+    self.q_pos = rows_desc.row_q_pos
+    self.in_len = np.where(row_k > 0, 1, 0).astype(np.int32)
+
+
 class Scheduler:
   """Admission + step building + commit over B slots and a page pool."""
 
@@ -176,6 +216,8 @@ class Scheduler:
     self.cancelled = 0
     self.rejected_overlong = 0
     self.slots_live_peak = 0
+    # admissions where cached-prefix ordering picked past the FIFO head
+    self.prefix_ordered_admissions = 0
 
   # -- submission ------------------------------------------------------------
 
@@ -274,24 +316,60 @@ class Scheduler:
     seq.cow_pairs = cow
     return True
 
-  def Admit(self) -> list:
-    """FIFO-admits waiting requests into free slots while pages last.
+  def _NextWaiting(self) -> int:
+    """Index into self.waiting of the next admission candidate.
 
-    Head-of-line blocking on the pool is intentional: skipping a big
-    request to admit a small one behind it would starve the big one."""
+    Strict FIFO without a prefix cache. With one attached, reorders
+    WITHIN the admission head — the first max_slots queued requests —
+    preferring the largest cached-prefix match (FIFO breaks ties, so
+    all-miss windows degenerate to the legacy order). Admitting the
+    best-cached candidate first matters under pool pressure: its shared
+    pages get pinned (refcount > 1, un-evictable) before cache-missing
+    admissions squeeze the pool and evict them, so the same eviction
+    budget yields strictly more reused tokens. The window bound keeps
+    starvation no worse than head-of-line blocking: nothing deeper than
+    the head window ever jumps the queue, and a passed-over head is
+    retried every boundary."""
+    if self.prefix_cache is None or len(self.waiting) <= 1:
+      return 0
+    best, best_hit = 0, -1
+    for j, seq in enumerate(self.waiting):
+      if j >= self.max_slots:
+        break
+      hit = self.prefix_cache.PeekHitTokens(seq.req.prompt)
+      if hit > best_hit:
+        best, best_hit = j, hit
+    return best
+
+  def Admit(self) -> list:
+    """Admits waiting requests into free slots while pages last.
+
+    FIFO, except that within the head window the largest cached-prefix
+    match goes first (_NextWaiting). Head-of-line blocking on the pool
+    is intentional: skipping a big request to admit a small one behind
+    it would starve the big one — so when the cache-ordered pick fails
+    to fit, the true FIFO head still gets its legacy try, and admission
+    stops only when that fails too."""
     admitted = []
     for i in range(self.max_slots):
       if self.slots[i] is not None or not self.waiting:
         continue
-      seq = self.waiting[0]
       if self.needs_kv_pages:
+        pick = self._NextWaiting()
+        seq = self.waiting[pick]
         if not self._AdmitPages(seq):
-          break
-        self.waiting.popleft()
+          if pick == 0:
+            break
+          pick, seq = 0, self.waiting[0]
+          if not self._AdmitPages(seq):
+            break
+        if pick:
+          self.prefix_ordered_admissions += 1
+        del self.waiting[pick]
         pages = self.alloc.PagesOf(seq.id)
       else:
         # pure O(1)-mixer stack: nothing pages, a free slot IS admission
-        self.waiting.popleft()
+        seq = self.waiting.popleft()
         pages = []
       self.slots[i] = seq
       seq.state = SeqState.PREFILL
@@ -485,6 +563,166 @@ class Scheduler:
         self.alloc.NoteRollback(m + 1 - committed)
     return events
 
+  # -- unified ragged step ----------------------------------------------------
+
+  def BuildRaggedStep(self, t: int, wmax: int,
+                      spec_k: int = 0) -> Optional[RaggedBatch]:
+    """Packs every live slot into ONE [T]-token ragged step (None if idle).
+
+    t: packed token width — static, the engine sizes it once as
+    max_slots * (spec_k + 1) + prefill token budget, so every admit /
+    decode / spec / retire mix reuses one compiled program. wmax: widest
+    row the program admits (>= spec_k + 1). spec_k: engine draft length
+    (0 = no draft source configured).
+
+    Decode rows are mandatory and packed first: 1 feedback token plus
+    row_k draft slots, row_k clamped per request exactly like
+    BuildVerifyStep (request opt-out/cap, remaining max_new budget, and
+    wmax - 1). Prefill rows then consume the LEFTOVER budget in slot
+    order, each taking up to min(wmax, budget, prompt_remaining) prompt
+    tokens. Decode latency therefore never stalls behind prefill,
+    prefill rides every step instead of alternating with it, spec
+    cycles run while other rows are still prefilling, and decode
+    capacity left idle by empty slots flows to prefill instead of
+    padding. Rows that fit no budget this step ride with row_len == 0.
+    """
+    rows = list(self.slots)
+    if not any(s is not None for s in rows):
+      return None
+    b = self.max_slots
+    row_len = np.zeros((b,), np.int32)
+    row_q_pos = np.ones((b,), np.int32)  # empty slot: 1, never SSM-reset 0
+    row_seeds = np.zeros((b,), np.int32)
+    row_pos = np.zeros((b,), np.int32)
+    row_k = np.zeros((b,), np.int32)
+    ids0 = np.zeros((b, 1), np.int32)
+    budget = t
+    any_spec = False
+    for i, seq in enumerate(rows):
+      if seq is None:
+        continue
+      row_q_pos[i] = seq.pos
+      row_seeds[i] = seq.req.seed
+      row_pos[i] = len(seq.out)
+      if seq.state is not SeqState.DECODE:
+        continue
+      rk = 0
+      if spec_k > 0:
+        rk = spec_k if seq.req.spec_k is None else min(seq.req.spec_k, spec_k)
+        rk = min(rk, seq.req.max_new - len(seq.out), wmax - 1)
+        rk = max(rk, 0)
+      row_k[i] = rk
+      any_spec = any_spec or rk > 0
+      ids0[i, 0] = seq.out[-1]
+      row_len[i] = rk + 1
+      budget -= rk + 1
+    assert budget >= 0, (t, row_len)  # engine sizes t for worst-case decode
+    prompt_tokens = 0
+    for i, seq in enumerate(rows):
+      if seq is None or seq.state is not SeqState.PREFILL:
+        continue
+      n = min(wmax, budget, seq.prompt_remaining)
+      row_len[i] = n
+      budget -= n
+      prompt_tokens += n
+    desc = ragged.BuildRaggedRows(row_len, row_q_pos, t, wmax)
+    tok_ids = np.zeros((t,), np.int32)
+    for i, seq in enumerate(rows):
+      n = int(row_len[i])
+      if seq is None or n == 0:
+        continue
+      cols = desc.row_cols[i, :n]
+      if seq.state is SeqState.PREFILL:
+        tok_ids[cols] = seq.req.prompt[seq.pos:seq.pos + n]
+      else:
+        tok_ids[cols[0]] = seq.out[-1]  # draft columns stay 0 until Draft
+      if self.needs_kv_pages:
+        # same exclusivity invariant as BuildStep/BuildVerifyStep: every
+        # slot this row writes (and, on spec rollback, REWRITES) lives in
+        # pages CoW-private to it
+        self.alloc.AssertExclusive(seq.id, seq.pos, n)
+    return RaggedBatch(tok_ids, desc, rows, prompt_tokens > 0,
+                       prompt_tokens, row_seeds, row_pos, row_k, any_spec,
+                       ids0)
+
+  def _Finish(self, i: int, seq: Sequence, done_eos: bool):
+    """Retires slot i's sequence (shared CommitRaggedStep epilogue)."""
+    self.slots[i] = None
+    self.alloc.Free(seq.id)
+    if self.state_pool is not None:
+      self.state_pool.Release(seq.id)
+    self.finished += 1
+    self._Retire(seq, SeqState.FINISHED, "eos" if done_eos else "length")
+
+  def CommitRaggedStep(self, batch: RaggedBatch, sampled_tok: np.ndarray,
+                       out_tokens=None, accept_len=None) -> list:
+    """Folds one ragged step back in: CommitStep + CommitVerifyStep, unified.
+
+    sampled_tok [T]: the program's per-token draws — token t's draw is a
+    pure function of (engine seed, row seed, row output position), so a
+    prefill row reads its LAST prompt token's column and a plain decode
+    row its only column, exactly the draws the legacy [B, C] programs
+    made. out_tokens [B, k+1] / accept_len [B]: the verify lane, consumed
+    only by rows with row_k > 0 (their column-0 entry is bitwise the
+    plain draw, so routing rk == 0 rows through sampled_tok is
+    equivalent — and keeps the no-spec engine free of verify outputs).
+    Returns the same [(request_id, token, finished)] event list as the
+    legacy commits, possibly several events per speculating row."""
+    events = []
+    desc = batch.rows_desc
+    for i, seq in enumerate(batch.rows):
+      if seq is None or seq.state is SeqState.CANCELLED:
+        continue   # cancelled mid-step: drop the tokens, evict at boundary
+      n = int(desc.row_len[i])
+      if seq.state is SeqState.PREFILL:
+        if n == 0:
+          continue                       # out of token budget this step
+        seq.pos += n
+        if seq.prompt_remaining > 0:
+          continue                       # more prompt tokens to go
+        tok = int(sampled_tok[desc.row_cols[i, n - 1]])
+        seq.state = SeqState.DECODE
+        if self.prefix_cache is not None and self.needs_kv_pages:
+          n_full = len(seq.req.prompt) // self.alloc.page_size
+          if n_full > 0:
+            self.prefix_cache.Insert(
+                seq.req.prompt, self.alloc.PagesOf(seq.id)[:n_full])
+      elif seq.state is SeqState.DECODE:
+        rk = int(batch.row_k[i])
+        if rk > 0:
+          # spec-verify lane: accepted prefix + correction/bonus, cursor
+          # rollback over the rejected tail — CommitVerifyStep semantics
+          m = min(int(accept_len[i]), rk)
+          self.alloc.NoteRollback(rk - m)
+          committed = 0
+          for j in range(m + 1):
+            tok = int(out_tokens[i, j])
+            seq.pos += 1        # verify wrote this column's K/V already
+            seq.out.append(tok)
+            committed += 1
+            done_eos = (seq.req.eos_id is not None and tok == seq.req.eos_id)
+            if done_eos or len(seq.out) >= seq.req.max_new:
+              self._Finish(i, seq, done_eos)
+              events.append((seq.id, tok, True))
+              break
+            events.append((seq.id, tok, False))
+          if committed < m + 1:
+            # accepted tokens truncated by an early eos roll back too
+            self.alloc.NoteRollback(m + 1 - committed)
+          continue
+        seq.pos += 1                     # the fed-back token is now cached
+        tok = int(sampled_tok[desc.row_cols[i, 0]])
+      else:
+        continue
+      seq.out.append(tok)
+      done_eos = (seq.req.eos_id is not None and tok == seq.req.eos_id)
+      if done_eos or len(seq.out) >= seq.req.max_new:
+        self._Finish(i, seq, done_eos)
+        events.append((seq.id, tok, True))
+      else:
+        events.append((seq.id, tok, False))
+    return events
+
   def _Retire(self, seq: Sequence, state: SeqState, reason: str):
     seq.state = state
     seq.finish_reason = reason
@@ -507,4 +745,5 @@ class Scheduler:
         "rejected_overlong": self.rejected_overlong,
         "needs_kv_pages": self.needs_kv_pages,
         "slots_live_peak": self.slots_live_peak,
+        "prefix_ordered_admissions": self.prefix_ordered_admissions,
     }

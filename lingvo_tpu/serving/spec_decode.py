@@ -36,9 +36,13 @@ of the continuous-batching engine with NO new kernels:
    restores each slot to the last accepted column on device, inside the
    same compiled verify program.
 
-The engine ends up with a THIRD compiled step program (verify, [B, k+1])
-plus the draft program(s); admission/eviction still only rewrite int32
-block tables.
+Step-program cost: in the engine's default ragged mode the verify lane is
+FOLDED INTO the one unified step program (spec rows are simply rows of
+width k+1 on the packed token axis, and SpecVerifyTokens runs on their
+gathered logits inside the same jit), so speculation adds only the draft
+program(s). In legacy mode the verify step is a THIRD compiled step
+program ([B, k+1]) next to decode and mixed. Either way,
+admission/eviction still only rewrite int32 block tables.
 """
 
 from __future__ import annotations
@@ -327,35 +331,18 @@ class SpecRunner:
     pl = len(seq.req.prompt)
     return seq.req.prompt[idx] if idx < pl else seq.out[idx - pl]
 
-  def ConsumeStep(self, batch, prefill_rows: np.ndarray):
-    """Mixed-step ride-along: the draft state consumes the same prompt
-    chunks the target just cached, so prompt prefill never shows up as
-    catch-up backlog. No-op for SelfDraft (no separate draft state)."""
-    if self.is_self:
-      return
-    in_len = batch.in_len * prefill_rows.astype(np.int32)
-    # prefix-cache admitted rows prefill from the first UNCACHED token, so
-    # the chunk on the wire starts at q_pos > draft_pos — riding along
-    # would skip the draft state over the cached prefix. Leave those rows
-    # to _DrainBacklog, which replays the full committed stream from
-    # draft_pos (host-side tokens, q_pos == 0 reset included).
-    for i, seq in enumerate(batch.rows):
-      if seq is not None and in_len[i] and seq.draft_pos != int(batch.q_pos[i]):
-        in_len[i] = 0
-    if not in_len.any():
-      return
-    self.draft_states = self._consume_fn(
-        self.draft_theta, self.draft_states, jnp.asarray(batch.ids),
-        jnp.asarray(batch.q_pos), jnp.asarray(in_len))
-    for i, seq in enumerate(batch.rows):
-      if seq is not None and in_len[i]:
-        seq.draft_pos += int(in_len[i])
-
   def _DrainBacklog(self, rows, row_k):
     """Catches the draft state up when a row's backlog outgrew the k+1
-    catch-up window (it sat in mixed steps emitting one token per step
-    while neighbors prefilled). Runs the consume program in
-    prefill_chunk-wide bites; steady state never enters the loop."""
+    catch-up window — most commonly the row just finished prompt prefill
+    (the draft state never consumes the prompt on the wire; it replays
+    the committed stream host-side, which also covers prefix-cache
+    admissions whose prefill skipped cached tokens entirely). Runs the
+    consume program in prefill_chunk-wide bites before the row's first
+    draft; steady state never enters the loop. This replaced the legacy
+    mixed-step ConsumeStep ride-along, whose prefill-row masking special
+    case existed only because the old engine gave prefill its own step
+    shape — under the unified ragged step there is no separate mixed
+    step to ride."""
     cp = self._prefill_chunk
     while True:
       todo = []

@@ -559,9 +559,11 @@ class TestEngineObservabilitySoak:
       assert r.prefill_chunks >= 1
     assert eng.trace.Stats()["requests_open"] == 0
 
-    # compile records: both step programs ran through the AOT path
-    assert stats["compile"]["mixed"]["calls"] > 0
-    assert "fallback" not in stats["compile"]["mixed"]
+    # compile records: THE unified step program ran through the AOT path
+    # — and it is the only step program this engine ever compiled
+    assert stats["compile"]["ragged"]["calls"] > 0
+    assert "fallback" not in stats["compile"]["ragged"]
+    assert stats["compile"]["step_programs"] == 1
 
     # registry delta over the soak window matches the streamed tokens
     delta = eng.metrics.Delta(prev)

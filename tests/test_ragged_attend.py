@@ -1,0 +1,194 @@
+"""ops/ragged_block_attend.py: the unified ragged kernel's twin contract.
+
+The op that collapses decode / chunked prefill / spec-verify into one
+program must hold the same guarantees each specialized op held:
+- XLA twin == Pallas(interpret) BITWISE, including dead-page clamp,
+  q_len=1 degenerate rows, q_end=0 padding tokens, and page reuse after a
+  real allocator eviction;
+- stale block-table entries (freed/foreign pages) never leak into output;
+- an all-decode token pack reproduces `BlockDecode` bit for bit and a
+  prefill pack reproduces `BlockPrefill` (same `_PageAttend` float-op
+  sequence) — the "three programs become views of one op" claim, at the
+  op level;
+- the int8 path stays bitwise-twinned through the shared `_DequantPages`.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lingvo_tpu.ops import block_decode
+from lingvo_tpu.ops import ragged_block_attend
+from lingvo_tpu.quant import kv as kv_quant
+from lingvo_tpu.serving import kv_cache
+
+
+def _QuantizePools(k_pool, v_pool):
+  k8, ks = kv_quant.QuantizeKv(jnp.asarray(k_pool))
+  v8, vs = kv_quant.QuantizeKv(jnp.asarray(v_pool))
+  return (k8, jnp.swapaxes(ks, 1, 2).astype(jnp.float32),
+          v8, jnp.swapaxes(vs, 1, 2).astype(jnp.float32))
+
+
+class TestRaggedAttend:
+
+  def _Inputs(self, b=3, t_pages=2, page=8, n=1, h=8, t=8, seed=0):
+    rng = np.random.RandomState(seed)
+    np_total = b * t_pages + 1
+    q = rng.randn(t, n, h).astype(np.float32)
+    k_pool = rng.randn(np_total, page, n, h).astype(np.float32)
+    v_pool = rng.randn(np_total, page, n, h).astype(np.float32)
+    tables = rng.permutation(np_total - 1).reshape(b, t_pages).astype(
+        np.int32)
+    return q, k_pool, v_pool, tables
+
+  @staticmethod
+  def _DenseRef(q, k_pool, v_pool, tables, row_of, q_end):
+    """numpy masked softmax per packed token over its row's gathered view."""
+    t, n, h = q.shape
+    out = np.zeros_like(q)
+    for ti in range(t):
+      end = int(q_end[ti])
+      if end == 0:
+        continue
+      row = int(row_of[ti])
+      k = k_pool[tables[row]].reshape(-1, n, h)[:end]
+      v = v_pool[tables[row]].reshape(-1, n, h)[:end]
+      s = np.einsum("nh,snh->ns", q[ti], k)
+      s = s - s.max(axis=-1, keepdims=True)
+      p = np.exp(s)
+      p /= p.sum(axis=-1, keepdims=True)
+      out[ti] = np.einsum("ns,snh->nh", p, v)
+    return out
+
+  def _Both(self, q, kp, vp, tables, row_of, q_end, page=8, **kw):
+    out_x = ragged_block_attend.RaggedAttend(
+        jnp.asarray(q), kp, vp, jnp.asarray(tables), jnp.asarray(row_of),
+        jnp.asarray(q_end), page_size=page, lowering="xla", **kw)
+    out_p = ragged_block_attend.RaggedAttend(
+        jnp.asarray(q), kp, vp, jnp.asarray(tables), jnp.asarray(row_of),
+        jnp.asarray(q_end), page_size=page, lowering="pallas",
+        interpret=True, **kw)
+    np.testing.assert_array_equal(np.asarray(out_x), np.asarray(out_p))
+    return np.asarray(out_x)
+
+  def test_mixed_rows_match_dense_reference(self):
+    """One pack spanning the full row spectrum: a q_len=1 decode token, a
+    3-token prefill chunk, a 3-token verify window, and a padding token."""
+    q, k_pool, v_pool, tables = self._Inputs()
+    #       decode row0 | prefill row1 (slots 4,5,6) | verify row2 | pad
+    row_of = np.array([0, 1, 1, 1, 2, 2, 2, 0], np.int32)
+    q_end = np.array([9, 5, 6, 7, 12, 13, 14, 0], np.int32)
+    out = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                     row_of, q_end)
+    ref = self._DenseRef(q, k_pool, v_pool, tables, row_of, q_end)
+    np.testing.assert_allclose(out, ref, atol=5e-6)
+    # the padding token is exactly zero, not NaN
+    np.testing.assert_array_equal(out[7], np.zeros_like(out[7]))
+
+  def test_stale_table_entries_never_leak(self):
+    """Table entries past a token's horizon may point anywhere (freed or
+    foreign pages); they must not change the output."""
+    q, k_pool, v_pool, tables = self._Inputs()
+    row_of = np.array([0, 1, 1, 2, 2, 2, 0, 1], np.int32)
+    q_end = np.array([3, 5, 6, 2, 3, 4, 4, 7], np.int32)  # page 1 dead
+    out1 = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                      row_of, q_end)
+    hostile = tables.copy()
+    hostile[:, 1] = [tables[1, 0], tables[2, 0], tables[0, 0]]  # alias
+    out2 = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), hostile,
+                      row_of, q_end)
+    np.testing.assert_array_equal(out1, out2)
+
+  def test_all_decode_pack_bitwise_equals_block_decode(self):
+    """T tokens with one token per row reproduce BlockDecode exactly —
+    the decode program was already this op."""
+    q, k_pool, v_pool, tables = self._Inputs(b=3, t=3)
+    lens = np.array([5, 16, 1], np.int32)
+    row_of = np.arange(3, dtype=np.int32)
+    out_r = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                       row_of, lens)
+    out_b = block_decode.BlockDecode(
+        jnp.asarray(q)[:, None], jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(lens), page_size=8, lowering="xla")
+    np.testing.assert_array_equal(out_r, np.asarray(out_b)[:, 0])
+
+  def test_prefill_pack_bitwise_equals_block_prefill(self):
+    """A packed prefill chunk reproduces BlockPrefill exactly — causal
+    masking within the chunk is just each token's shorter horizon."""
+    q, k_pool, v_pool, tables = self._Inputs(b=2, t=6)
+    q_pos = np.array([2, 8], np.int32)
+    in_len = np.array([3, 3], np.int32)
+    row_of = np.array([0, 0, 0, 1, 1, 1], np.int32)
+    q_end = np.array([3, 4, 5, 9, 10, 11], np.int32)   # q_pos + c + 1
+    out_r = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                       row_of, q_end)
+    out_p = block_decode.BlockPrefill(
+        jnp.asarray(q).reshape(2, 3, 1, 8), jnp.asarray(k_pool),
+        jnp.asarray(v_pool), jnp.asarray(tables), jnp.asarray(q_pos),
+        jnp.asarray(in_len), page_size=8)
+    np.testing.assert_allclose(out_r.reshape(2, 3, 1, 8), np.asarray(out_p),
+                               atol=5e-6)
+
+  def test_twins_bitwise_equal_incl_page_reuse(self):
+    """XLA == Pallas(interpret) bitwise before AND after a real allocator
+    frees one sequence's pages and hands them to another (pool bytes
+    overwritten in place — exactly what eviction + admission does)."""
+    q, k_pool, v_pool, tables = self._Inputs(b=2, t=5)
+    k_pool = jnp.asarray(k_pool)
+    v_pool = jnp.asarray(v_pool)
+    row_of = np.array([0, 1, 1, 1, 0], np.int32)
+    q_end = np.array([5, 14, 15, 16, 0], np.int32)
+    self._Both(q, k_pool, v_pool, tables, row_of, q_end)
+
+    alloc = kv_cache.PageAllocator(num_pages=4, page_size=8)
+    alloc.Allocate("a", 2)
+    alloc.Allocate("b", 2)
+    alloc.Free("a")
+    reused = alloc.Allocate("c", 2)
+    assert reused == [0, 1]
+    rng = np.random.RandomState(7)
+    for pg in reused:
+      k_pool = k_pool.at[pg].set(rng.randn(8, 1, 8).astype(np.float32))
+      v_pool = v_pool.at[pg].set(rng.randn(8, 1, 8).astype(np.float32))
+    tables2 = np.array([reused, list(alloc.PagesOf("b"))], np.int32)
+    q_end2 = np.array([10, 14, 15, 16, 12], np.int32)
+    row_of2 = np.array([0, 1, 1, 1, 0], np.int32)
+    out = self._Both(q, k_pool, v_pool, tables2, row_of2, q_end2)
+    ref = self._DenseRef(q, np.asarray(k_pool), np.asarray(v_pool),
+                         tables2, row_of2, q_end2)
+    np.testing.assert_allclose(out, ref, atol=5e-6)
+
+  def test_int8_twins_bitwise_and_match_float_on_dequant(self):
+    """int8 XLA == int8 Pallas(interpret) bitwise, and both == the float
+    kernel run on elementwise-dequantized pools: dequantize-on-read is the
+    ONLY thing the quantized path adds."""
+    q, k_pool, v_pool, tables = self._Inputs()
+    k8, ks, v8, vs = _QuantizePools(k_pool, v_pool)
+    kf = kv_quant.DequantKv(k8.swapaxes(1, 2), ks).swapaxes(1, 2)
+    vf = kv_quant.DequantKv(v8.swapaxes(1, 2), vs).swapaxes(1, 2)
+    row_of = np.array([0, 1, 1, 1, 2, 2, 2, 0], np.int32)
+    q_end = np.array([9, 5, 6, 7, 12, 13, 14, 0], np.int32)
+    out_q = self._Both(q, k8, v8, tables, row_of, q_end,
+                       k_scale=ks, v_scale=vs)
+    out_f = ragged_block_attend.RaggedAttend(
+        jnp.asarray(q), kf, vf, jnp.asarray(tables), jnp.asarray(row_of),
+        jnp.asarray(q_end), page_size=8, lowering="xla")
+    np.testing.assert_array_equal(out_q, np.asarray(out_f))
+
+  @pytest.mark.slow
+  def test_twin_sweep_over_horizon_grid(self):
+    """Twin equality across horizon grids incl. 0, 1, and capacity."""
+    q, k_pool, v_pool, tables = self._Inputs(b=4, t_pages=2, t=4)
+    row_of = np.arange(4, dtype=np.int32)
+    for ends in ([0, 1, 8, 16], [16, 16, 16, 16], [0, 0, 0, 0],
+                 [7, 9, 15, 3]):
+      self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                 row_of, np.asarray(ends, np.int32))
+
+  def test_supported_on_tpu_gate_is_shared(self):
+    assert ragged_block_attend.SupportedOnTpu(128, 128)
+    assert not ragged_block_attend.SupportedOnTpu(8, 128)
+    assert not ragged_block_attend.SupportedOnTpu(128, 8)

@@ -509,7 +509,11 @@ class TestServingEngine:
                           "kv_cache_dtype", "kv_bytes_per_token",
                           "serve_int8_weights", "draft_tokens",
                           "accepted_tokens", "accepted_len_hist",
-                          "prefix_hit_tokens", "prefix_cache"}
+                          "prefix_hit_tokens", "prefix_cache",
+                          "step_programs"}
+    # compiled-step-program census: one (p_len, t_max) bucket was used,
+    # and this driver compiles a (prefill, sample) program pair per bucket
+    assert telem["step_programs"] == 2
     # the literal set above IS the shared schema: the telemetry dict is
     # generated from observe.schema, so any key added to one surface
     # without the other now fails here, not in a bench comparison
