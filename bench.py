@@ -837,8 +837,13 @@ def _BenchSpecDecode(jax, jnp, model_registry, on_tpu, variants=None):
   rate/histogram (the whole game: a rejected draft token is wasted
   draft+verify compute), p50/p99 latency, and rollback accounting.
 
-  variants: [(draft_source, k)] with draft_source in {"self", "model"};
-  default [("self", 8)] — the sweep tool ladders the full grid.
+  variants: [(draft_source, k)] or [(draft_source, k, w)] with
+  draft_source in {"self", "model"} and w the draft-tree width (default 1
+  = chain speculation); the default pair — chain k=8 vs the
+  same-verify-width w=2 k=4 tree — reports `tree_vs_chain_speedup`, the
+  tentpole's acceptance bar: at equal packed columns per row, sibling
+  hedging must buy tokens/sec, not just acceptance depth. The sweep tool
+  ladders the full (draft, k, w) grid.
   """
   from lingvo_tpu.serving import engine as engine_lib
   from lingvo_tpu.serving import spec_decode
@@ -903,10 +908,10 @@ def _BenchSpecDecode(jax, jnp, model_registry, on_tpu, variants=None):
   draft_task.FinalizePaths()
   draft_theta = draft_task.InstantiateVariables(jax.random.PRNGKey(7))
 
-  def _MakeSpec(source, k):
+  def _MakeSpec(source, k, w=1):
     if source == "self":
-      return spec_decode.SelfDraft(k=k, num_layers=1)
-    return spec_decode.ModelDraft(draft_task, draft_theta, k=k)
+      return spec_decode.SelfDraft(k=k, num_layers=1, w=w)
+    return spec_decode.ModelDraft(draft_task, draft_theta, k=k, w=w)
 
   def _Play(spec):
     """Plays the stream in real time; returns (outputs, wall, lat, stats)."""
@@ -958,15 +963,19 @@ def _BenchSpecDecode(jax, jnp, model_registry, on_tpu, variants=None):
       },
       "variants": [],
   }
-  for source, k in (variants or [("self", 8)]):
-    outs, wall, lat, stats = _Play(_MakeSpec(source, k))
+  for variant in (variants or [("self", 8), ("self", 4, 2)]):
+    source, k = variant[0], variant[1]
+    w = variant[2] if len(variant) > 2 else 1
+    outs, wall, lat, stats = _Play(_MakeSpec(source, k, w))
     # the bar that makes the speedup honest: byte-identical greedy streams
-    assert outs == base_outs, f"spec({source}, k={k}) diverged from greedy"
+    assert outs == base_outs, (
+        f"spec({source}, k={k}, w={w}) diverged from greedy")
     tps = total_useful / wall
     drafted = stats["draft_tokens"]
     result["variants"].append({
         "draft": source,
         "k": k,
+        "w": w,
         "draft_layers": 1 if source == "self" else draft_task.p.num_layers,
         "wall_s": round(wall, 3),
         "tokens_per_sec": round(tps, 1),
@@ -975,13 +984,23 @@ def _BenchSpecDecode(jax, jnp, model_registry, on_tpu, variants=None):
         "output_streams_identical": True,
         "steps": stats["steps"],
         "spec_cycles": stats["spec_cycles"],
+        "spec_branches": stats["spec_branches"],
+        "spec_width_clamps": stats["spec_width_clamps"],
         "acceptance_rate": round(
             stats["accepted_tokens"] / max(drafted, 1), 3),
         "accepted_len_hist": stats["accepted_len_hist"],
+        "accepted_depth_hist": stats["accepted_depth_hist"],
         "rolled_back_tokens": stats["kv_pages"]["rolled_back_tokens"],
     })
   best = max(v["tokens_per_sec_speedup"] for v in result["variants"])
   result["tokens_per_sec_speedup"] = best
+  chains = [v for v in result["variants"] if v["w"] == 1]
+  trees = [v for v in result["variants"] if v["w"] > 1]
+  if chains and trees:
+    # the tentpole's bar: the best tree arm vs the best chain arm
+    result["tree_vs_chain_speedup"] = round(
+        max(t["tokens_per_sec"] for t in trees)
+        / max(max(c["tokens_per_sec"] for c in chains), 1e-9), 3)
   return result
 
 

@@ -749,12 +749,17 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     pos = rows.pos.astype(jnp.int32)                               # [T]
     valid = rows.valid
     row = jnp.clip(rows.row_of.astype(jnp.int32), 0, b - 1)
+    # Tree rows decouple the KV SLOT (pos, DFS-ordered, collision-free)
+    # from the LOGICAL position (pos_ids = q_pos + depth) a token embeds
+    # at; on chain rows pos_ids == pos bitwise.
+    rot_pos = rows.pos_ids.astype(jnp.int32)
+    q_start = rows.row_q_pos.astype(jnp.int32)[row]                # [T]
     q = self._HeadsProj(theta, "query", query_vec)                 # [1,T,N,H]
     k_new = self._HeadsProj(theta, "key", query_vec)
     v_new = self._HeadsProj(theta, "value", query_vec)
     if p.use_rotary_position_emb:
       rt = self.ChildTheta(theta, "rotary")
-      posf = pos[None].astype(jnp.float32)
+      posf = rot_pos[None].astype(jnp.float32)
       q = self.rotary.FProp(rt, q, position=posf)
       k_new = self.rotary.FProp(rt, k_new, position=posf)
     q = self._ScaleQuery(theta, q)
@@ -788,7 +793,8 @@ class MultiHeadedAttention(base_layer.BaseLayer):
     if eligible:
       ctx = ragged_block_attend.RaggedAttend(
           q[0], k_pool, v_pool, block_tables, row, q_end,
-          page_size=page_size, k_scale=k_scale, v_scale=v_scale)[None]
+          page_size=page_size, k_scale=k_scale, v_scale=v_scale,
+          q_start=q_start, anc_lo=rows.anc_lo, anc_hi=rows.anc_hi)[None]
     else:
       # gather-dense fallback at token granularity: each token is a batch
       # row of one query over its row's materialized cache view (handles
@@ -804,7 +810,13 @@ class MultiHeadedAttention(base_layer.BaseLayer):
       # padding tokens see slot 0 only (garbage, but never an all-masked
       # softmax row)
       horizon = jnp.where(valid, pos, 0)
-      mask = jnp.where(slot <= horizon[:, None, None, None], 0.0, _NEG_INF)
+      ok = ragged_block_attend._AncestorOk(
+          slot, slot - q_start[:, None, None, None],
+          rows.anc_lo[:, None, None, None], rows.anc_hi[:, None, None, None])
+      # padding tokens keep their slot-0 escape hatch regardless of mask
+      ok = ok | ~valid[:, None, None, None]
+      mask = jnp.where(
+          (slot <= horizon[:, None, None, None]) & ok, 0.0, _NEG_INF)
       ctx, _ = self._Atten(theta, q[0][:, None], k_dense[row],
                            v_dense[row], mask)
       ctx = ctx[:, 0][None]

@@ -36,6 +36,22 @@ Invariants the builder (serving/scheduler.py BuildRaggedStep) maintains:
   row_q_pos == 1.
 - `valid` padding tokens carry row_of/pos clipped into range so device
   gathers stay in bounds.
+
+Tree speculation (PR 18) packs a token TREE per speculating row in DFS
+preorder on the same axis: the root (last committed token) at column 0 and
+draft node j at column j+1. Every node keeps its OWN kv slot (`pos` stays
+`row_q_pos + col`, so the scatter has no sibling collisions), while the
+LOGICAL position a node embeds/attends at is `row_q_pos + depth(node)` —
+that is `pos_ids`, which only diverges from `pos` on tree rows. In-step
+visibility is the ancestor chain: token t may attend step column c of its
+row iff c is an ancestor-or-self, encoded as a 64-bit column bitmask split
+into `anc_lo`/`anc_hi` (tree rows are capped at 64 packed columns; the
+scheduler clamps width before depth under that cap). Chain rows ship the
+sentinel -1/-1 (all columns visible), which keeps the attention mask
+bitwise-identical to the pre-tree kernel. `col_parent` is the ROW-view
+twin of the same structure: the parent COLUMN of each packed column
+(-1 = no in-step parent, i.e. the row's incoming recurrent state), which
+is what the SSM tree scan gathers its per-column initial state from.
 """
 
 from __future__ import annotations
@@ -59,14 +75,62 @@ class RaggedRows(NamedTuple):
   row_q_pos: jnp.ndarray  # [B] int32  row's first-token global position
   row_len: jnp.ndarray    # [B] int32  tokens the row carries this step
   row_cols: jnp.ndarray   # [B, wmax] int32  token-axis gather indices
+  pos_ids: jnp.ndarray   # [T] int32  logical position (rotary); == pos on chains
+  anc_lo: jnp.ndarray    # [T] int32  in-step ancestor bitmask, columns 0..31
+  anc_hi: jnp.ndarray    # [T] int32  in-step ancestor bitmask, columns 32..63
+  col_parent: jnp.ndarray  # [B, wmax] int32  parent column (-1 = row state)
 
 
-def BuildRaggedRows(row_lens, row_q_pos, t: int, wmax: int) -> RaggedRows:
+MAX_TREE_COLS = 64  # anc_lo/anc_hi bit budget; scheduler clamps width first.
+
+
+def TreeDepths(parents) -> np.ndarray:
+  """Draft-node depths from DFS parent pointers.
+
+  parents: [R] ints, parent DRAFT index of each draft node (-1 = child of
+  the root/committed token). DFS preorder guarantees parents[j] < j.
+  Returns [R] depths, root children at depth 1.
+  """
+  parents = np.asarray(parents, np.int32)
+  depth = np.zeros(parents.shape, np.int32)
+  for j, p in enumerate(parents):
+    assert p < j, (j, p)
+    depth[j] = 1 if p < 0 else depth[p] + 1
+  return depth
+
+
+def TreeAncestorMasks(parents) -> tuple[np.ndarray, np.ndarray]:
+  """Per-COLUMN ancestor bitmasks (lo, hi) from DFS parent pointers.
+
+  Column 0 is the root; draft j lives at column j+1. Bit c of column
+  mask[j] is set iff step column c is an ancestor-or-self of column j.
+  Returns two [R+1] int32 arrays (bits 0..31 / 32..63).
+  """
+  parents = np.asarray(parents, np.int32)
+  r = parents.shape[0]
+  assert r + 1 <= MAX_TREE_COLS, (r, MAX_TREE_COLS)
+  masks = np.zeros((r + 1,), np.int64)
+  masks[0] = 1
+  for j, p in enumerate(parents):
+    col = j + 1
+    masks[col] = masks[p + 1] | (np.int64(1) << col)
+  lo = (masks & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+  hi = ((masks >> 32) & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+  return lo, hi
+
+
+def BuildRaggedRows(row_lens, row_q_pos, t: int, wmax: int,
+                    row_parents=None) -> RaggedRows:
   """Host-side builder: per-row (q_pos, len) -> a packed RaggedRows.
 
   row_lens/row_q_pos: [B] ints. Rows are packed in slot order; the caller
   guarantees sum(row_lens) <= t and max(row_lens) <= wmax. Returns numpy
   arrays (the engine ships them device-side per step like StepBatch).
+
+  row_parents: optional {slot: [row_len-1] parent pointers} for TREE rows
+  (draft j's parent draft index, -1 = root). Rows absent from the dict are
+  chains: pos_ids == pos, anc masks -1 (all visible), col_parent c-1 —
+  all bitwise-neutral against the pre-tree program.
   """
   row_lens = np.asarray(row_lens, np.int32)
   row_q_pos = np.asarray(row_q_pos, np.int32)
@@ -78,6 +142,10 @@ def BuildRaggedRows(row_lens, row_q_pos, t: int, wmax: int) -> RaggedRows:
   pos = np.zeros((t,), np.int32)
   valid = np.zeros((t,), bool)
   row_cols = np.zeros((b, wmax), np.int32)
+  pos_ids = np.zeros((t,), np.int32)
+  anc_lo = np.full((t,), -1, np.int32)
+  anc_hi = np.full((t,), -1, np.int32)
+  col_parent = np.tile(np.arange(-1, wmax - 1, dtype=np.int32), (b, 1))
   cursor = 0
   for i in range(b):
     n = int(row_lens[i])
@@ -89,7 +157,20 @@ def BuildRaggedRows(row_lens, row_q_pos, t: int, wmax: int) -> RaggedRows:
     pos[sl] = row_q_pos[i] + np.arange(n)
     valid[sl] = True
     row_cols[i, :n] = np.arange(cursor, cursor + n)
+    parents = None if row_parents is None else row_parents.get(i)
+    if parents is not None:
+      parents = np.asarray(parents, np.int32)
+      assert parents.shape == (n - 1,), (parents.shape, n)
+      depths = np.concatenate([[0], TreeDepths(parents)]).astype(np.int32)
+      lo, hi = TreeAncestorMasks(parents)
+      pos_ids[sl] = row_q_pos[i] + depths
+      anc_lo[sl] = lo
+      anc_hi[sl] = hi
+      col_parent[i, 1:n] = parents + 1
+    else:
+      pos_ids[sl] = pos[sl]
     cursor += n
   return RaggedRows(row_of=row_of, col_of=col_of, pos=pos, valid=valid,
                     row_q_pos=row_q_pos, row_len=row_lens,
-                    row_cols=row_cols)
+                    row_cols=row_cols, pos_ids=pos_ids,
+                    anc_lo=anc_lo, anc_hi=anc_hi, col_parent=col_parent)

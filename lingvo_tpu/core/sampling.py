@@ -190,3 +190,188 @@ def SpecVerifyTokens(target_logits, draft_tokens, draft_logits, key,
   out = jnp.where(cols < accept_len[:, None], d_pad,
                   jnp.where(cols == accept_len[:, None], at_cut, bonus))
   return out.astype(jnp.int32), accept_len
+
+
+def SpecVerifyTree(target_logits, draft_tokens, branches, draft_logits, key,
+                   temperature: float = 0.0, top_k: int = 0,
+                   row_seeds=None, row_pos=None, draft_valid=None):
+  """Branch-aware acceptance over one TREE verify step.
+
+  The verify step fed each row its last committed token t0 (tree column 0)
+  plus R draft nodes in DFS order, so `target_logits[:, j + 1]` is the
+  target distribution AFTER draft node j and `target_logits[:, 0]` the one
+  after t0. The tree branches once, at depth 1: `branches[b, i, d - 1]` is
+  the draft index of branch i's node at depth d (-1 = absent), so each
+  branch is a root-anchored chain and chain speculation is the W == 1
+  degenerate case.
+
+  Acceptance walks the tree depth-first by construction of the walk: at
+  depth 1 the sibling set is all branch heads; once a branch is entered,
+  deeper candidates come only from that branch (a root-to-leaf path).
+
+  - `temperature <= 0`: greedy — a candidate is lawful iff it equals the
+    argmax of its PARENT's target distribution, so the walk accepts the
+    longest lawful root-to-leaf argmax chain (leftmost branch on sibling
+    ties — duplicate siblings carry identical continuations of the argmax
+    chain, so the emitted stream is the same either way). Emitted tokens
+    are the target argmaxes themselves: byte-identical to the
+    non-speculative engine.
+  - `temperature > 0`: residual speculative sampling generalized over the
+    sibling set (multi-round rejection): candidate i at a node is accepted
+    iff u_i < p_i(x)/q(x), where p_1 is the (temperature/top-k) target at
+    the node and p_{i+1} = norm(max(p_i - q_i, 0)) the residual left after
+    rejecting candidate i. Accept-or-residual over the set emits exactly
+    the target law at every node (exact for i.i.d. draft-sampled siblings
+    — the draft sources sample siblings i.i.d. at temperature > 0), so
+    each request's output distribution equals the non-speculative
+    engine's. Stream keys reuse the chain convention — depth d draws at
+    stream position row_pos + d - 1 with coin fold 1 (sibling i > 0 adds
+    fold (3, i)), residual fold 2, and the full-acceptance bonus is the
+    plain positional draw.
+
+  Args:
+    target_logits: [B, C, V] verify-step logits, C = R + 1 DFS columns.
+    draft_tokens: [B, R] int32 draft-node proposals (DFS order).
+    branches: [B, W, K] int32 draft index per (branch, depth), -1 absent.
+    draft_logits: [B, R, V] draft distribution each proposal was drawn
+      from (ignored at temperature <= 0; required otherwise).
+    key/temperature/top_k/row_seeds/row_pos: as SpecVerifyTokens.
+    draft_valid: optional [B, R] bool — budget-clamped nodes can never be
+      accepted.
+
+  Returns:
+    (out_tokens [B, K + 1] int32, accept_depth [B] int32,
+     branch [B] int32). The caller emits out_tokens[i, :accept_depth + 1];
+    `branch` is the accepted branch index (0 when nothing was accepted),
+    which the engine uses to locate the winning path's DFS columns for KV
+    repair and SSM column select.
+  """
+  b, c, _ = target_logits.shape
+  _, w, kd = branches.shape
+  r = draft_tokens.shape[1]
+  assert c >= r + 1, (c, r)
+  if draft_valid is None:
+    draft_valid = jnp.ones((b, r), bool)
+  b_idx = jnp.arange(b)
+  branches = branches.astype(jnp.int32)
+  draft_tokens = draft_tokens.astype(jnp.int32)
+
+  def _NodeTok(j):            # j: [B] draft index (clipped for gathers)
+    return draft_tokens[b_idx, jnp.clip(j, 0, max(r - 1, 0))]
+
+  def _NodeValid(j):
+    return (j >= 0) & draft_valid[b_idx, jnp.clip(j, 0, max(r - 1, 0))]
+
+  cur_col = jnp.zeros((b,), jnp.int32)
+  alive = jnp.ones((b,), bool)
+  m = jnp.zeros((b,), jnp.int32)
+  branch = jnp.zeros((b,), jnp.int32)
+
+  if temperature <= 0.0:
+    g = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)      # [B, C]
+    out = [g[b_idx, cur_col]]
+    for d in range(1, kd + 1):
+      expect = g[b_idx, cur_col]                                  # [B]
+      cand = branches[:, :, d - 1]                                # [B, W]
+      ok = (_NodeValid(cand.T).T
+            & (_NodeTok(cand.T).T == expect[:, None]))            # [B, W]
+      if d > 1:
+        ok = ok & (jnp.arange(w)[None] == branch[:, None])
+      any_ok = jnp.any(ok, axis=1)
+      first = jnp.argmax(ok, axis=1).astype(jnp.int32)
+      if d == 1:
+        branch = jnp.where(any_ok, first, branch)
+      j_acc = branches[b_idx, jnp.where(any_ok, first, branch),
+                       d - 1]
+      alive = alive & any_ok
+      m = m + alive.astype(jnp.int32)
+      cur_col = jnp.where(alive, j_acc + 1, cur_col)
+      out.append(g[b_idx, cur_col])
+    # out[t] is the argmax AFTER the t-th accepted path node: accepted
+    # drafts for t < m (they ARE those argmaxes), the correction/bonus at
+    # t == m, unconsumed past it.
+    return (jnp.stack(out[:kd + 1], axis=1).astype(jnp.int32), m,
+            branch)
+
+  assert row_seeds is not None and row_pos is not None, (
+      "speculative sampling at temperature > 0 needs per-request streams")
+  tl = _TransformLogits(target_logits, temperature, top_k)        # [B, C, V]
+  ql = _TransformLogits(draft_logits, temperature, top_k)         # [B, R, V]
+  p = jax.nn.softmax(tl, axis=-1)
+  q = jax.nn.softmax(ql, axis=-1)
+  seeds = row_seeds.astype(jnp.uint32)
+
+  def _PosKey(seed, pp):
+    return jax.random.fold_in(jax.random.fold_in(key, seed), pp)
+
+  pos_keys = jax.vmap(_PosKey)
+  acc_toks, finals = [], []
+  for d in range(1, kd + 1):
+    kk = pos_keys(seeds, row_pos.astype(jnp.uint32) + (d - 1))    # [B] keys
+    p_work = jnp.take_along_axis(
+        p, cur_col[:, None, None], axis=1)[:, 0]                  # [B, V]
+    degenerate = jnp.zeros((b,), bool)
+    accepted = jnp.zeros((b,), bool)
+    j_acc = jnp.zeros((b,), jnp.int32)
+    br_acc = branch
+    for i in range(w):
+      j_i = branches[:, i, d - 1]                                 # [B]
+      cand_ok = _NodeValid(j_i) & ~accepted
+      if d > 1:
+        cand_ok = cand_ok & (branch == i)
+      x_i = _NodeTok(j_i)
+      q_i = q[b_idx, jnp.clip(j_i, 0, max(r - 1, 0))]             # [B, V]
+      coin = jax.vmap(
+          lambda kx: jax.random.uniform(jax.random.fold_in(kx, 1))
+          if i == 0 else
+          jax.random.uniform(
+              jax.random.fold_in(jax.random.fold_in(kx, 3), i)))(kk)
+      p_x = p_work[b_idx, x_i]
+      q_x = q_i[b_idx, x_i]
+      acc_now = cand_ok & (coin * q_x < p_x)
+      j_acc = jnp.where(acc_now, j_i, j_acc)
+      if d == 1:
+        br_acc = jnp.where(acc_now, i, br_acc)
+      accepted = accepted | acc_now
+      considered = cand_ok & ~acc_now
+      resid = jnp.maximum(p_work - q_i, 0.0)
+      z = jnp.sum(resid, axis=-1, keepdims=True)
+      p_next = jnp.where(z > 0.0, resid / jnp.maximum(z, 1e-30), p_work)
+      degenerate = degenerate | (considered & (z[:, 0] <= 0.0))
+      p_work = jnp.where(considered[:, None], p_next, p_work)
+    # correction draw from the post-set residual (fallback to the target
+    # at the node when the residual vanished — p == q there, any lawful)
+    tl_cur = jnp.take_along_axis(
+        tl, cur_col[:, None, None], axis=1)[:, 0]                 # [B, V]
+    corr_logits = jnp.where(degenerate[:, None], tl_cur,
+                            jnp.log(jnp.maximum(p_work, 1e-30)))
+    corr = jax.vmap(
+        lambda kx, ll: jax.random.categorical(
+            jax.random.fold_in(kx, 2), ll, axis=-1))(
+                kk, corr_logits).astype(jnp.int32)                # [B]
+    step_alive = alive & accepted
+    # the token emitted at stream position row_pos + d - 1: the accepted
+    # draft if the walk survives, else (if it died exactly here) the
+    # correction
+    acc_toks.append(_NodeTok(j_acc))
+    finals.append(corr)
+    branch = jnp.where(alive, br_acc, branch)
+    m = m + step_alive.astype(jnp.int32)
+    cur_col = jnp.where(step_alive, j_acc + 1, cur_col)
+    alive = step_alive
+  # full-acceptance bonus: the plain positional draw at the leaf
+  kk_b = pos_keys(seeds, row_pos.astype(jnp.uint32) + kd)
+  tl_leaf = jnp.take_along_axis(tl, cur_col[:, None, None], axis=1)[:, 0]
+  bonus = jax.vmap(lambda kx, ll: jax.random.categorical(
+      kx, ll, axis=-1))(kk_b, tl_leaf).astype(jnp.int32)
+  acc_mat = jnp.stack(acc_toks, axis=1) if kd else jnp.zeros((b, 0),
+                                                             jnp.int32)
+  fin_mat = (jnp.concatenate([jnp.stack(finals, axis=1), bonus[:, None]],
+                             axis=1) if kd else bonus[:, None])   # [B, K+1]
+  cols = jnp.arange(kd + 1, dtype=jnp.int32)[None]
+  at_cut = jnp.take_along_axis(fin_mat, m[:, None], axis=1)[:, 0]
+  acc_pad = jnp.concatenate(
+      [acc_mat, jnp.zeros((b, 1), jnp.int32)], axis=1)
+  out = jnp.where(cols < m[:, None], acc_pad,
+                  jnp.where(cols == m[:, None], at_cut[:, None], 0))
+  return out.astype(jnp.int32), m, branch

@@ -271,7 +271,8 @@ class GatedSSMLayer(base_layer.BaseLayer):
     return NestedMap(state=jnp.zeros((num_slots, n, h, s), jnp.float32))
 
   def PagedStep(self, theta, query_vec, cached_states: NestedMap,
-                block_tables, q_pos, in_len, collect_col_states: bool = False):
+                block_tables, q_pos, in_len, collect_col_states: bool = False,
+                col_parent=None):
     """One continuous-batching step; query_vec [B, C, D], B = engine slots.
 
     block_tables is ignored — the O(1) state needs no pages. Slot re-use is
@@ -289,6 +290,14 @@ class GatedSSMLayer(base_layer.BaseLayer):
     float ops of the C == 1 decode path, so a verify step's per-column
     state trajectory (and output) is bitwise identical to feeding the same
     tokens one step at a time — the greedy-identity bar of spec decoding.
+
+    col_parent (tree speculation, requires collect_col_states): [B, C]
+    int32 parent COLUMN of each packed column (-1 = the row's incoming
+    state). A column's recurrence then starts from its parent's trajectory
+    entry instead of the packed predecessor's, which is what makes sibling
+    branches independent continuations of their shared ancestor. Chain
+    rows ship col_parent[:, j] == j - 1, gathering exactly the value the
+    plain scan carries — the trajectory stays bitwise identical.
     """
     del block_tables
     b, c_len, _ = query_vec.shape
@@ -302,13 +311,37 @@ class GatedSSMLayer(base_layer.BaseLayer):
                >= in_len[:, None]).astype(jnp.float32)
     decay_log, v = self._MaskScanInputs(decay_log, v, invalid)
     if collect_col_states:
+      xs = tuple(jnp.moveaxis(t, 1, 0)
+                 for t in (decay_log, b_proj, c_proj, v))
+      if col_parent is not None:
+        parent = jnp.clip(col_parent.astype(jnp.int32), -1, c_len - 1)
+
+        def _TreeCol(traj, xs):
+          j, dl, bb, cc, vv = xs
+          pj = jax.lax.dynamic_index_in_dim(parent, j, axis=1,
+                                            keepdims=False)       # [B]
+          s_par = jnp.take_along_axis(
+              traj, jnp.clip(pj, 0, None)[:, None, None, None, None],
+              axis=1)[:, 0]
+          s_in = jnp.where((pj < 0)[:, None, None, None], state, s_par)
+          s_next, y_t = ssd_scan.SequentialStep(s_in, dl, bb, cc, vv)
+          traj = jax.lax.dynamic_update_slice_in_dim(
+              traj, s_next[:, None], j, axis=1)
+          return traj, y_t
+
+        traj0 = jnp.zeros((b, c_len) + state.shape[1:], jnp.float32)
+        traj, ys = jax.lax.scan(
+            _TreeCol, traj0,
+            (jnp.arange(c_len, dtype=jnp.int32),) + xs)
+        y = jnp.moveaxis(ys, 0, 1)
+        out = self._Finish(theta, y, v, gate)
+        return out, NestedMap(state=traj[:, -1], col_states=traj)
+
       def _Col(s, xs):
         dl, bb, cc, vv = xs
         s_next, y_t = ssd_scan.SequentialStep(s, dl, bb, cc, vv)
         return s_next, (y_t, s_next)
 
-      xs = tuple(jnp.moveaxis(t, 1, 0)
-                 for t in (decay_log, b_proj, c_proj, v))
       s_new, (ys, cols) = jax.lax.scan(_Col, state, xs)
       y = jnp.moveaxis(ys, 0, 1)
       out = self._Finish(theta, y, v, gate)
@@ -344,7 +377,8 @@ class GatedSSMLayer(base_layer.BaseLayer):
     wmax = x_rows.shape[1]
     out_rows, new_states = self.PagedStep(
         theta, x_rows, cached_states, None, rows.row_q_pos, rows.row_len,
-        collect_col_states=collect_col_states)
+        collect_col_states=collect_col_states,
+        col_parent=rows.col_parent if collect_col_states else None)
     row = jnp.clip(rows.row_of.astype(jnp.int32), 0, x_rows.shape[0] - 1)
     col = jnp.clip(rows.col_of.astype(jnp.int32), 0, wmax - 1)
     return out_rows[row, col][None], new_states

@@ -27,6 +27,7 @@ ENGINE_COUNTER_KEYS = (
     "tokens_emitted", "prompt_tokens",
     "dense_fallback_steps", "quantized_steps",
     "spec_cycles", "draft_tokens", "accepted_tokens",
+    "spec_branches", "spec_width_clamps",
     "prefix_hit_tokens",
 )
 
@@ -39,10 +40,13 @@ ENGINE_INFO_KEYS = (
 # Nested sub-dict sections always present in Stats().
 ENGINE_SECTION_KEYS = ("scheduler", "kv_pages", "mixers", "prefix_cache")
 
-# Keys every engine Stats() dict must carry.
+# Keys every engine Stats() dict must carry. accepted_len_hist and
+# accepted_depth_hist are two readings of the same per-verify histogram:
+# hist[m] = rows whose accepted draft prefix length / accepted
+# root-to-leaf tree depth was m (identical for chain speculation).
 ENGINE_STATS_REQUIRED = frozenset(
     ENGINE_COUNTER_KEYS + ENGINE_INFO_KEYS + ENGINE_SECTION_KEYS
-    + ("accepted_len_hist",))
+    + ("accepted_len_hist", "accepted_depth_hist"))
 
 # Keys present only under specific configurations:
 #   state_slots — stacks with O(1)-state mixers
@@ -81,6 +85,7 @@ GSHARD_TELEMETRY_KEYS = (
     "decode_state_bytes_per_seq",
     "kv_cache_dtype", "kv_bytes_per_token", "serve_int8_weights",
     "draft_tokens", "accepted_tokens", "accepted_len_hist",
+    "spec_branches", "spec_width_clamps", "accepted_depth_hist",
     "prefix_hit_tokens", "prefix_cache", "step_programs",
 )
 
@@ -134,7 +139,7 @@ COMPILE_CENSUS_KEY = "step_programs"
 SCHEDULER_STATS_KEYS = frozenset({
     "slots", "slots_live", "slots_prefill", "slots_live_peak", "queue_depth",
     "admitted", "finished", "cancelled", "rejected_overlong",
-    "needs_kv_pages", "prefix_ordered_admissions",
+    "needs_kv_pages", "prefix_ordered_admissions", "width_clamps",
 })
 
 # serving/kv_cache.py PageAllocator.Stats() (page_bytes/pool_bytes only

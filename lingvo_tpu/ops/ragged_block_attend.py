@@ -69,19 +69,45 @@ from lingvo_tpu.ops.block_decode import SupportedOnTpu  # noqa: F401  (same
 # -- XLA twin (the CPU serving path) -----------------------------------------
 
 
+def _AncestorOk(slot, c, lo, hi):
+  """In-step ancestor visibility for key slots `slot` (already [?, P]).
+
+  c = slot - q_start (position within the row's packed step window); bit c
+  of the token's (lo | hi << 32) mask says whether step column c is an
+  ancestor-or-self. Slots below the window (c < 0, the committed prefix)
+  clip to bit 0, which every tree mask sets (the root is an ancestor of
+  all); chain rows ship lo = hi = -1 so every bit reads 1 and the combined
+  mask stays bitwise the pre-tree causal mask. Slots at c >= 64 only occur
+  on chain rows (tree rows are capped at 64 columns), where -1 again
+  yields 1."""
+  cc = jnp.clip(c, 0, 63)
+  word = jnp.where(cc < 32, lo, hi)
+  sh = jnp.where(cc < 32, cc, cc - 32)
+  return jnp.bitwise_and(jax.lax.shift_right_logical(word, sh), 1) == 1
+
+
 def _XlaRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
-                     page_size: int, k_scale=None, v_scale=None):
+                     page_size: int, k_scale=None, v_scale=None,
+                     q_start=None, anc_lo=None, anc_hi=None):
   """q: [T, N, H]; pools [NP, P, N, H]; tables [B, t_pages] int32;
   row_of/q_end [T] int32. -> [T, N, H].
 
   Dynamic trip count over the batch-max live page: per step the work is
   O(T * max(q_end)), not O(T * t_pages * P). k_scale/v_scale [NP, N, P]
-  switch on the int8 path via the shared `_DequantPages`."""
+  switch on the int8 path via the shared `_DequantPages`. q_start/anc_lo/
+  anc_hi [T] int32 add per-token in-step ancestor masking for tree rows
+  (None = chain semantics, bitwise the unmasked kernel)."""
   t, n, h = q.shape
   np_total, page, _, _ = k_pool.shape
   assert page == page_size, (page, page_size)
   t_pages = block_tables.shape[1]
   ends = q_end.astype(jnp.int32)
+  if q_start is None:
+    q_start = jnp.zeros((t,), jnp.int32)
+    anc_lo = anc_hi = jnp.full((t,), -1, jnp.int32)
+  starts = q_start.astype(jnp.int32)
+  lo = anc_lo.astype(jnp.int32)
+  hi = anc_hi.astype(jnp.int32)
   trip = jnp.clip((jnp.max(ends) + page_size - 1) // page_size, 0, t_pages)
   tables = jnp.clip(block_tables.astype(jnp.int32), 0, np_total - 1)
   rows = jnp.clip(row_of.astype(jnp.int32), 0, tables.shape[0] - 1)
@@ -98,7 +124,10 @@ def _XlaRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
       k_page = _DequantPages(k_page, k_scale[pid])
       v_page = _DequantPages(v_page, v_scale[pid])
     slot = j * page_size + jnp.arange(page_size, dtype=jnp.int32)  # [P]
-    keep = (slot[None, :] < ends[:, None]).astype(jnp.float32)[:, None, :]
+    causal = slot[None, :] < ends[:, None]                 # [T, P]
+    ok = _AncestorOk(slot[None, :], slot[None, :] - starts[:, None],
+                     lo[:, None], hi[:, None])
+    keep = (causal & ok).astype(jnp.float32)[:, None, :]
     return batched_attend(q, k_page, v_page, keep, m, l, acc)
 
   m0 = jnp.full((t, n, 1), NEG_INF, jnp.float32)
@@ -111,7 +140,8 @@ def _XlaRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
 # -- Pallas TPU kernel -------------------------------------------------------
 
 
-def _RaggedAttendKernel(row_of_ref, tables_ref, ends_ref, q_ref, k_ref,
+def _RaggedAttendKernel(row_of_ref, tables_ref, ends_ref, starts_ref,
+                        lo_ref, hi_ref, q_ref, k_ref,
                         v_ref, *rest, page_size: int, t_pages: int):
   """One (token, logical page) program step; scratch carried over pages.
 
@@ -139,7 +169,9 @@ def _RaggedAttendKernel(row_of_ref, tables_ref, ends_ref, q_ref, k_ref,
   def _Accumulate():
     slot = j * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (1, page_size), 1)                       # [1, P]
-    keep = (slot < ln).astype(jnp.float32)                  # [1, P]
+    ok = _AncestorOk(slot, slot - starts_ref[ti],
+                     lo_ref[ti], hi_ref[ti])                # [1, P]
+    keep = ((slot < ln) & ok).astype(jnp.float32)           # [1, P]
     k_page, v_page = k_ref[0], v_ref[0]
     if ks_ref is not None:
       k_page = _DequantPages(k_page, ks_ref[0])
@@ -157,7 +189,8 @@ def _RaggedAttendKernel(row_of_ref, tables_ref, ends_ref, q_ref, k_ref,
 
 def _PallasRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
                         page_size: int, interpret: bool = False,
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None,
+                        q_start=None, anc_lo=None, anc_hi=None):
   """Pallas lowering of _XlaRaggedAttend. q: [T, N, H] -> [T, N, H]."""
   t, n, h = q.shape
   np_total, page, _, _ = k_pool.shape
@@ -166,26 +199,36 @@ def _PallasRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
   tables = jnp.clip(block_tables.astype(jnp.int32), 0, np_total - 1)
   rows = jnp.clip(row_of.astype(jnp.int32), 0, tables.shape[0] - 1)
   ends = q_end.astype(jnp.int32)
+  if q_start is None:
+    q_start = jnp.zeros((t,), jnp.int32)
+    anc_lo = anc_hi = jnp.full((t,), -1, jnp.int32)
+  starts = q_start.astype(jnp.int32)
+  lo = anc_lo.astype(jnp.int32)
+  hi = anc_hi.astype(jnp.int32)
 
   # Dead logical pages clamp to the TOKEN's last live page: Pallas
   # re-requests the same physical block and elides the HBM DMA, pl.when
   # skips compute. A stale table entry past a token's horizon never
   # reaches VMEM — the page-reuse-after-eviction guarantee.
-  def _PageIdx(ti, j, row_ref, tables_ref, ends_ref):
+  def _PageIdx(ti, j, row_ref, tables_ref, ends_ref, s_ref, lo_ref, hi_ref):
     last = jnp.maximum(
         (ends_ref[ti] + page_size - 1) // page_size - 1, 0)
     last = jnp.minimum(last, t_pages - 1)
     return (tables_ref[row_ref[ti], jnp.minimum(j, last)], 0, 0, 0)
 
-  def _ScaleIdx(ti, j, row_ref, tables_ref, ends_ref):
-    return _PageIdx(ti, j, row_ref, tables_ref, ends_ref)[:3]
+  def _ScaleIdx(ti, j, row_ref, tables_ref, ends_ref, s_ref, lo_ref, hi_ref):
+    return _PageIdx(ti, j, row_ref, tables_ref, ends_ref,
+                    s_ref, lo_ref, hi_ref)[:3]
+
+  def _TokIdx(ti, j, r_ref, t_ref, e_ref, s_ref, lo_ref, hi_ref):
+    return (ti, 0, 0)
 
   in_specs = [
-      pl.BlockSpec((1, n, h), lambda ti, j, r_ref, t_ref, e_ref: (ti, 0, 0)),
+      pl.BlockSpec((1, n, h), _TokIdx),
       pl.BlockSpec((1, page_size, n, h), _PageIdx),
       pl.BlockSpec((1, page_size, n, h), _PageIdx),
   ]
-  operands = [rows, tables, ends, q, k_pool, v_pool]
+  operands = [rows, tables, ends, starts, lo, hi, q, k_pool, v_pool]
   if k_scale is not None:
     in_specs += [
         pl.BlockSpec((1, n, page_size), _ScaleIdx),
@@ -194,11 +237,10 @@ def _PallasRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
     operands += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
 
   grid_spec = pltpu.PrefetchScalarGridSpec(
-      num_scalar_prefetch=3,
+      num_scalar_prefetch=6,
       grid=(t, t_pages),
       in_specs=in_specs,
-      out_specs=pl.BlockSpec(
-          (1, n, h), lambda ti, j, r_ref, t_ref, e_ref: (ti, 0, 0)),
+      out_specs=pl.BlockSpec((1, n, h), _TokIdx),
       scratch_shapes=[
           pltpu.VMEM((n, LANES), jnp.float32),
           pltpu.VMEM((n, LANES), jnp.float32),
@@ -222,6 +264,7 @@ def _PallasRaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end,
 
 def RaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end, *,
                  page_size: int, k_scale=None, v_scale=None,
+                 q_start=None, anc_lo=None, anc_hi=None,
                  lowering: str = "auto", interpret: bool | None = None):
   """Packed-token ragged paged attention — decode, prefill, and verify
   rows in one call.
@@ -236,24 +279,37 @@ def RaggedAttend(q, k_pool, v_pool, block_tables, row_of, q_end, *,
   (its `q_pos + 1`); 0 marks a padding token, whose output is 0.
   k_scale/v_scale: [num_pages, N, page_size] f32 sidecars for int8 pools
   (both or neither); pages dequantize in-kernel via `_DequantPages`.
+  q_start/anc_lo/anc_hi: [T] int32 tree-speculation operands — q_start is
+  the token's row step-window start (its row_q_pos) and anc_lo/anc_hi the
+  64-bit ancestor-column bitmask; all three or none. None keeps chain
+  semantics bitwise (every in-step predecessor visible).
   lowering: 'auto' (Pallas on real TPU, XLA twin elsewhere) | 'pallas' |
   'xla'. Returns [T, N, H].
   """
   assert q.ndim == 3, q.shape
   assert lowering in ("auto", "pallas", "xla"), lowering
   assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
+  tree_args = (q_start is not None, anc_lo is not None, anc_hi is not None)
+  assert all(tree_args) or not any(tree_args), \
+      "pass q_start+anc_lo+anc_hi together or none"
   if k_scale is not None:
     assert k_pool.dtype == jnp.int8, k_pool.dtype
+  if q_start is not None:
+    q_start = jnp.asarray(q_start)
+    anc_lo = jnp.asarray(anc_lo)
+    anc_hi = jnp.asarray(anc_hi)
   on_tpu = jax.default_backend() == "tpu"
   if lowering == "auto":
     lowering = "pallas" if on_tpu else "xla"
   if lowering == "xla":
     return _XlaRaggedAttend(q, k_pool, v_pool, block_tables,
                             jnp.asarray(row_of), jnp.asarray(q_end),
-                            page_size, k_scale=k_scale, v_scale=v_scale)
+                            page_size, k_scale=k_scale, v_scale=v_scale,
+                            q_start=q_start, anc_lo=anc_lo, anc_hi=anc_hi)
   if interpret is None:
     interpret = not on_tpu
   return _PallasRaggedAttend(q, k_pool, v_pool, block_tables,
                              jnp.asarray(row_of), jnp.asarray(q_end),
                              page_size, interpret=interpret,
-                             k_scale=k_scale, v_scale=v_scale)
+                             k_scale=k_scale, v_scale=v_scale,
+                             q_start=q_start, anc_lo=anc_lo, anc_hi=anc_hi)

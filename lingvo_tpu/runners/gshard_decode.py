@@ -299,6 +299,9 @@ class GShardDecode:
         draft_tokens=0,
         accepted_tokens=0,
         accepted_len_hist=[],
+        spec_branches=0,
+        spec_width_clamps=0,
+        accepted_depth_hist=[],
         # prefix-cache telemetry, same mirroring contract: the batch-
         # synchronous driver re-prefills every prompt, so no cache exists
         prefix_hit_tokens=0,

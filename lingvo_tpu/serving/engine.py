@@ -308,13 +308,26 @@ class ServingLoop:
     if step_mode not in ("ragged", "legacy"):
       raise ValueError(
           "step_mode must be 'ragged' or 'legacy', got %r" % (step_mode,))
+    if (self.spec is not None and self.spec.w > 1
+        and step_mode == "legacy"):
+      raise ValueError(
+          "tree speculation (draft width > 1) requires step_mode='ragged' "
+          "— the legacy verify step is chain-only")
     self.step_mode = step_mode
     self.prefill_token_budget = int(prefill_token_budget or prefill_chunk)
-    spec_width = (self.spec.k + 1) if self.spec is not None else 1
+    # a speculating row is 1 root + w*k tree nodes wide (chain: w == 1)
+    spec_width = ((1 + self.spec.w * self.spec.k)
+                  if self.spec is not None else 1)
     self._ragged_t = max_batch * spec_width + self.prefill_token_budget
     self._ragged_wmax = max(spec_width, self.prefill_token_budget)
+    # tree KV repair needs each paged leaf's (page, token-offset) axes;
+    # chain engines never repair (accepted prefixes are already in place)
+    self._kv_leaf_axes = None
+    if (self.spec is not None and self.spec.w > 1
+        and self.mixers["num_attention"] > 0):
+      self._kv_leaf_axes = self._PagedLeafAxes(task, theta, kv_cache_dtype)
     self._ragged_fn = self._BuildRaggedFn(task, donate)
-    self._zero_qlogits = None   # lazy [B, k, V] f32 (no-draft spec steps)
+    self._zero_qlogits = None   # lazy [B, w*k, V] f32 (no-draft spec steps)
     # silent-fallback visibility: classify ONCE which attention path the
     # compiled step will take, and count ineligible (dense-fallback) steps
     self.paged_path = self._ClassifyPath()
@@ -424,11 +437,22 @@ class ServingLoop:
     through SpecVerifyTokens as all-invalid and their column-0 output
     is exactly the plain draw, so no-draft steps run the SAME program
     with zero q_logits rather than a second compiled shape.
+
+    Tree speculation (draft width w > 1) stays the SAME one program:
+    speculating rows pack a w-ary token tree in DFS order, the verify
+    lane rebuilds DFS-ordered target logits from the packed columns and
+    runs SpecVerifyTree with a static branch table, the accepted path's
+    K/V is gathered/scattered into the canonical chain slots inside the
+    same jit (no second program, no host round-trip), and hybrid-SSM
+    rows column-select the accepted LEAF's tree-scan trajectory. Width
+    w == 1 engines compile the EXACT chain program below — chain
+    speculation is the degenerate tree, bitwise.
     """
     temp, topk = self.temperature, self.top_k
     base_key = self.sample_seed
     b = self.max_batch
     spec_k = self.spec.k if self.spec is not None else 0
+    spec_w = self.spec.w if self.spec is not None else 1
     collect = self.spec is not None and self.mixers["num_ssm"] > 0
 
     if spec_k == 0:
@@ -442,7 +466,7 @@ class ServingLoop:
             logits, key, temperature=temp, top_k=topk,
             row_seeds=seeds[row], positions=pos[row])
         return sampled, new_states
-    else:
+    elif spec_w == 1:
       def _RaggedStep(theta, states, tok_ids, rows, tables, seeds, pos,
                       row_k, q_logits):
         logits, new_states = task.RaggedStep(theta, tok_ids[None], states,
@@ -474,6 +498,100 @@ class ServingLoop:
                               jnp.clip(rows.row_len - 1, 0, None))
           new_states = spec_decode._SelectAcceptedCols(new_states, restore)
         return sampled, out, alen, new_states
+    else:
+      r = spec_w * spec_k
+      ps = self.page_size
+      trash_page = self.num_pages        # the pool's padding-write page
+      kv_axes = self._kv_leaf_axes
+
+      def _IdxTuple(ndim, pa, oa, pi, oi):
+        idx = [slice(None)] * ndim
+        idx[pa] = pi
+        idx[oa] = oi
+        return tuple(idx)
+
+      def _RepairKv(states, tables, rows, row_k, alen, wbr):
+        # Moves the accepted path's K/V (and int8 scale sidecars) from
+        # its DFS tree slots to the canonical chain slots q_pos+1..
+        # q_pos+m, so the committed cache is bit-identical to a chain
+        # that decoded the same tokens. Branch-0 wins are pure identity
+        # copies (src == dst); inactive (row, depth) pairs copy the
+        # trash page onto itself so duplicate scatter indices can never
+        # land on live pages.
+        q_pos = rows.row_q_pos.astype(jnp.int32)
+        dd = jnp.arange(1, spec_k + 1, dtype=jnp.int32)[None]    # [1, K]
+        m = jnp.minimum(alen, row_k)[:, None]
+        active = (row_k[:, None] > 0) & (dd <= m)
+        src_slot = (q_pos[:, None] + 1
+                    + wbr[:, None] * row_k[:, None] + dd - 1)
+        dst_slot = q_pos[:, None] + dd
+        cap = tables.shape[1] * ps
+        src_slot = jnp.clip(src_slot, 0, cap - 1)
+        dst_slot = jnp.clip(dst_slot, 0, cap - 1)
+        bb = jnp.arange(b, dtype=jnp.int32)[:, None]
+        sp = jnp.where(active, tables[bb, src_slot // ps], trash_page)
+        so = jnp.where(active, src_slot % ps, 0)
+        dp = jnp.where(active, tables[bb, dst_slot // ps], trash_page)
+        do = jnp.where(active, dst_slot % ps, 0)
+        leaves, treedef = jax.tree_util.tree_flatten(states)
+        assert len(leaves) == len(kv_axes), (len(leaves), len(kv_axes))
+        out = []
+        for leaf, ax in zip(leaves, kv_axes):
+          if ax is None:
+            out.append(leaf)
+            continue
+          pa, oa = ax
+          vals = leaf[_IdxTuple(leaf.ndim, pa, oa, sp, so)]
+          out.append(
+              leaf.at[_IdxTuple(leaf.ndim, pa, oa, dp, do)].set(vals))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+      def _RaggedStep(theta, states, tok_ids, rows, tables, seeds, pos,
+                      row_k, row_w, q_logits):
+        logits, new_states = task.RaggedStep(theta, tok_ids[None], states,
+                                             tables, rows,
+                                             ssm_col_states=collect)
+        logits = logits[0]                                     # [T, V]
+        key = jax.random.PRNGKey(base_key)
+        row = jnp.clip(rows.row_of, 0, b - 1)
+        sampled = sampling.SampleFromLogits(
+            logits, key, temperature=temp, top_k=topk,
+            row_seeds=seeds[row], positions=pos[row])
+        # tree verify lane: draft node j = bi*k + d (the branch-major
+        # draft layout) sits at packed column 1 + bi*row_k + d; rows
+        # with clamped width/depth leave the tail invalid, so the
+        # branch table stays a STATIC arange and per-row shape lives
+        # entirely in draft_valid. DFS-ordered target logits are
+        # rebuilt so node j's after-distribution is column j + 1 —
+        # the SpecVerifyTree contract.
+        j = jnp.arange(r, dtype=jnp.int32)
+        bi_j, d_j = j // spec_k, j % spec_k
+        nvalid = ((bi_j[None] < row_w[:, None])
+                  & (d_j[None] < row_k[:, None]))              # [B, R]
+        node_col = jnp.where(
+            nvalid, 1 + bi_j[None] * row_k[:, None] + d_j[None], 0)
+        ntok = jnp.take_along_axis(rows.row_cols, node_col, axis=1)
+        v_logits = jnp.concatenate(
+            [logits[rows.row_cols[:, :1]], logits[ntok]], axis=1)
+        d_toks = tok_ids[ntok]
+        branches = jnp.broadcast_to(
+            jnp.arange(r, dtype=jnp.int32).reshape(1, spec_w, spec_k),
+            (b, spec_w, spec_k))
+        out, alen, wbr = sampling.SpecVerifyTree(
+            v_logits, d_toks, branches, q_logits, key, temperature=temp,
+            top_k=topk, row_seeds=seeds, row_pos=pos, draft_valid=nvalid)
+        if collect:
+          # SSM trajectory restore: the accepted LEAF's packed column —
+          # the tree scan threaded states parent-to-child, so the leaf
+          # column holds exactly the chain state after root + path
+          leaf_col = jnp.where(alen > 0, 1 + wbr * row_k + (alen - 1), 0)
+          restore = jnp.where(row_k > 0, leaf_col,
+                              jnp.clip(rows.row_len - 1, 0, None))
+          new_states = spec_decode._SelectAcceptedCols(new_states, restore)
+        if kv_axes is not None:
+          new_states = _RepairKv(new_states, tables, rows, row_k, alen,
+                                 wbr)
+        return sampled, out, alen, new_states
 
     return jax.jit(_RaggedStep, donate_argnums=donate)
 
@@ -481,12 +599,43 @@ class ServingLoop:
     """All-zero draft logits for spec-engine steps where no row drafted
     (still prefilling): the verify lane runs with draft_valid all-False,
     so the values are never consumed — they only pin the one compiled
-    signature."""
+    signature. Tree engines widen to the full w*k draft layout."""
     if self._zero_qlogits is None:
       self._zero_qlogits = jnp.zeros(
-          (self.max_batch, self.spec.k, self._task.p.vocab_size),
-          jnp.float32)
+          (self.max_batch, self.spec.w * self.spec.k,
+           self._task.p.vocab_size), jnp.float32)
     return self._zero_qlogits
+
+  def _PagedLeafAxes(self, task, theta, kv_cache_dtype):
+    """(page_axis, offset_axis) per decode-state leaf, None for unpaged.
+
+    The same structural detection as _BuildCowFn, run along BOTH pool
+    geometry parameters: the leaf axis that grows with the pool size is
+    the page axis, the one that grows with page_size is the token-offset
+    axis. Detecting the offset axis independently matters because int8
+    scale sidecars keep it on a different axis ([P, N, page_size]) than
+    the K/V pools ([P, page_size, N, H]) — adjacency can't be assumed."""
+    def _Shapes(np_total, ps):
+      return jax.eval_shape(
+          lambda th: task.InitPagedDecodeState(
+              th, np_total, ps, self.max_batch, kv_cache_dtype), theta)
+
+    base = jax.tree_util.tree_leaves(
+        _Shapes(self.num_pages + 1, self.page_size))
+    bigger = jax.tree_util.tree_leaves(
+        _Shapes(self.num_pages + 2, self.page_size))
+    wider = jax.tree_util.tree_leaves(
+        _Shapes(self.num_pages + 1, self.page_size + 1))
+    axes = []
+    for la, lb, lc in zip(base, bigger, wider):
+      dp = [i for i, (x, y) in enumerate(zip(la.shape, lb.shape))
+            if x != y]
+      do = [i for i, (x, y) in enumerate(zip(la.shape, lc.shape))
+            if x != y]
+      assert len(dp) <= 1 and len(do) <= 1, (la.shape, lb.shape, lc.shape)
+      assert bool(dp) == bool(do), (la.shape, dp, do)
+      axes.append((dp[0], do[0]) if dp else None)
+    return axes
 
   # -- prefix-cache support --------------------------------------------------
 
@@ -601,7 +750,8 @@ class ServingLoop:
 
   def Submit(self, prompt, max_new_tokens: Optional[int] = None,
              eos_id=_END, seed: Optional[int] = None,
-             spec_k: Optional[int] = None) -> StreamHandle:
+             spec_k: Optional[int] = None,
+             spec_w: Optional[int] = None) -> StreamHandle:
     """Queues a request; returns its streaming handle immediately.
 
     seed: per-request sampling seed (defaults to the request id) — only
@@ -609,14 +759,17 @@ class ServingLoop:
     spec_k: per-request speculative-decoding knob — None defers to the
     engine (full draft length when a draft source is configured, exact
     legacy behavior otherwise), 0 opts out, n > 0 caps the draft length
-    at min(n, engine k)."""
+    at min(n, engine k).
+    spec_w: per-request tree-speculation WIDTH knob — None defers to the
+    engine's draft width, 1 forces a linear chain (exact chain-spec
+    behavior), n > 1 caps the branch count at min(n, engine w)."""
     max_new = max_new_tokens or self.default_max_new
     eos = self.eos_id if eos_id is _END else eos_id
     with self._lock:
       self._seq_counter += 1
       req_id = self._seq_counter
       req = scheduler_lib.Request(req_id, prompt, max_new, eos, seed=seed,
-                                  spec_k=spec_k)
+                                  spec_k=spec_k, spec_w=spec_w)
       total = len(req.prompt) + req.max_new
       if self.sched.needs_kv_pages and (
           self.alloc.PagesFor(total) > self.alloc.num_pages):
@@ -706,8 +859,9 @@ class ServingLoop:
     with self._lock:
       self._AdmitPhase()
       spec_k = self.spec.k if self.spec is not None else 0
+      spec_w = self.spec.w if self.spec is not None else 1
       batch = self.sched.BuildRaggedStep(self._ragged_t, self._ragged_wmax,
-                                         spec_k=spec_k)
+                                         spec_k=spec_k, spec_w=spec_w)
       if batch is None:
         return 0
       tables = np.array(self.sched.block_tables)  # freeze under the lock
@@ -727,10 +881,15 @@ class ServingLoop:
         # one dtype for both the drafted and the no-draft (zeros) case:
         # the verify program must keep a single compiled signature
         q_logits = q_logits.astype(jnp.float32)
+        # tree rows pack branch-major: branch bi's depth-d node sits at
+        # packed column 1 + bi*rk + d but draft index bi*spec_k + d —
+        # clamped rows (rk < spec_k) keep only each branch's prefix
         for i in range(self.max_batch):
           rk = int(batch.row_k[i])
           if rk > 0:
-            batch.tok_ids[desc.row_cols[i, 1:1 + rk]] = d_toks[i, :rk]
+            for bi in range(int(batch.row_w[i])):
+              batch.tok_ids[desc.row_cols[i, 1 + bi * rk:1 + (bi + 1) * rk]
+                            ] = d_toks[i, bi * spec_k:bi * spec_k + rk]
       else:
         q_logits = self._ZeroQLogits()
     rows_dev = ragged_lib.RaggedRows(*(jnp.asarray(m) for m in desc))
@@ -739,7 +898,10 @@ class ServingLoop:
             jnp.asarray(batch.row_pos)]
     out = alen = None
     if self.spec is not None:
-      args += [jnp.asarray(batch.row_k), q_logits]
+      args += [jnp.asarray(batch.row_k)]
+      if self.spec.w > 1:
+        args += [jnp.asarray(batch.row_w)]
+      args += [q_logits]
       sampled, out, alen, new_states = self._compile_log.Call(
           "ragged", self._ragged_fn, *args)
       out, alen = np.asarray(out), np.asarray(alen)
@@ -766,19 +928,23 @@ class ServingLoop:
         self._counters["quantized_steps"].Inc()
       if batch.any_spec:
         self._counters["spec_cycles"].Inc()
+        if batch.width_clamps:
+          self._counters["spec_width_clamps"].Inc(batch.width_clamps)
         for i, seq in enumerate(batch.rows):
           rk = int(batch.row_k[i])
           if (seq is None or rk == 0
               or seq.state is scheduler_lib.SeqState.CANCELLED):
             continue
+          rw = int(batch.row_w[i])
           m = min(int(alen[i]), rk)
-          self._counters["draft_tokens"].Inc(rk)
+          self._counters["draft_tokens"].Inc(rw * rk)
           self._counters["accepted_tokens"].Inc(m)
+          self._counters["spec_branches"].Inc(rw)
           self.spec.accepted_len_hist[m] += 1
           if self.trace is not None:
-            self.trace.SpecVerify(seq.id, rk, m)
-            if rk - m > 0:
-              self.trace.Rollback(seq.id, rk - m)
+            self.trace.SpecVerify(seq.id, rw * rk, m)
+            if rw * rk - m > 0:
+              self.trace.Rollback(seq.id, rw * rk - m)
       self._PushEvents(events)
       self._TickProfile()
       self._BeatWatchdog()
@@ -858,6 +1024,7 @@ class ServingLoop:
         m = min(int(alen[i]), rk)
         self._counters["draft_tokens"].Inc(rk)
         self._counters["accepted_tokens"].Inc(m)
+        self._counters["spec_branches"].Inc(1)   # legacy verify is chain
         spec.accepted_len_hist[m] += 1
         if self.trace is not None:
           self.trace.SpecVerify(seq.id, rk, m)
@@ -973,8 +1140,13 @@ class ServingLoop:
       if self.state_pool is not None:
         stats["state_slots"] = self.state_pool.Stats()
       # acceptance telemetry: hist[m] = verify rows whose accepted draft
-      # prefix had length m ([] for engines without a draft source)
+      # prefix had length m ([] for engines without a draft source).
+      # accepted_depth_hist is the tree-speculation reading of the SAME
+      # data — m is the accepted root-to-leaf DEPTH along the winning
+      # branch (chains: depth == prefix length, so the views coincide).
       stats["accepted_len_hist"] = (
+          self.spec.accepted_len_hist.tolist() if self.spec else [])
+      stats["accepted_depth_hist"] = (
           self.spec.accepted_len_hist.tolist() if self.spec else [])
       if self.spec is not None:
         stats["spec"] = self.spec.Describe()

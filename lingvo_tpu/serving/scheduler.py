@@ -69,20 +69,29 @@ class Request:
   legacy single-token path otherwise. 0 opts this request out of
   speculation entirely; n > 0 caps its draft length at min(n, engine k).
   Only consulted by engines with a draft source configured.
+
+  spec_w: per-request TREE-speculation width knob — the number of
+  branches the draft tree forks into at depth 1 (core/ragged.py tree
+  contract). None (default) defers to the engine's draft width, 1 forces
+  a linear chain (the exact PR-11 behavior), n > 1 caps the width at
+  min(n, engine w). Only consulted when the engine's draft source has
+  width > 1.
   """
 
   def __init__(self, req_id, prompt, max_new_tokens: int,
                eos_id: Optional[int] = None, seed: Optional[int] = None,
-               spec_k: Optional[int] = None):
+               spec_k: Optional[int] = None, spec_w: Optional[int] = None):
     prompt = [int(t) for t in prompt]
     assert len(prompt) >= 1, "empty prompt"
     assert max_new_tokens >= 1, max_new_tokens
     assert spec_k is None or spec_k >= 0, spec_k
+    assert spec_w is None or spec_w >= 1, spec_w
     self.id = req_id
     self.prompt = prompt
     self.max_new = int(max_new_tokens)
     self.eos_id = eos_id
     self.spec_k = spec_k
+    self.spec_w = spec_w
     if seed is None:
       seed = req_id if isinstance(req_id, int) else abs(hash(req_id))
     self.seed = int(seed) % (2**31)
@@ -142,12 +151,14 @@ class RaggedBatch:
   """One packed ragged device step (numpy; the engine jits over it).
 
   The unified replacement for all three StepBatch shapes: a decode row
-  carries 1 + row_k tokens (row_k > 0 is the spec-verify lane), a
-  prefill row a token-budgeted chunk, and every composition launches
-  through the SAME compiled program. `rows_desc` is the
-  core/ragged.RaggedRows routing pytree; `tok_ids` is the matching
-  packed [T] token stream — draft columns hold 0 until the engine fills
-  proposals at rows_desc.row_cols[i, 1:1+row_k[i]].
+  carries 1 + row_w * row_k tokens (row_k > 0 is the spec-verify lane; a
+  row_w > 1 row packs a token TREE of row_w branches, each a chain of
+  row_k drafts, in DFS order — core/ragged.py), a prefill row a
+  token-budgeted chunk, and every composition launches through the SAME
+  compiled program. `rows_desc` is the core/ragged.RaggedRows routing
+  pytree; `tok_ids` is the matching packed [T] token stream — draft
+  columns hold 0 until the engine fills proposals: branch bi's depth-d
+  node at rows_desc.row_cols[i, 1 + bi * row_k[i] + d].
 
   The row-level view (ids / q_pos / in_len / rows / row_seeds / row_pos
   / row_k) deliberately speaks the StepBatch protocol so
@@ -161,7 +172,8 @@ class RaggedBatch:
 
   def __init__(self, tok_ids, rows_desc: ragged.RaggedRows, rows,
                mixed: bool, prompt_tokens: int, row_seeds, row_pos,
-               row_k, any_spec: bool, ids0):
+               row_k, any_spec: bool, ids0, row_w=None,
+               width_clamps: int = 0):
     self.tok_ids = tok_ids        # [T] int32 packed token stream
     self.rows_desc = rows_desc    # core/ragged.RaggedRows (numpy members)
     self.rows = rows              # slot -> Sequence or None, frozen at build
@@ -169,8 +181,12 @@ class RaggedBatch:
     self.prompt_tokens = prompt_tokens
     self.row_seeds = row_seeds    # [B] int32
     self.row_pos = row_pos        # [B] int32
-    self.row_k = row_k            # [B] int32 draft slots this step
+    self.row_k = row_k            # [B] int32 per-branch draft depth this step
     self.any_spec = any_spec      # host fast-path: Draft is skipped if False
+    # [B] int32 tree width this step (1 = chain; row_w * row_k draft slots)
+    self.row_w = (row_w if row_w is not None
+                  else np.ones_like(np.asarray(row_k)))
+    self.width_clamps = width_clamps  # rows whose width the pack cap shrank
     # -- StepBatch-protocol adapter for the draft source ----------------
     self.ids = ids0               # [B, 1] int32: column-0 feedback token
     self.q_pos = rows_desc.row_q_pos
@@ -218,6 +234,8 @@ class Scheduler:
     self.slots_live_peak = 0
     # admissions where cached-prefix ordering picked past the FIFO head
     self.prefix_ordered_admissions = 0
+    # tree-speculation rows whose branch count the packed-row cap shrank
+    self.width_clamps = 0
 
   # -- submission ------------------------------------------------------------
 
@@ -565,26 +583,36 @@ class Scheduler:
 
   # -- unified ragged step ----------------------------------------------------
 
-  def BuildRaggedStep(self, t: int, wmax: int,
-                      spec_k: int = 0) -> Optional[RaggedBatch]:
+  def BuildRaggedStep(self, t: int, wmax: int, spec_k: int = 0,
+                      spec_w: int = 1) -> Optional[RaggedBatch]:
     """Packs every live slot into ONE [T]-token ragged step (None if idle).
 
     t: packed token width — static, the engine sizes it once as
-    max_slots * (spec_k + 1) + prefill token budget, so every admit /
-    decode / spec / retire mix reuses one compiled program. wmax: widest
-    row the program admits (>= spec_k + 1). spec_k: engine draft length
-    (0 = no draft source configured).
+    max_slots * (1 + spec_w * spec_k) + prefill token budget, so every
+    admit / decode / spec / retire mix reuses one compiled program.
+    wmax: widest row the program admits (>= 1 + spec_w * spec_k).
+    spec_k: engine draft depth (0 = no draft source configured).
+    spec_w: engine draft-tree width (1 = chain speculation).
 
     Decode rows are mandatory and packed first: 1 feedback token plus
-    row_k draft slots, row_k clamped per request exactly like
+    row_w * row_k draft slots. row_k is clamped per request exactly like
     BuildVerifyStep (request opt-out/cap, remaining max_new budget, and
-    wmax - 1). Prefill rows then consume the LEFTOVER budget in slot
-    order, each taking up to min(wmax, budget, prompt_remaining) prompt
-    tokens. Decode latency therefore never stalls behind prefill,
-    prefill rides every step instead of alternating with it, spec
-    cycles run while other rows are still prefilling, and decode
-    capacity left idle by empty slots flows to prefill instead of
-    padding. Rows that fit no budget this step ride with row_len == 0.
+    the packed-row cap); row_w (tree rows only) is clamped WIDTH BEFORE
+    DEPTH under min(wmax, ragged.MAX_TREE_COLS) — under pressure a
+    request loses branches before it loses per-branch depth, because a
+    deep chain keeps the accepted-length upside that extra siblings only
+    hedge. Each clamped row bumps `width_clamps`. A row_w > 1 row packs
+    its tree in DFS order (branch bi's depth-d node at column
+    1 + bi * row_k + d) and ships parent pointers so
+    ragged.BuildRaggedRows emits ancestor masks; row_w == 1 rows stay
+    chain-packed — bitwise the pre-tree build. Prefill rows then consume
+    the LEFTOVER budget in slot order, each taking up to
+    min(wmax, budget, prompt_remaining) prompt tokens. Decode latency
+    therefore never stalls behind prefill, prefill rides every step
+    instead of alternating with it, spec cycles run while other rows are
+    still prefilling, and decode capacity left idle by empty slots flows
+    to prefill instead of padding. Rows that fit no budget this step
+    ride with row_len == 0.
     """
     rows = list(self.slots)
     if not any(s is not None for s in rows):
@@ -595,9 +623,12 @@ class Scheduler:
     row_seeds = np.zeros((b,), np.int32)
     row_pos = np.zeros((b,), np.int32)
     row_k = np.zeros((b,), np.int32)
+    row_w = np.ones((b,), np.int32)
+    row_parents = {}
     ids0 = np.zeros((b, 1), np.int32)
     budget = t
     any_spec = False
+    width_clamps = 0
     for i, seq in enumerate(rows):
       if seq is None:
         continue
@@ -607,15 +638,51 @@ class Scheduler:
       if seq.state is not SeqState.DECODE:
         continue
       rk = 0
+      rw = 1
       if spec_k > 0:
         rk = spec_k if seq.req.spec_k is None else min(seq.req.spec_k, spec_k)
-        rk = min(rk, seq.req.max_new - len(seq.out), wmax - 1)
+        rk = min(rk, seq.req.max_new - len(seq.out))
         rk = max(rk, 0)
+        if rk > 0 and spec_w > 1:
+          rw = spec_w if seq.req.spec_w is None else min(seq.req.spec_w,
+                                                         spec_w)
+          rw = max(rw, 1)
+        if rw > 1:
+          cap = min(wmax, ragged.MAX_TREE_COLS)
+          room = rw * rk   # pageless stack: only the packed-row cap binds
+          if self.needs_kv_pages:
+            # transient tree writes (slots q_pos+1 .. q_pos+rw*rk) must
+            # stay inside the pages reserved at admission: block-table
+            # entries past the footprint alias pool page 0, so an
+            # unclamped tree near its max_new budget would scatter draft
+            # K/V into another sequence's page. Chains can't overflow —
+            # rk <= max_new - len(out) already bounds q_pos + rk.
+            cap_tok = len(self.alloc.PagesOf(seq.id)) * self.alloc.page_size
+            room = cap_tok - 1 - seq.pos
+          want = rw
+          while rw > 1 and (1 + rw * rk > cap or rw * rk > room):
+            rw -= 1
+          if rw < want:
+            width_clamps += 1
+          if rw > 1:
+            rk = min(rk, (cap - 1) // rw)
+        if rw == 1:
+          rk = min(rk, wmax - 1)   # exact chain clamp (pre-tree behavior)
       row_k[i] = rk
+      row_w[i] = rw
       any_spec = any_spec or rk > 0
       ids0[i, 0] = seq.out[-1]
-      row_len[i] = rk + 1
-      budget -= rk + 1
+      row_len[i] = 1 + rw * rk
+      budget -= 1 + rw * rk
+      if rw > 1:
+        # DFS preorder parents: branch bi is a chain whose head hangs off
+        # the root (-1) and whose depth-d node follows its predecessor
+        parents = np.empty((rw * rk,), np.int32)
+        for bi in range(rw):
+          for d in range(rk):
+            j = bi * rk + d
+            parents[j] = -1 if d == 0 else j - 1
+        row_parents[i] = parents
     assert budget >= 0, (t, row_len)  # engine sizes t for worst-case decode
     prompt_tokens = 0
     for i, seq in enumerate(rows):
@@ -625,7 +692,8 @@ class Scheduler:
       row_len[i] = n
       budget -= n
       prompt_tokens += n
-    desc = ragged.BuildRaggedRows(row_len, row_q_pos, t, wmax)
+    desc = ragged.BuildRaggedRows(row_len, row_q_pos, t, wmax,
+                                  row_parents or None)
     tok_ids = np.zeros((t,), np.int32)
     for i, seq in enumerate(rows):
       n = int(row_len[i])
@@ -641,9 +709,10 @@ class Scheduler:
         # slot this row writes (and, on spec rollback, REWRITES) lives in
         # pages CoW-private to it
         self.alloc.AssertExclusive(seq.id, seq.pos, n)
+    self.width_clamps += width_clamps
     return RaggedBatch(tok_ids, desc, rows, prompt_tokens > 0,
                        prompt_tokens, row_seeds, row_pos, row_k, any_spec,
-                       ids0)
+                       ids0, row_w=row_w, width_clamps=width_clamps)
 
   def _Finish(self, i: int, seq: Sequence, done_eos: bool):
     """Retires slot i's sequence (shared CommitRaggedStep epilogue)."""
@@ -690,10 +759,15 @@ class Scheduler:
       elif seq.state is SeqState.DECODE:
         rk = int(batch.row_k[i])
         if rk > 0:
-          # spec-verify lane: accepted prefix + correction/bonus, cursor
-          # rollback over the rejected tail — CommitVerifyStep semantics
+          # spec-verify lane: accepted path + correction/bonus, cursor
+          # rollback over every other tree node — CommitVerifyStep
+          # semantics generalized to row_w branches (chain: row_w == 1).
+          # The engine's in-program KV repair already moved the accepted
+          # path's K/V into the canonical chain slots, so advancing
+          # seq.pos by m + 1 lands on bit-correct cache state.
+          rw = int(batch.row_w[i])
           m = min(int(accept_len[i]), rk)
-          self.alloc.NoteRollback(rk - m)
+          self.alloc.NoteRollback(rw * rk - m)
           committed = 0
           for j in range(m + 1):
             tok = int(out_tokens[i, j])
@@ -746,4 +820,5 @@ class Scheduler:
         "needs_kv_pages": self.needs_kv_pages,
         "slots_live_peak": self.slots_live_peak,
         "prefix_ordered_admissions": self.prefix_ordered_admissions,
+        "width_clamps": self.width_clamps,
     }

@@ -43,6 +43,21 @@ gathered logits inside the same jit), so speculation adds only the draft
 program(s). In legacy mode the verify step is a THIRD compiled step
 program ([B, k+1]) next to decode and mixed. Either way,
 admission/eviction still only rewrite int32 block tables.
+
+TREE speculation (w > 1 on either draft source, ragged mode only): the
+draft proposes a token TREE per row — w branches forked at depth 1, each
+a chain of k tokens, packed branch-major so draft index bi * k + d is
+branch bi's depth-(d+1) node. Branch heads are the top-w tokens of the
+root distribution at temperature 0 and w i.i.d. draws from it otherwise
+(i.i.d. siblings are what keeps the verify's multi-round residual
+rejection exactly target-distributed); each branch then continues as an
+ordinary chain draft under a branch-folded key. Draft-phase KV/state
+writes stay TRANSIENT — branches sequentially overwrite each other's
+scratch slots, which can only cost acceptance rate, never correctness,
+because the unified step re-writes every tree slot at full depth and
+core/sampling.SpecVerifyTree guarantees the emitted stream. ModelDraft
+checkpoints its recurrent state after the committed catch-up and replays
+every branch from that checkpoint.
 """
 
 from __future__ import annotations
@@ -107,30 +122,39 @@ def MixerCensus(task) -> dict:
 class SelfDraft:
   """Early-exit self-speculation: first `num_layers` of the target stack.
 
-  k: draft tokens proposed per decode row per cycle (verify width k+1).
+  k: draft depth proposed per decode row per cycle (chain verify width
+  k+1; tree verify width 1 + w*k). w: draft-tree width — 1 (default)
+  keeps the exact linear-chain draft, w > 1 forks w branches at depth 1.
   num_layers: flat trunk depth of the draft pass (must divide the scanned
   repeat-body depth for RepeatedTransformerLayer stacks)."""
 
-  def __init__(self, k: int = 4, num_layers: int = 1):
-    assert k >= 1 and num_layers >= 1, (k, num_layers)
+  def __init__(self, k: int = 4, num_layers: int = 1, w: int = 1):
+    assert k >= 1 and num_layers >= 1 and w >= 1, (k, num_layers, w)
     self.k = int(k)
+    self.w = int(w)
     self.num_layers = int(num_layers)
 
   def Describe(self) -> dict:
-    return {"draft": "self", "k": self.k, "num_layers": self.num_layers}
+    return {"draft": "self", "k": self.k, "w": self.w,
+            "num_layers": self.num_layers}
 
 
 class ModelDraft:
-  """Independent tiny draft model (pure O(1)-state stack, pageless)."""
+  """Independent tiny draft model (pure O(1)-state stack, pageless).
 
-  def __init__(self, task, theta, k: int = 4):
-    assert k >= 1, k
+  w: draft-tree width — 1 (default) keeps the exact linear-chain draft,
+  w > 1 forks w branches at depth 1, each replayed from the recurrent
+  state checkpointed after the committed catch-up."""
+
+  def __init__(self, task, theta, k: int = 4, w: int = 1):
+    assert k >= 1 and w >= 1, (k, w)
     self.k = int(k)
+    self.w = int(w)
     self.task = task
     self.theta = theta
 
   def Describe(self) -> dict:
-    return {"draft": "model", "k": self.k,
+    return {"draft": "model", "k": self.k, "w": self.w,
             "num_layers": self.task.p.num_layers}
 
 
@@ -184,6 +208,7 @@ class SpecRunner:
                top_k: int, sample_seed: int, compile_log=None):
     self.config = config
     self.k = config.k
+    self.w = getattr(config, "w", 1)
     # optional observe.CompileLog: routes the verify program through a
     # one-shot AOT compile so the engine's compile records cover all
     # three step programs (decode / mixed / spec_verify)
@@ -197,7 +222,8 @@ class SpecRunner:
     self._prefill_chunk = prefill_chunk
     self._has_ssm = MixerCensus(task)["num_ssm"] > 0
     # accepted-length histogram: hist[m] = verify rows whose accepted
-    # draft prefix had length m (each such row committed m + 1 tokens)
+    # draft prefix (tree: accepted root-to-leaf DEPTH along the winning
+    # branch) had length m — each such row committed m + 1 tokens
     self.accepted_len_hist = np.zeros((self.k + 1,), np.int64)
 
     if self.is_self:
@@ -261,6 +287,23 @@ class SpecRunner:
       return jax.random.fold_in(jax.random.PRNGKey(base_key),
                                 _DRAFT_KEY_SALT)
 
+    w = self.w
+
+    def _BranchHeads(l0, key_d, seeds, pos0):
+      # depth-1 sibling set from the shared root distribution l0: the
+      # top-w distinct tokens at temperature 0 (maximum acceptance mass),
+      # w i.i.d. branch-keyed draws otherwise — the i.i.d. sibling law
+      # SpecVerifyTree's multi-round residual rejection is exact for
+      if temp <= 0.0:
+        return jax.lax.top_k(l0, w)[1].astype(jnp.int32)
+      cols = []
+      for bi in range(w):
+        kb = key_d if bi == 0 else jax.random.fold_in(key_d, bi)
+        cols.append(sampling.SampleFromLogits(
+            l0, kb, temperature=temp, top_k=topk, row_seeds=seeds,
+            positions=pos0))
+      return jnp.stack(cols, 1)
+
     if self.is_self:
       num_layers = self.config.num_layers
 
@@ -283,7 +326,42 @@ class SpecRunner:
         # the verify step re-writes every kept position at full depth
         return jnp.stack(d_toks, 1), jnp.stack(q_logits, 1)
 
-      self._self_draft_fn = jax.jit(_SelfPropose)
+      def _SelfProposeTree(theta, states, ids0, q_pos, act, tables, seeds,
+                           pos0):
+        key_d = _DraftKey()
+        # root step: the shared depth-1 distribution every branch head is
+        # picked from (its KV write at q_pos is transient, like all draft
+        # writes — the unified step re-writes every tree slot)
+        logits0, st = task.PagedStepPrefix(theta, ids0, states, tables,
+                                           q_pos, act, num_layers)
+        l0 = logits0[:, 0]
+        heads = _BranchHeads(l0, key_d, seeds, pos0)           # [B, w]
+        d_toks = [None] * (w * k)
+        q_logits = [None] * (w * k)
+        for bi in range(w):
+          kb = key_d if bi == 0 else jax.random.fold_in(key_d, bi)
+          cur = heads[:, bi]
+          d_toks[bi * k] = cur
+          q_logits[bi * k] = l0
+          # each branch continues as an ordinary chain draft over the
+          # SAME scratch slots q_pos+1.. — later branches overwrite
+          # earlier ones' transient KV, and each step only attends slots
+          # <= its own position, so every branch sees exactly
+          # prefix + root + its own prefix
+          for d in range(1, k):
+            logits, st = task.PagedStepPrefix(theta, cur[:, None], st,
+                                              tables, q_pos + d, act,
+                                              num_layers)
+            lj = logits[:, 0]
+            cur = sampling.SampleFromLogits(
+                lj, kb, temperature=temp, top_k=topk, row_seeds=seeds,
+                positions=pos0 + d)
+            d_toks[bi * k + d] = cur
+            q_logits[bi * k + d] = lj
+        return jnp.stack(d_toks, 1), jnp.stack(q_logits, 1)
+
+      self._self_draft_fn = jax.jit(_SelfPropose if w == 1
+                                    else _SelfProposeTree)
     else:
       draft_task = self.draft_task
 
@@ -321,7 +399,39 @@ class SpecRunner:
             cur = lj[:, 0]
         return jnp.stack(d_toks, 1), jnp.stack(q_logits, 1), st
 
-      self._propose_fn = jax.jit(_Propose)
+      def _ProposeTree(theta_d, states_d, catch_ids, dpos, clen, seeds,
+                       pos0):
+        tables = jnp.zeros((catch_ids.shape[0], 1), jnp.int32)
+        key_d = _DraftKey()
+        # committed catch-up advances the KEPT draft state st; every
+        # branch below replays from that checkpoint transiently
+        logits_c, st = draft_task.PagedStep(theta_d, catch_ids, states_d,
+                                            tables, dpos, clen)
+        last = jnp.clip(clen - 1, 0, k)[:, None, None]
+        l0 = jnp.take_along_axis(logits_c, last, axis=1)[:, 0]
+        act = (clen > 0).astype(jnp.int32)
+        heads = _BranchHeads(l0, key_d, seeds, pos0)           # [B, w]
+        d_toks = [None] * (w * k)
+        q_logits = [None] * (w * k)
+        for bi in range(w):
+          kb = key_d if bi == 0 else jax.random.fold_in(key_d, bi)
+          st_t = st
+          cur_tok = heads[:, bi]
+          cur = l0
+          for d in range(k):
+            d_toks[bi * k + d] = cur_tok
+            q_logits[bi * k + d] = cur
+            if d < k - 1:
+              lj, st_t = draft_task.PagedStep(
+                  theta_d, cur_tok[:, None], st_t, tables,
+                  dpos + clen + d, act)
+              cur = lj[:, 0]
+              cur_tok = sampling.SampleFromLogits(
+                  cur, kb, temperature=temp, top_k=topk, row_seeds=seeds,
+                  positions=pos0 + d + 1)
+        return jnp.stack(d_toks, 1), jnp.stack(q_logits, 1), st
+
+      self._propose_fn = jax.jit(_Propose if w == 1 else _ProposeTree)
 
   # -- host-side draft-state bookkeeping (ModelDraft) ------------------------
 

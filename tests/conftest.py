@@ -66,3 +66,56 @@ try:
   jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 except Exception:
   pass
+
+# -- shared tiny LMs (session-scoped) -----------------------------------------
+# One instantiation of each tiny model serves EVERY serving-stack test
+# module (test_serving_engine / test_spec_decode / test_ragged_step /
+# test_tree_spec): theta init and jit warm-up are the dominant fixture
+# cost, and hoisting them session-wide is what keeps the suite inside the
+# verify budget as the serving matrix grows.
+
+import pytest  # noqa: E402
+
+
+def TinyLmParams(every_n=None, num_layers=2, use_repeat=False, **overrides):
+  """The stack-under-test: 2-layer rotary TransformerLm, vocab 64.
+
+  every_n switches attention mixers for GatedSSMLayer every n layers
+  (0 = pure O(1)-state stack, the only shape ModelDraft accepts)."""
+  from lingvo_tpu.core import ssm
+  from lingvo_tpu.models.lm import layers as lm_layers
+  p = lm_layers.TransformerLm.Params().Set(
+      name="lm", vocab_size=64, model_dim=32, num_layers=num_layers,
+      num_heads=2, hidden_dim=64, use_rotary=True)
+  if every_n is not None:
+    p = p.Set(use_repeat_layer=use_repeat,
+              mixer_tpl=ssm.GatedSSMLayer.Params().Set(state_dim=8,
+                                                       chunk_size=4),
+              mixer_atten_every_n=every_n)
+  return p.Set(**overrides)
+
+
+def InstantiateLm(p, seed=0):
+  import jax
+  task = p.Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(seed))
+  return task, theta
+
+
+@pytest.fixture(scope="session")
+def tiny_lm():
+  return InstantiateLm(TinyLmParams())
+
+
+@pytest.fixture(scope="session")
+def hybrid_lm():
+  # flat (non-repeat) stack so a 1-layer early-exit prefix is legal; the
+  # repeat-stack prefix path gets its own engine tests
+  return InstantiateLm(TinyLmParams(every_n=2, use_repeat=False))
+
+
+@pytest.fixture(scope="session")
+def ssm_draft_lm():
+  # pure O(1)-state stack: the only shape ModelDraft accepts (pageless)
+  return InstantiateLm(TinyLmParams(every_n=0), seed=1)

@@ -19,6 +19,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from lingvo_tpu.core import ragged
 from lingvo_tpu.ops import block_decode
 from lingvo_tpu.ops import ragged_block_attend
 from lingvo_tpu.quant import kv as kv_quant
@@ -192,3 +193,138 @@ class TestRaggedAttend:
     assert ragged_block_attend.SupportedOnTpu(128, 128)
     assert not ragged_block_attend.SupportedOnTpu(8, 128)
     assert not ragged_block_attend.SupportedOnTpu(128, 8)
+
+
+class TestAncestorMaskedAttend:
+  """Per-token in-step ancestor visibility (tree speculation).
+
+  A tree token's horizon is its causal window MINUS in-window slots that
+  are not on its root path: slot s is visible iff s < q_end and (s below
+  the row's step window, or bit (s - q_start) of the token's ancestor
+  mask is set). Chain rows ship the -1/-1 sentinel masks and must stay
+  BITWISE the unmasked kernel."""
+
+  _Inputs = TestRaggedAttend._Inputs
+  _Both = TestRaggedAttend._Both
+
+  @staticmethod
+  def _TreeRow(q_pos, parents):
+    """Per-token (q_end, q_start, lo, hi) for one DFS-packed tree row."""
+    lo, hi = ragged.TreeAncestorMasks(parents)
+    n = len(parents) + 1
+    q_end = q_pos + 1 + np.arange(n)          # own DFS slot inclusive
+    q_start = np.full((n,), q_pos, np.int32)
+    return q_end.astype(np.int32), q_start, lo, hi
+
+  @staticmethod
+  def _MaskedDenseRef(q, k_pool, v_pool, tables, row_of, q_end, q_start,
+                      lo, hi):
+    t, n, h = q.shape
+    out = np.zeros_like(q)
+    for ti in range(t):
+      end = int(q_end[ti])
+      if end == 0:
+        continue
+      mask = (np.int64(np.uint32(lo[ti]))
+              | (np.int64(np.uint32(hi[ti])) << 32))
+      slots = np.arange(end)
+      c = np.clip(slots - int(q_start[ti]), 0, 63)
+      keep = ((mask >> c) & 1).astype(bool)
+      kk = k_pool[tables[int(row_of[ti])]].reshape(-1, n, h)[:end][keep]
+      vv = v_pool[tables[int(row_of[ti])]].reshape(-1, n, h)[:end][keep]
+      s = np.einsum("nh,snh->ns", q[ti], kk)
+      s = s - s.max(axis=-1, keepdims=True)
+      p = np.exp(s)
+      p /= p.sum(axis=-1, keepdims=True)
+      out[ti] = np.einsum("ns,snh->nh", p, vv)
+    return out
+
+  def test_tree_row_matches_masked_dense_reference(self):
+    """A w=2,k=2 tree row next to a plain decode row: each tree token
+    sees the committed prefix + its own root path, never its siblings;
+    XLA == Pallas(interpret) bitwise throughout."""
+    q, k_pool, v_pool, tables = self._Inputs()
+    parents = [-1, 0, -1, 2]
+    t_end, t_start, t_lo, t_hi = self._TreeRow(6, parents)
+    row_of = np.array([0] * 5 + [1, 0, 0], np.int32)
+    q_end = np.concatenate([t_end, [9, 0, 0]]).astype(np.int32)
+    q_start = np.concatenate([t_start, [0, 0, 0]]).astype(np.int32)
+    lo = np.concatenate([t_lo, [-1, -1, -1]]).astype(np.int32)
+    hi = np.concatenate([t_hi, [-1, -1, -1]]).astype(np.int32)
+    out = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                     row_of, q_end, q_start=q_start, anc_lo=lo, anc_hi=hi)
+    ref = self._MaskedDenseRef(q, k_pool, v_pool, tables, row_of, q_end,
+                               q_start, lo, hi)
+    np.testing.assert_allclose(out, ref, atol=5e-6)
+    # the two branches are built over the same prefix but must differ
+    # (each excludes the other's slots); padding stays exactly zero
+    assert not np.array_equal(out[2], out[4])
+    np.testing.assert_array_equal(out[7], np.zeros_like(out[7]))
+
+  def test_chain_sentinels_bitwise_equal_unmasked(self):
+    """-1/-1 masks with any q_start reproduce the unmasked kernel BIT FOR
+    BIT on a mixed decode/prefill/verify pack — the no-regression proof
+    for every pre-tree serving shape."""
+    q, k_pool, v_pool, tables = self._Inputs()
+    row_of = np.array([0, 1, 1, 1, 2, 2, 2, 0], np.int32)
+    q_end = np.array([9, 5, 6, 7, 12, 13, 14, 0], np.int32)
+    q_start = np.array([8, 2, 2, 2, 9, 9, 9, 0], np.int32)
+    neg = np.full((8,), -1, np.int32)
+    base = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool), tables,
+                      row_of, q_end)
+    masked = self._Both(q, jnp.asarray(k_pool), jnp.asarray(v_pool),
+                        tables, row_of, q_end, q_start=q_start,
+                        anc_lo=neg, anc_hi=neg)
+    np.testing.assert_array_equal(base, masked)
+
+  def test_masked_twins_bitwise_incl_page_reuse(self):
+    """XLA == Pallas(interpret) bitwise on ancestor-masked packs before
+    AND after a real allocator eviction hands one row's pages to another
+    (the _Both helper asserts the twin equality on every call)."""
+    q, k_pool, v_pool, tables = self._Inputs(b=2, t=5)
+    k_pool = jnp.asarray(k_pool)
+    v_pool = jnp.asarray(v_pool)
+    parents = [-1, 0, 1, -1]                     # a 3-chain + 1 sibling
+    t_end, t_start, lo5, hi5 = self._TreeRow(8, parents)
+    row_of = np.array([0] * 5, np.int32)
+    self._Both(q, k_pool, v_pool, tables, row_of, t_end,
+               q_start=t_start, anc_lo=lo5, anc_hi=hi5)
+    alloc = kv_cache.PageAllocator(num_pages=4, page_size=8)
+    alloc.Allocate("a", 2)
+    alloc.Allocate("b", 2)
+    alloc.Free("a")
+    reused = alloc.Allocate("c", 2)
+    rng = np.random.RandomState(7)
+    for pg in reused:
+      k_pool = k_pool.at[pg].set(rng.randn(8, 1, 8).astype(np.float32))
+      v_pool = v_pool.at[pg].set(rng.randn(8, 1, 8).astype(np.float32))
+    tables2 = np.array([reused, list(alloc.PagesOf("b"))], np.int32)
+    out = self._Both(q, k_pool, v_pool, tables2, row_of, t_end,
+                     q_start=t_start, anc_lo=lo5, anc_hi=hi5)
+    ref = self._MaskedDenseRef(q, np.asarray(k_pool), np.asarray(v_pool),
+                               tables2, row_of, t_end, t_start, lo5, hi5)
+    np.testing.assert_allclose(out, ref, atol=5e-6)
+
+  def test_int8_masked_twins_bitwise(self):
+    """The int8 path composes with ancestor masks: quantized XLA ==
+    quantized Pallas(interpret) bitwise, both == the float kernel on
+    dequantized pools."""
+    q, k_pool, v_pool, tables = self._Inputs()
+    k8, ks, v8, vs = _QuantizePools(k_pool, v_pool)
+    kf = kv_quant.DequantKv(k8.swapaxes(1, 2), ks).swapaxes(1, 2)
+    vf = kv_quant.DequantKv(v8.swapaxes(1, 2), vs).swapaxes(1, 2)
+    parents = [-1, 0, -1, 2]
+    t_end, t_start, t_lo, t_hi = self._TreeRow(6, parents)
+    row_of = np.array([0] * 5 + [1, 1, 1], np.int32)
+    q_end = np.concatenate([t_end, [5, 6, 7]]).astype(np.int32)
+    q_start = np.concatenate([t_start, [4, 4, 4]]).astype(np.int32)
+    lo = np.concatenate([t_lo, [-1, -1, -1]]).astype(np.int32)
+    hi = np.concatenate([t_hi, [-1, -1, -1]]).astype(np.int32)
+    out_q = self._Both(q, k8, v8, tables, row_of, q_end, k_scale=ks,
+                       v_scale=vs, q_start=q_start, anc_lo=lo, anc_hi=hi)
+    out_f = ragged_block_attend.RaggedAttend(
+        jnp.asarray(q), kf, vf, jnp.asarray(tables), jnp.asarray(row_of),
+        jnp.asarray(q_end), page_size=8, lowering="xla",
+        q_start=jnp.asarray(q_start), anc_lo=jnp.asarray(lo),
+        anc_hi=jnp.asarray(hi))
+    np.testing.assert_array_equal(out_q, np.asarray(out_f))

@@ -34,8 +34,8 @@ from lingvo_tpu.serving import scheduler as scheduler_lib
 from lingvo_tpu.serving import spec_decode
 
 from tests.test_spec_decode import (_Instantiate, _LmParams, _Stream,
-                                    _RunStream, hybrid_lm, ssm_draft_lm,
-                                    tiny_lm)  # noqa: F401
+                                    _RunStream)  # noqa: F401
+# tiny_lm / hybrid_lm / ssm_draft_lm fixtures: session-scoped in conftest.py
 
 
 def _Engine(task, theta, spec=None, *, step_mode="ragged", **kw):

@@ -30,29 +30,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from lingvo_tpu.observe import schema as observe_schema
 from lingvo_tpu.ops import block_decode
 from lingvo_tpu.serving import engine as engine_lib
 from lingvo_tpu.serving import kv_cache
 from lingvo_tpu.serving import scheduler as scheduler_lib
 
 
-# -- shared tiny LM (module-scoped: every engine test reuses one theta) ------
+# -- shared tiny LM (session-scoped `tiny_lm` fixture: conftest.py) ----------
 
-
-def _TinyLmParams(**overrides):
-  from lingvo_tpu.models.lm import layers as lm_layers
-  p = lm_layers.TransformerLm.Params().Set(
-      name="lm", vocab_size=64, model_dim=32, num_layers=2, num_heads=2,
-      hidden_dim=64, use_rotary=True)
-  return p.Set(**overrides)
-
-
-@pytest.fixture(scope="module")
-def tiny_lm():
-  task = _TinyLmParams().Instantiate()
-  task.FinalizePaths()
-  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
-  return task, theta
+from tests.conftest import TinyLmParams as _TinyLmParams  # noqa: E402
 
 
 # one jitted ExtendStep per task and one memoized rollout per prompt: the
@@ -503,22 +490,16 @@ class TestServingEngine:
     recs = driver.DecodeOnce(1, prompts, lens)
     telem = driver._last_telemetry
     assert telem is not None
-    assert set(telem) == {"prefill_s", "decode_s", "total_s",
-                          "prompt_tokens", "decode_tokens",
-                          "tokens_per_sec", "decode_state_bytes_per_seq",
-                          "kv_cache_dtype", "kv_bytes_per_token",
-                          "serve_int8_weights", "draft_tokens",
-                          "accepted_tokens", "accepted_len_hist",
-                          "prefix_hit_tokens", "prefix_cache",
-                          "step_programs"}
+    # the telemetry key set is single-sourced in observe/schema.py — the
+    # exact-match assertion catches keys landing on only one surface
+    assert set(telem) == set(observe_schema.GSHARD_TELEMETRY_KEYS)
+    assert {"spec_branches", "spec_width_clamps",
+            "accepted_depth_hist"} <= set(telem)
     # compiled-step-program census: one (p_len, t_max) bucket was used,
     # and this driver compiles a (prefill, sample) program pair per bucket
     assert telem["step_programs"] == 2
-    # the literal set above IS the shared schema: the telemetry dict is
-    # generated from observe.schema, so any key added to one surface
-    # without the other now fails here, not in a bench comparison
-    from lingvo_tpu.observe import schema as observe_schema
-    assert set(telem) == set(observe_schema.GSHARD_TELEMETRY_KEYS)
+    # the telemetry dict is generated from observe.schema, so any key added
+    # to one surface without the other fails here, not in a bench comparison
     assert list(telem) == list(observe_schema.GSHARD_TELEMETRY_KEYS)
     # both surfaces share the mirrored keys by construction
     assert observe_schema.SHARED_SERVING_KEYS <= set(telem)

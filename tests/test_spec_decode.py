@@ -38,45 +38,10 @@ from lingvo_tpu.serving import scheduler as scheduler_lib
 from lingvo_tpu.serving import spec_decode
 
 
-# -- shared tiny models -------------------------------------------------------
+# -- shared tiny models: session-scoped fixtures live in conftest.py ----------
 
-
-def _LmParams(every_n=None, num_layers=2, use_repeat=False):
-  from lingvo_tpu.models.lm import layers as lm_layers
-  p = lm_layers.TransformerLm.Params().Set(
-      name="lm", vocab_size=64, model_dim=32, num_layers=num_layers,
-      num_heads=2, hidden_dim=64, use_rotary=True)
-  if every_n is not None:
-    p = p.Set(use_repeat_layer=use_repeat,
-              mixer_tpl=ssm.GatedSSMLayer.Params().Set(state_dim=8,
-                                                       chunk_size=4),
-              mixer_atten_every_n=every_n)
-  return p
-
-
-def _Instantiate(p, seed=0):
-  task = p.Instantiate()
-  task.FinalizePaths()
-  theta = task.InstantiateVariables(jax.random.PRNGKey(seed))
-  return task, theta
-
-
-@pytest.fixture(scope="module")
-def tiny_lm():
-  return _Instantiate(_LmParams())
-
-
-@pytest.fixture(scope="module")
-def hybrid_lm():
-  # flat (non-repeat) stack so a 1-layer early-exit prefix is legal; the
-  # repeat-stack prefix path gets its own engine test below
-  return _Instantiate(_LmParams(every_n=2, use_repeat=False))
-
-
-@pytest.fixture(scope="module")
-def ssm_draft_lm():
-  # pure O(1)-state stack: the only shape ModelDraft accepts (pageless)
-  return _Instantiate(_LmParams(every_n=0), seed=1)
+from tests.conftest import InstantiateLm as _Instantiate  # noqa: E402
+from tests.conftest import TinyLmParams as _LmParams  # noqa: E402
 
 
 def _Engine(task, theta, spec=None, *, max_batch=3, num_pages=24,
@@ -456,7 +421,8 @@ class TestSpecEngine:
     eng.RunBatch(np.array([[5, 6]], np.int32), np.array([2], np.int32), 6)
     stats = eng.Stats()
     observe_schema.ValidateEngineStats(stats)
-    assert stats["spec"] == {"draft": "self", "k": 3, "num_layers": 1}
+    assert stats["spec"] == {"draft": "self", "k": 3, "w": 1,
+                             "num_layers": 1}
     assert len(stats["accepted_len_hist"]) == 4   # k + 1 buckets
     assert sum(stats["accepted_len_hist"]) == stats["spec_cycles"]
 
