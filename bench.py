@@ -1287,6 +1287,199 @@ def _BenchPrefixCache(jax, jnp, model_registry, on_tpu):
   }
 
 
+def _BenchFleet(jax, jnp, model_registry, on_tpu):
+  """Disaggregated serving fleet: prefix router + prefill/decode split
+  (ISSUE 19). Two arms, each against its honest baseline on an identical
+  seeded request tape, greedy streams byte-compared in every arm:
+
+  - **routing**: 4 chat sessions, each opening with its own long system
+    prompt, into a 2-replica fleet whose per-replica page pools hold
+    only ~2 of the 4 prompts. The prefix-aware router pins each session
+    to one home, so the fleet's caches partition the working set;
+    round-robin sprays every session across both replicas and thrashes
+    both pools. Acceptance: `prefill_tokens_ratio` (round_robin /
+    prefix prompt tokens actually computed; bar >= 1.5 at ~0.9 share
+    fraction) and `streams_identical` across prefix, round_robin AND a
+    single big-pool replica.
+  - **disagg**: short interactive probes decode while long, length-
+    varied prompts stream in. Unified = two step_mode='legacy' replicas
+    doing both jobs (a mixed legacy step widens to prefill_chunk, so a
+    long prefill genuinely stalls co-scheduled decodes); disagg = one
+    prefill worker + one legacy decode replica receiving finished KV
+    pages page-granularly (engine.AdoptPrefix), so the decode replica
+    never computes more than a page-tail of prompt. Acceptance: probe
+    `decode_p99_tpot_ratio` (disagg / unified; bar <= 1.1) and
+    `streams_identical` between the arms.
+  """
+  from lingvo_tpu.serving import engine as engine_lib
+  from lingvo_tpu.serving import fleet as fleet_lib
+
+  rng = np.random.RandomState(0)
+  if on_tpu:
+    page, pool, big_pool, b_slots, chunk = 128, 24, 96, 1, 128
+    sys_len, tail_len, max_new, max_seq = 512, 64, 32, 1024
+    d_pool, d_slots, d_seq = 256, 4, 2048
+    bg_lo, bg_hi, bg_new, n_bg, n_probe, probe_new = 128, 1024, 16, 12, 8, 32
+  else:
+    page, pool, big_pool, b_slots, chunk = 8, 12, 48, 1, 8
+    sys_len, tail_len, max_new, max_seq = 32, 4, 8, 64
+    d_pool, d_slots, d_seq = 48, 4, 96
+    bg_lo, bg_hi, bg_new, n_bg, n_probe, probe_new = 8, 64, 4, 10, 8, 8
+
+  mp = model_registry.GetParams("lm.synthetic_packed_input.DenseLmTiny",
+                                "Train")
+  mp.task.input = mp.input
+  mp.task.use_rotary = True
+  if on_tpu:
+    mp.task.model_dim = 512
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 1024
+  else:
+    mp.task.model_dim = 256
+    mp.task.num_layers = 4
+    mp.task.num_heads = 4
+    mp.task.hidden_dim = 512
+  task = mp.task.Instantiate()
+  task.FinalizePaths()
+  theta = task.InstantiateVariables(jax.random.PRNGKey(0))
+  vocab = task.p.vocab_size
+
+  # -- routing arm ------------------------------------------------------------
+  n_sessions = 4
+  sys_prompts = [rng.randint(1, vocab, sys_len).astype(np.int32)
+                 for _ in range(n_sessions)]
+
+  def _Turn(s):
+    tail = rng.randint(1, vocab, tail_len).astype(np.int32)
+    return np.concatenate([sys_prompts[s], tail])
+
+  openers = [_Turn(s) for s in range(n_sessions)]
+  steady = []
+  for i in range(20):   # 18 session turns + 2 unshared: 0.9 share fraction
+    if i % 10 == 9:
+      steady.append((rng.randint(1, vocab, sys_len + tail_len).astype(
+          np.int32), None))
+    else:
+      steady.append((_Turn(i % n_sessions), i % n_sessions))
+  # shuffled so round_robin's alternation can't accidentally partition the
+  # sessions the way the prefix router does on purpose
+  rng.shuffle(steady)
+  share = (n_sessions + sum(1 for _, s in steady if s is not None)) / (
+      n_sessions + len(steady))
+  load_key = ("scheduler/queue_depth", "scheduler/slots_live")
+
+  def _MkEng(np_pages):
+    return engine_lib.ServingLoop(
+        task, theta, page_size=page, num_pages=np_pages, max_batch=b_slots,
+        max_seq_len=max_seq, prefill_chunk=chunk, prefix_cache=True)
+
+  def _PlayRouting(policy, n_replicas=2, np_pages=None):
+    np_pages = pool if np_pages is None else np_pages
+    engines = {f"r{i}": _MkEng(np_pages) for i in range(n_replicas)}
+    fl = fleet_lib.ServingFleet(engines, policy=policy,
+                                load_key=load_key).Start()
+    # opener burst: in-flight load spreads the sessions over the fleet
+    hs = [fl.Submit(p, max_new, session=f"s{s}")
+          for s, p in enumerate(openers)]
+    streams = [h.Result(timeout=1200) for h in hs]
+    for p, s in steady:   # steady state: sequential, fully deterministic
+      h = fl.Submit(p, max_new, session=None if s is None else f"s{s}")
+      streams.append(h.Result(timeout=1200))
+    pt = sum(fl.Engine(lb).Stats()["prompt_tokens"] for lb in fl.order)
+    emitted = {lb: fl.Engine(lb).Stats()["tokens_emitted"]
+               for lb in fl.order}
+    stats = fl.Stats()
+    fl.Stop()
+    return streams, pt, emitted, stats
+
+  s_prefix, pt_prefix, em_prefix, fstats = _PlayRouting("prefix")
+  s_rr, pt_rr, em_rr, _ = _PlayRouting("round_robin")
+  s_single, pt_single, _, _ = _PlayRouting("prefix", n_replicas=1,
+                                           np_pages=big_pool)
+  ratio = pt_rr / max(pt_prefix, 1)
+
+  # -- disaggregation arm -----------------------------------------------------
+  bg_prompts = [rng.randint(1, vocab, int(L)).astype(np.int32)
+                for L in rng.randint(bg_lo, bg_hi + 1, n_bg)]
+  probe_prompts = [rng.randint(1, vocab, page - 1).astype(np.int32)
+                   for _ in range(n_probe)]   # sub-page: never handed off
+
+  def _MkLegacy():
+    return engine_lib.ServingLoop(
+        task, theta, page_size=page, num_pages=d_pool, max_batch=d_slots,
+        max_seq_len=d_seq, prefill_chunk=chunk, prefix_cache=True,
+        step_mode="legacy")
+
+  def _PlayDisagg(disagg):
+    if disagg:
+      fl = fleet_lib.ServingFleet({"d0": _MkLegacy()},
+                                  prefill={"p0": _MkLegacy()}).Start()
+    else:
+      fl = fleet_lib.ServingFleet({"u0": _MkLegacy(), "u1": _MkLegacy()},
+                                  policy="round_robin").Start()
+    streams, bg_handles, tpot = {}, [], []
+    pi = 0
+    for i, p in enumerate(bg_prompts):
+      bg_handles.append((i, fl.Submit(p, bg_new)))
+      if i % 2 == 1 and pi < n_probe:
+        # probe while prefills are in flight: TPOT feels the interference
+        t0 = time.perf_counter()
+        h = fl.Submit(probe_prompts[pi], probe_new)
+        streams[f"probe{pi}"] = h.Result(timeout=1200)
+        tpot.append((time.perf_counter() - t0) / probe_new)
+        pi += 1
+    while pi < n_probe:
+      t0 = time.perf_counter()
+      h = fl.Submit(probe_prompts[pi], probe_new)
+      streams[f"probe{pi}"] = h.Result(timeout=1200)
+      tpot.append((time.perf_counter() - t0) / probe_new)
+      pi += 1
+    for i, h in bg_handles:
+      streams[f"bg{i}"] = h.Result(timeout=1200)
+    stats = fl.Stats()
+    fl.Stop()
+    return streams, np.asarray(tpot, np.float64), stats
+
+  su, tu, _ = _PlayDisagg(False)
+  sd, td, dstats = _PlayDisagg(True)
+  u50, u99 = np.percentile(tu, 50), np.percentile(tu, 99)
+  d50, d99 = np.percentile(td, 50), np.percentile(td, 99)
+
+  return {
+      "routing": {
+          "sessions": n_sessions,
+          "requests": n_sessions + len(steady),
+          "share_fraction": round(share, 3),
+          "system_prompt_tokens": sys_len,
+          "page_size": page,
+          "num_pages_per_replica": pool,
+          "prefill_tokens": {"prefix": pt_prefix, "round_robin": pt_rr,
+                             "single_big_pool": pt_single},
+          "prefill_tokens_ratio": round(ratio, 3),
+          "routing_win": bool(ratio >= 1.5),
+          "streams_identical": bool(s_prefix == s_rr == s_single),
+          "tokens_emitted": {"prefix": em_prefix, "round_robin": em_rr},
+          "router": fstats["router"],
+      },
+      "disagg": {
+          "probes": n_probe,
+          "background_prompts": n_bg,
+          "prompt_len_range": [int(bg_lo), int(bg_hi)],
+          "probe_tpot_ms": {
+              "unified": {"p50": round(u50 * 1e3, 3),
+                          "p99": round(u99 * 1e3, 3)},
+              "disagg": {"p50": round(d50 * 1e3, 3),
+                         "p99": round(d99 * 1e3, 3)}},
+          "decode_p99_tpot_ratio": round(d99 / max(u99, 1e-9), 3),
+          "disagg_win": bool(d99 <= 1.1 * u99),
+          "streams_identical": bool(su == sd),
+          "handoffs": dstats["handoffs"],
+          "handoff_pages": dstats["handoff_pages"],
+          "handoff_fallbacks": dstats["handoff_fallbacks"],
+      },
+  }
+
+
 def _BenchRaggedStep(jax, jnp, model_registry, on_tpu, budget=None):
   """One ragged step program vs the padded three-program engine (ISSUE 17).
 
@@ -2259,6 +2452,7 @@ def main():
        lambda: _BenchQuantServing(jax, jnp, model_registry, on_tpu)),
       ("prefix_cache",
        lambda: _BenchPrefixCache(jax, jnp, model_registry, on_tpu)),
+      ("fleet", lambda: _BenchFleet(jax, jnp, model_registry, on_tpu)),
       ("ragged_step",
        lambda: _BenchRaggedStep(jax, jnp, model_registry, on_tpu)),
       ("fused_xent",

@@ -112,17 +112,35 @@ def MergeStatusz(docs: dict) -> dict:
       for label, doc in docs.items() if "snapshot" in doc])
 
 
-def LeastLoaded(docs: dict,
-                load_key: str = "scheduler/queue_depth") -> Optional[str]:
+def LiveLabels(docs: dict, order=None) -> list:
+  """Labels of replicas that answered their scrape (have a `snapshot`),
+  in deterministic order — the router's DOWN handling primitive: a dead
+  replica (scrape error, missing snapshot) is routed AROUND, never
+  raised on. `order` fixes the ordering explicitly (the fleet's replica
+  declaration order); default is sorted labels."""
+  labels = order if order is not None else sorted(docs)
+  return [lb for lb in labels
+          if isinstance(docs.get(lb), dict) and "snapshot" in docs[lb]]
+
+
+def LeastLoaded(docs: dict, load_key: str = "scheduler/queue_depth",
+                order=None) -> Optional[str]:
   """The replica label with the smallest numeric `load_key` in its
   snapshot — the router's admission primitive. Replicas missing the key
-  (or erroring) are never chosen; None when nobody qualifies."""
+  (or erroring/DOWN) are never chosen; None when nobody qualifies.
+
+  Ties break DETERMINISTICALLY on replica ordering — `order` when given
+  (the fleet's declaration order), else sorted labels — never on dict
+  insertion order, so N routers scoring the same scrape pick the same
+  replica."""
   best, best_load = None, None
-  for label in sorted(docs):
-    doc = docs[label]
+  for label in (order if order is not None else sorted(docs)):
+    doc = docs.get(label)
+    if not isinstance(doc, dict):
+      continue
     v = doc.get("snapshot", {}).get(load_key)
     if isinstance(v, bool) or not isinstance(v, (int, float)):
       continue
-    if best_load is None or v < best_load:
+    if best_load is None or v < best_load:   # strict <: first-in-order wins
       best, best_load = label, v
   return best

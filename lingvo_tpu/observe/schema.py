@@ -155,7 +155,7 @@ KV_PAGES_OPTIONAL = frozenset({"page_bytes", "pool_bytes"})
 # without a cache report DisabledPrefixCacheStats().
 PREFIX_CACHE_STATS_KEYS = frozenset({
     "enabled", "hits", "misses", "hit_tokens", "evictions", "cow_copies",
-    "cached_pages", "cached_tokens",
+    "cached_pages", "cached_tokens", "stale_pages", "refreshed_pages",
 })
 
 
@@ -165,6 +165,25 @@ def DisabledPrefixCacheStats() -> dict:
   out = {k: 0 for k in sorted(PREFIX_CACHE_STATS_KEYS)}
   out["enabled"] = False
   return out
+
+# serving/router.py PrefixRouter.Stats() — the `router/*` registry section
+# a fleet front-end exports. shadow_* describe the router-side radix
+# index of what it has routed where; the *_routed counters partition
+# requests_routed by why the chosen replica won (session pin, shadow
+# prefix score, pure load balance).
+ROUTER_STATS_KEYS = frozenset({
+    "requests_routed", "pinned_routed", "prefix_routed", "balanced_routed",
+    "rerouted_down", "sessions_pinned", "shadow_nodes", "shadow_evictions",
+})
+
+# serving/fleet.py ServingFleet.Stats() — fleet-level view over N replica
+# engines; `router` nests the ROUTER_STATS_KEYS dict above.
+FLEET_STATS_KEYS = frozenset({
+    "policy", "disaggregated", "replicas", "replicas_up", "replicas_down",
+    "requests", "failovers", "resubmitted_requests",
+    "handoffs", "handoff_pages", "handoff_fallbacks", "theta_swaps",
+    "router",
+})
 
 # observe/trace.py TraceRecorder.Stats()
 TRACE_STATS_KEYS = frozenset({

@@ -43,3 +43,18 @@ def SendRecv(x, pairs, axis_name: str):
   Shards not named as a dst receive zeros.
   """
   return jax.lax.ppermute(x, axis_name, list(pairs))
+
+
+def SendPages(blocks, pairs, axis_name: str):
+  """KV page handoff between fleet workers (serving/fleet.py).
+
+  `blocks` is a pytree of per-paged-leaf [n, ...] page blocks — the
+  gathered output of `ServingLoop.ExportPrefixBlocks` (int8 K/V pools
+  and their f32 scale sidecars are separate leaves and ride the same
+  pairs). Every leaf is ppermuted along `axis_name` with one explicit
+  (src, dst) list, so a prefill worker's finished pages land on its
+  decode worker in a single collective-permute; non-dst shards receive
+  zeros they never read.
+  """
+  return jax.tree_util.tree_map(
+      lambda x: jax.lax.ppermute(x, axis_name, list(pairs)), blocks)

@@ -153,7 +153,8 @@ class ServingLoop:
                prefix_cache=None, trace=True, metrics_registry=None,
                serve_port: Optional[int] = None, watchdog=None,
                step_mode: str = "ragged",
-               prefill_token_budget: Optional[int] = None):
+               prefill_token_budget: Optional[int] = None,
+               prefix_swap_persist: bool = False):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
@@ -201,6 +202,12 @@ class ServingLoop:
     step reserves beyond the worst-case decode tokens (defaults to
     prefill_chunk); decode capacity left idle by empty slots flows to
     prefill on top of it.
+    prefix_swap_persist: what UpdateTheta does to the prefix cache —
+    False (default) drops the whole radix tree (Invalidate), True keeps
+    the tree and marks every page stale (MarkStale): stale pages are
+    never served, but one warm re-prefill per live prefix refreshes its
+    nodes in place, so hit_tokens recover without a cold tree restart.
+    Per-swap override via UpdateTheta(persist_prefix=...).
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -249,6 +256,7 @@ class ServingLoop:
           prefix_cache if isinstance(prefix_cache, prefix_cache_lib.PrefixCache)
           else prefix_cache_lib.PrefixCache())
       self.prefix_cache.Bind(self.alloc, self.kv_cache_dtype)
+    self.prefix_swap_persist = bool(prefix_swap_persist)
     self.sched = scheduler_lib.Scheduler(
         max_batch, self.alloc, table_pages, prefill_chunk,
         needs_kv_pages=self.mixers["num_attention"] > 0,
@@ -285,6 +293,9 @@ class ServingLoop:
     # leaf of the decode state (compiled once; src/dst are traced scalars)
     self._cow_fn = (self._BuildCowFn(task, theta, kv_cache_dtype)
                     if self.prefix_cache is not None else None)
+    # fleet page handoff (AdoptPrefix): jitted page gather/scatter pair,
+    # built lazily — most engines never donate or adopt a prefix
+    self._page_io_fns = None
     # observability (observe/): per-engine metrics registry, per-request
     # lifecycle trace, and one-shot compile records for the step programs
     self.metrics = (metrics_registry if metrics_registry is not None
@@ -370,6 +381,7 @@ class ServingLoop:
     self._thread: Optional[threading.Thread] = None
     self._running = False
     self._seq_counter = 0
+    self._adopt_counter = 0   # transient page-handoff allocation owners
     # stall watchdog: StepOnce heartbeats + queue observations feed it;
     # the /healthz scrape thread (or a test) runs Check() — liveness must
     # be evaluated on a thread a hung step loop can't take down
@@ -690,10 +702,114 @@ class ServingLoop:
                                     jnp.asarray(dst, jnp.int32))
       seq.cow_pairs = []
 
-  def UpdateTheta(self, theta):
-    """Hot-swaps the served checkpoint and invalidates the prefix cache
-    (every cached page holds K/V computed under the OLD theta — serving
-    it to new requests would silently mix checkpoints). In-flight
+  def _PageIoFns(self):
+    """Jitted whole-page (gather, scatter) across the page-pool leaves —
+    the device half of the fleet page handoff (serving/fleet.py):
+    gather(states, idx) pulls the [n]-page blocks of one pool out as a
+    flat leaf list, scatter(states, idx, blocks) lands them in another
+    pool of the same stack. Which leaves are paged (and on which axis)
+    reuses the _PagedLeafAxes structural detection, so int8 K/V scale
+    sidecars are just more paged leaves and ride along."""
+    if self._page_io_fns is None:
+      axes = [ax[0] if ax is not None else None
+              for ax in self._PagedLeafAxes(self._task, self._theta,
+                                            self._kv_override)]
+
+      def _Gather(states, idx):
+        leaves = jax.tree_util.tree_leaves(states)
+        assert len(leaves) == len(axes), (len(leaves), len(axes))
+        return [jnp.take(leaf, idx, axis=ax)
+                for leaf, ax in zip(leaves, axes) if ax is not None]
+
+      def _Scatter(states, idx, blocks):
+        leaves, treedef = jax.tree_util.tree_flatten(states)
+        assert len(leaves) == len(axes), (len(leaves), len(axes))
+        out, j = [], 0
+        for leaf, ax in zip(leaves, axes):
+          if ax is None:
+            out.append(leaf)
+          else:
+            out.append(leaf.at[(slice(None),) * ax + (idx,)].set(blocks[j]))
+            j += 1
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+      donate = (0,) if jax.default_backend() != "cpu" else ()
+      self._page_io_fns = (jax.jit(_Gather),
+                           jax.jit(_Scatter, donate_argnums=donate))
+    return self._page_io_fns
+
+  def ExportPrefixBlocks(self, prompt):
+    """Donor half of the fleet page handoff: gathers this engine's
+    cached full-page KV prefix of `prompt` out of its pool. Returns
+    (num_pages, blocks) — blocks is the per-paged-leaf [n, ...] device
+    array list, (0, []) when nothing is cached. The source pages are
+    pinned (Retain) only for the duration of the gather; the blocks are
+    copies, so the donor may evict or swap freely afterwards."""
+    if self.prefix_cache is None:
+      return 0, []
+    with self._lock:
+      pages, _ = self.prefix_cache.Probe(prompt)
+      if not pages:
+        return 0, []
+      for pg in pages:
+        self.alloc.Retain(pg)
+      try:
+        gather, _ = self._PageIoFns()
+        blocks = gather(self._states, jnp.asarray(pages, jnp.int32))
+        # materialize before unpinning: the gather must read the pages
+        # while our Retain still guarantees nobody rewrites them
+        blocks = list(jax.block_until_ready(blocks))
+      finally:
+        for pg in pages:
+          self.alloc.Release(pg)
+    return len(pages), blocks
+
+  def AdoptPrefix(self, prompt, donor, channel=None) -> int:
+    """Receiver half of the fleet page handoff (prefill/decode
+    disaggregation, serving/fleet.py): copies `donor`'s cached full-page
+    KV prefix for `prompt` into this engine's pool and prefix cache, so
+    the next Submit of `prompt` admits as a warm prefix hit and prefill
+    covers only the uncached tail. channel: optional transport applied
+    to the gathered page blocks between the pools (e.g. the
+    parallel/sendrecv.py ppermute lowering for multi-host fleets); None
+    copies directly on the shared device. Returns tokens adopted — 0
+    when either side has no cache, the donor holds nothing, or this pool
+    cannot free enough pages (the caller then just prefills cold)."""
+    if self.prefix_cache is None:
+      return 0
+    n, blocks = donor.ExportPrefixBlocks(prompt)
+    if n == 0:
+      return 0
+    if channel is not None:
+      blocks = channel.Transfer(blocks)
+    with self._lock:
+      already = self.prefix_cache.PeekHitTokens(prompt)
+      if already >= n * self.page_size:
+        return 0   # warm already — don't churn pages for a worse copy
+      if self.alloc.num_free < n:
+        self.prefix_cache.EvictForPressure(n - self.alloc.num_free)
+        if self.alloc.num_free < n:
+          return 0
+      self._adopt_counter += 1
+      owner = ("_adopt", self._adopt_counter)
+      pages = self.alloc.Allocate(owner, n)
+      _, scatter = self._PageIoFns()
+      self._states = scatter(self._states, jnp.asarray(pages, jnp.int32),
+                             blocks)
+      # Insert retains what it keeps; Free drops our allocation ref, so
+      # unadopted pages (a racing insert won) go straight back to the pool
+      self.prefix_cache.Insert(prompt, pages)
+      self.alloc.Free(owner)
+    return n * self.page_size
+
+  def UpdateTheta(self, theta, persist_prefix: Optional[bool] = None):
+    """Hot-swaps the served checkpoint. Every cached prefix page holds
+    K/V computed under the OLD theta — serving one to a new request
+    would silently mix checkpoints — so the prefix cache is either
+    dropped wholesale (Invalidate, the default) or, when
+    `persist_prefix` (falling back to the engine's prefix_swap_persist
+    knob) is True, kept as a tree of STALE nodes that the next prefill
+    of each prefix refreshes in place (PrefixCache.MarkStale). In-flight
     sequences continue under the new theta, as with any mid-serving
     swap; a ModelDraft's independent draft theta is not touched (stale
     drafts cost acceptance rate, never correctness — every proposal is
@@ -703,7 +819,12 @@ class ServingLoop:
         theta, _ = quant_weights.Int8ServingTheta(theta)
       self._theta = theta
       if self.prefix_cache is not None:
-        self.prefix_cache.Invalidate()
+        persist = (self.prefix_swap_persist if persist_prefix is None
+                   else persist_prefix)
+        if persist:
+          self.prefix_cache.MarkStale()
+        else:
+          self.prefix_cache.Invalidate()
 
   # -- async API -------------------------------------------------------------
 

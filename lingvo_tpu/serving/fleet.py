@@ -1,0 +1,542 @@
+"""Disaggregated serving fleet: a router over N `ServingLoop` replicas.
+
+`ServingFleet` composes the single-replica pieces the previous PRs
+built — the continuous-batching engine (engine.py), its CoW prefix
+cache (prefix_cache.py), per-replica telemetry (observe/) — into the
+deployment shape that actually serves traffic:
+
+- **Phase 1, prefix-aware routing** (`serving/router.py`): each request
+  is scored against every UP replica by expected prefix-cache
+  hit_tokens (a router-side shadow radix index of what was routed
+  where) minus queue depth (each replica's `scheduler/queue_depth`
+  snapshot — the same key a /statusz scrape spells, so the scoring path
+  is transport-agnostic). Chat sessions pin to the replica holding
+  their conversation prefix. Alternative policies: `round_robin` (the
+  bench baseline) and `least_loaded` (observe/aggregate.LeastLoaded).
+- **Phase 2, prefill/decode disaggregation**: an optional prefill
+  worker group absorbs prompt processing so a long prompt never steals
+  a decode replica's ragged-step token budget. A prefill worker is an
+  ordinary ServingLoop with a prefix cache: the fleet submits the
+  prompt there with max_new=1, the worker runs its normal chunked
+  prefill and caches the prompt's full-page KV; the fleet then hands
+  those pages to the decode replica page-granularly
+  (`engine.AdoptPrefix`: gather out of the worker pool, optional
+  transport channel, scatter into the decode pool, insert into the
+  decode replica's prefix cache — int8 scale sidecars are just more
+  paged leaves and ride along). The decode replica's own admission then
+  sees a warm full-page prefix hit and prefills only the uncached tail,
+  which is what makes disaggregated streams BYTE-IDENTICAL to unified
+  ones: the same admission machinery runs, just against a pre-warmed
+  cache. In-process fleets move pages with a direct device copy
+  (`channel=None`); multi-host fleets lower the same gathered blocks
+  through `parallel/sendrecv.SendPages` (`SendRecvChannel`).
+- **Failover**: `KillReplica` (or any death the health scrape detects)
+  cancels the replica's in-flight work; the fleet resubmits every
+  outstanding FleetHandle — admitted or still queued — to a surviving
+  replica, re-prefilling from scratch (or a warm sibling prefix, if the
+  router finds one). Greedy decoding makes the regenerated stream
+  byte-identical, so a `FleetHandle.Result` caller never observes the
+  death. Sessions pinned to the dead replica re-pin on their next turn.
+- **Hot theta swap**: `UpdateTheta` fans out to every worker; with
+  `prefix_swap_persist` engines the radix trees survive the swap
+  (stale-marked, refreshed in place by the next prefill of each
+  prefix — prefix_cache.MarkStale), and the router's shadow index stays
+  valid since it tracks WHERE prefixes live, not what theta computed
+  them. Without persistence the shadow drops with the trees.
+
+Threading: the fleet serializes its own bookkeeping (router, outstanding
+tables, handoff queue) under one lock; engine locks nest inside it and
+never the reverse (engine loop threads know nothing of the fleet). The
+disaggregation pump is one daemon thread polling finished prefills.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from lingvo_tpu import observe
+from lingvo_tpu.observe import aggregate
+from lingvo_tpu.observe import schema as observe_schema
+from lingvo_tpu.parallel import mesh as mesh_lib
+from lingvo_tpu.parallel import sendrecv
+from lingvo_tpu.serving import router as router_lib
+
+_UNSET = object()
+
+
+class FleetHandle:
+  """Per-request handle that survives replica failover.
+
+  Wraps the current replica's StreamHandle; the fleet rebinds it when a
+  replica dies and the request is resubmitted elsewhere. `Result` is the
+  contract: it returns the finished token stream no matter how many
+  homes the request had (greedy regeneration is byte-identical).
+  `Tokens` yields from the final result — a fleet handle does not
+  live-stream, since a mid-stream rebind would have to retract tokens.
+  """
+
+  def __init__(self, fleet, prompt, max_new, session, seed, eos_id):
+    self._fleet = fleet
+    self.prompt = list(prompt)
+    self.max_new = max_new
+    self.session = session
+    self.seed = seed
+    self.eos_id = eos_id
+    self.replica: Optional[str] = None   # current home's label
+    self.finish_reason: Optional[str] = None
+    self._cond = threading.Condition()
+    self._inner = None                   # current StreamHandle
+    self._gen = 0                        # bumped per rebind
+    self._cancelled = False
+
+  # fleet-side
+  def _Rebind(self, handle, label):
+    with self._cond:
+      self._inner = handle
+      self.replica = label
+      self._gen += 1
+      self._cond.notify_all()
+
+  def _Settled(self) -> bool:
+    """Finished for good: the current home completed it (a cancelled
+    inner handle is a dead replica's artifact, not completion — unless
+    the user cancelled)."""
+    inner = self._inner
+    return (inner is not None and inner.done
+            and (inner.finish_reason != "cancelled" or self._cancelled))
+
+  # user-side
+  @property
+  def done(self) -> bool:
+    with self._cond:
+      return self._Settled()
+
+  def Result(self, timeout: Optional[float] = None) -> list:
+    """Blocks until the request finishes (across any failovers);
+    returns all generated tokens."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+
+    def _Left():
+      if deadline is None:
+        return None
+      left = deadline - time.monotonic()
+      if left <= 0:
+        raise TimeoutError("fleet request still running")
+      return left
+
+    while True:
+      with self._cond:
+        while self._inner is None and not self._cancelled:
+          if not self._cond.wait(timeout=_Left()):
+            raise TimeoutError("fleet request still awaiting dispatch")
+        if self._inner is None:   # cancelled before ever dispatched
+          self.finish_reason = "cancelled"
+          return []
+        inner, gen = self._inner, self._gen
+      toks = inner.Result(timeout=_Left())
+      if inner.finish_reason != "cancelled" or self._cancelled:
+        self.finish_reason = ("cancelled" if self._cancelled
+                              else inner.finish_reason)
+        return toks
+      # the home replica died under this request: wait out the rebind
+      with self._cond:
+        while self._gen == gen and not self._cancelled:
+          if not self._cond.wait(timeout=_Left()):
+            raise TimeoutError("fleet request awaiting failover rebind")
+
+  def Tokens(self, timeout: Optional[float] = None):
+    """Yields the finished stream (see class docstring: no live
+    streaming across rebinds)."""
+    yield from self.Result(timeout=timeout)
+
+  def Cancel(self) -> bool:
+    return self._fleet.Cancel(self)
+
+
+class SendRecvChannel:
+  """Multi-host lowering of the page handoff: moves gathered page
+  blocks between two workers' shards with one collective-permute
+  (`parallel/sendrecv.SendPages`) over a fleet mesh axis.
+
+  In-process fleets sharing a device pass `channel=None` to AdoptPrefix
+  (direct copy); this channel exists for fleets whose prefill and
+  decode groups live on different slices of one mesh — and as the
+  executable spec of the wire protocol (tests run it on a host-device
+  mesh). Each block is fed in replicated, permuted shard-to-shard, and
+  read back from the destination shard.
+  """
+
+  def __init__(self, mesh, axis_name: str, src: int, dst: int):
+    self.mesh = mesh
+    self.axis_name = axis_name
+    self.src = int(src)
+    self.dst = int(dst)
+
+  def Transfer(self, blocks):
+    spec = jax.sharding.PartitionSpec
+    pairs = [(self.src, self.dst)]
+
+    def _Send(b):
+      moved = sendrecv.SendPages(b, pairs, self.axis_name)
+      return moved[None]   # per-shard leading axis: stack, then pick dst
+
+    fn = mesh_lib.ShardMap(_Send, self.mesh, in_specs=spec(),
+                           out_specs=spec(self.axis_name), check_vma=False)
+    return [fn(b)[self.dst] for b in blocks]
+
+
+class _Handoff:
+  """One disaggregated request waiting on its prefill worker."""
+
+  __slots__ = ("fh", "worker", "prefill_handle", "target")
+
+  def __init__(self, fh, worker, prefill_handle, target):
+    self.fh = fh
+    self.worker = worker               # prefill worker label
+    self.prefill_handle = prefill_handle
+    self.target = target               # intended decode replica label
+
+
+class ServingFleet:
+  """Router + N decode replicas (+ optional prefill worker group).
+
+  replicas: ordered {label: ServingLoop} — the DECODE group; declaration
+  order is the router's deterministic tie-break order. policy: 'prefix'
+  (default, PrefixRouter), 'round_robin', or 'least_loaded'.
+  prefill: optional ordered {label: ServingLoop} prefill worker group
+  (labels must not collide with decode labels); non-empty turns on
+  disaggregation — every prompt with at least one full page prefills on
+  a worker and its KV pages are handed to the decode replica before the
+  decode submit. Workers need a prefix cache (it is how finished pages
+  survive until the handoff); decode replicas need one to adopt into.
+  channel: optional transport for the page blocks (SendRecvChannel);
+  None = direct device copy. load_weight/load_key/pin_sessions:
+  PrefixRouter knobs. serve_port: export fleet-level /statusz (router
+  section + fleet stats) via observe/export.py.
+  """
+
+  def __init__(self, replicas, *, policy: str = "prefix", prefill=None,
+               channel=None, load_weight: Optional[float] = None,
+               load_key=None, pin_sessions: bool = True,
+               serve_port: Optional[int] = None):
+    self._engines = dict(replicas)
+    self.order = list(self._engines)
+    assert self.order, "a fleet needs at least one decode replica"
+    if policy not in ("prefix", "round_robin", "least_loaded"):
+      raise ValueError(f"unknown routing policy {policy!r}")
+    self.policy = policy
+    self._prefill_engines = dict(prefill or {})
+    self.prefill_order = list(self._prefill_engines)
+    overlap = set(self.order) & set(self.prefill_order)
+    assert not overlap, f"labels serve both groups: {sorted(overlap)}"
+    self.channel = channel
+    page_sizes = {e.page_size for e in self._engines.values()}
+    assert len(page_sizes) == 1, (
+        f"replicas disagree on page_size: {sorted(page_sizes)} — prefix "
+        "routing and page handoff key on page-aligned chunks")
+    self.page_size = page_sizes.pop()
+    if self.disaggregated:
+      for lb, eng in list(self._engines.items()) + list(
+          self._prefill_engines.items()):
+        assert eng.prefix_cache is not None, (
+            f"disaggregation requires a prefix cache on every worker "
+            f"({lb} has none): workers park finished pages in theirs, "
+            "decode replicas adopt into theirs")
+    router_kw = {} if load_key is None else {"load_key": load_key}
+    self.router = router_lib.PrefixRouter(
+        self.page_size, self.order, load_weight=load_weight,
+        pin_sessions=pin_sessions, **router_kw)
+    self._lock = threading.RLock()
+    self._up = set(self.order) | set(self.prefill_order)
+    self._rr = 0
+    self._outstanding = {lb: {} for lb in self.order}   # label -> {id(fh): fh}
+    self._pending: list[_Handoff] = []
+    self._pump: Optional[threading.Thread] = None
+    self._running = False
+    self._req_counter = 0
+    # fleet-level counters (FLEET_STATS_KEYS; router section rides along)
+    self.requests = 0
+    self.failovers = 0
+    self.resubmitted_requests = 0
+    self.handoffs = 0
+    self.handoff_pages = 0
+    self.handoff_fallbacks = 0
+    self.theta_swaps = 0
+    self.metrics = observe.MetricsRegistry("fleet")
+    self.metrics.SectionFn("router", self.router.Stats)
+    self.metrics.SectionFn("fleet", self._ScalarStats)
+    self.status_server = None
+    if serve_port is not None:
+      self.status_server = observe.StatusServer(
+          serve_port, registry=self.metrics, name="fleet",
+          statusz_fn=self.Stats).Start()
+
+  # -- properties -------------------------------------------------------------
+
+  @property
+  def disaggregated(self) -> bool:
+    return bool(self._prefill_engines)
+
+  def Engine(self, label: str):
+    """The ServingLoop behind a label (either group)."""
+    return self._engines.get(label) or self._prefill_engines[label]
+
+  # -- lifecycle --------------------------------------------------------------
+
+  def Start(self):
+    with self._lock:
+      if self._running:
+        return self
+      self._running = True
+    for eng in list(self._engines.values()) + list(
+        self._prefill_engines.values()):
+      eng.Start()
+    if self.disaggregated:
+      self._pump = threading.Thread(target=self._PumpLoop, daemon=True,
+                                    name="fleet-handoff-pump")
+      self._pump.start()
+    return self
+
+  def Stop(self, drain: bool = True, timeout: float = 60.0):
+    with self._lock:
+      if not self._running:
+        return
+      if drain:
+        # flush pending handoffs so their decode submits exist to drain
+        deadline = time.monotonic() + timeout
+        while self._pending and time.monotonic() < deadline:
+          self._lock.release()
+          try:
+            time.sleep(0.005)
+          finally:
+            self._lock.acquire()
+      self._running = False
+    if self._pump is not None:
+      self._pump.join(timeout=timeout)
+      self._pump = None
+    for eng in list(self._engines.values()) + list(
+        self._prefill_engines.values()):
+      if eng._running:   # a killed replica is already down
+        eng.Stop(drain=drain, timeout=timeout)
+    if self.status_server is not None:
+      self.status_server.Stop()
+      self.status_server = None
+
+  # -- routing ----------------------------------------------------------------
+
+  def _Snapshots(self) -> dict:
+    """{label: registry snapshot or None (DOWN)} for the decode group —
+    the router's scoring input; in-process twin of a /statusz sweep."""
+    out = {}
+    for lb in self.order:
+      out[lb] = (self._engines[lb].metrics.Snapshot()
+                 if lb in self._up else None)
+    return out
+
+  def _Pick(self, prompt, session) -> str:
+    snapshots = self._Snapshots()
+    if self.policy == "prefix":
+      return self.router.Route(prompt, snapshots, session=session)
+    live = [lb for lb in self.order if snapshots.get(lb) is not None]
+    if not live:
+      raise RuntimeError(f"no UP replica among {self.order}")
+    if self.policy == "round_robin":
+      lb = live[self._rr % len(live)]
+      self._rr += 1
+      return lb
+    docs = {lb: {"snapshot": snapshots[lb]} for lb in live}
+    return aggregate.LeastLoaded(docs, order=self.order) or live[0]
+
+  def _PickPrefillWorker(self, prompt) -> Optional[str]:
+    live = [lb for lb in self.prefill_order if lb in self._up]
+    if not live:
+      return None
+    docs = {lb: {"snapshot": self._prefill_engines[lb].metrics.Snapshot()}
+            for lb in live}
+    return aggregate.LeastLoaded(docs, order=self.prefill_order) or live[0]
+
+  # -- submission -------------------------------------------------------------
+
+  def Submit(self, prompt, max_new_tokens: Optional[int] = None,
+             session=None, seed: Optional[int] = None,
+             eos_id=_UNSET) -> FleetHandle:
+    """Routes and queues one request; returns its fleet handle.
+
+    session: opaque chat-session key — requests sharing it pin to one
+    replica (its cache holds the conversation prefix). seed: per-request
+    sampling seed, defaulted to a FLEET-global counter so a request
+    resubmitted (failover) or replayed on another replica draws the
+    same stream at temperature > 0."""
+    with self._lock:
+      assert self._running, "Submit before Start()"
+      self._req_counter += 1
+      self.requests += 1
+      if seed is None:
+        seed = self._req_counter
+      fh = FleetHandle(self, prompt, max_new_tokens, session, seed, eos_id)
+      if self.disaggregated and len(prompt) >= self.page_size:
+        if self.policy == "prefix":
+          # route WITHOUT tagging the shadow: "warm" must read whether
+          # some EARLIER request already put the full prefix there
+          label = self.router.Route(prompt, self._Snapshots(),
+                                    session=session, note=False)
+          warm = self.router.shadow.ExpectedHitTokens(label, prompt)
+          self.router.shadow.NoteRouted(label, prompt)
+        else:
+          label = self._Pick(prompt, session)
+          warm = 0
+        full = (len(prompt) // self.page_size) * self.page_size
+        if warm < min(full, len(prompt) - 1):
+          worker = self._PickPrefillWorker(prompt)
+          if worker is not None:
+            ph = self._prefill_engines[worker].Submit(
+                list(prompt), max_new_tokens=1, seed=seed)
+            self._pending.append(_Handoff(fh, worker, ph, label))
+            return fh
+      else:
+        label = self._Pick(prompt, session)
+      self._Dispatch(fh, label)
+    return fh
+
+  def _Dispatch(self, fh: FleetHandle, label: str):
+    """Submits to a decode replica and binds (caller holds the lock)."""
+    eng = self._engines[label]
+    kwargs = {} if fh.eos_id is _UNSET else {"eos_id": fh.eos_id}
+    h = eng.Submit(list(fh.prompt), max_new_tokens=fh.max_new,
+                   seed=fh.seed, **kwargs)
+    self._outstanding[label][id(fh)] = fh
+    fh._Rebind(h, label)
+
+  def Cancel(self, fh: FleetHandle) -> bool:
+    with self._lock:
+      with fh._cond:
+        fh._cancelled = True
+        inner, label = fh._inner, fh.replica
+        fh._cond.notify_all()
+      for hd in self._pending:
+        if hd.fh is fh and not hd.prefill_handle.done:
+          hd.prefill_handle.Cancel()   # don't waste worker prefill budget
+      self._pending = [hd for hd in self._pending if hd.fh is not fh]
+      if label is not None:
+        self._outstanding.get(label, {}).pop(id(fh), None)
+      if inner is not None and not inner.done:
+        return inner.Cancel()
+      return inner is None
+
+  # -- disaggregation pump ----------------------------------------------------
+
+  def _PumpLoop(self):
+    while True:
+      with self._lock:
+        if not self._running:
+          return
+        moved = self._PumpOnce()
+      if not moved:
+        time.sleep(0.002)
+
+  def _PumpOnce(self) -> int:
+    """Lands every finished prefill: adopt pages into the decode
+    replica, then dispatch the decode submit (caller holds the lock).
+    Returns handoffs landed."""
+    still, moved = [], 0
+    for hd in self._pending:
+      if not hd.prefill_handle.done:
+        still.append(hd)
+        continue
+      moved += 1
+      target = hd.target
+      if target not in self._up:   # decode home died while prefilling
+        target = self._Pick(hd.fh.prompt, hd.fh.session)
+      if hd.prefill_handle.finish_reason == "cancelled":
+        # the prefill worker died mid-prompt: decode prefills cold
+        self.handoff_fallbacks += 1
+      else:
+        adopted = self._engines[target].AdoptPrefix(
+            hd.fh.prompt, self._prefill_engines[hd.worker],
+            channel=self.channel)
+        self.handoffs += 1
+        self.handoff_pages += adopted // self.page_size
+      self._Dispatch(hd.fh, target)
+    self._pending = still
+    return moved
+
+  # -- failover ---------------------------------------------------------------
+
+  def KillReplica(self, label: str, timeout: float = 30.0):
+    """Simulates (or administratively performs) a replica death: stops
+    the engine without draining — cancelling everything it held — then
+    resubmits every outstanding fleet request, admitted or still queued,
+    to a surviving replica. FleetHandle callers never notice beyond
+    latency: greedy regeneration is byte-identical."""
+    with self._lock:
+      if label not in self._up:
+        return
+      self._up.discard(label)
+      self.failovers += 1
+      if label in self._engines:
+        self.router.OnReplicaDown(label)
+    eng = self.Engine(label)
+    eng.Stop(drain=False, timeout=timeout)
+    with self._lock:
+      for fh in list(self._outstanding.get(label, {}).values()):
+        self._outstanding[label].pop(id(fh), None)
+        if fh._Settled():
+          continue   # finished before the axe fell: stream already out
+        new_label = self._Pick(fh.prompt, fh.session)
+        self._Dispatch(fh, new_label)
+        self.resubmitted_requests += 1
+      # prefill handoffs on a dead worker fall back in the pump (their
+      # handles read finish_reason == "cancelled"); dead decode targets
+      # re-pick there too. Nothing else to do here.
+
+  # -- theta swap -------------------------------------------------------------
+
+  def UpdateTheta(self, theta, persist_prefix: Optional[bool] = None):
+    """Hot-swaps every worker's checkpoint mid-traffic. persist_prefix
+    None defers to each engine's own prefix_swap_persist knob; the
+    router's shadow index survives exactly when the replicas' trees do
+    (see PrefixRouter.OnThetaSwap)."""
+    engines = list(self._engines.values()) + list(
+        self._prefill_engines.values())
+    with self._lock:
+      for eng in engines:
+        eng.UpdateTheta(theta, persist_prefix=persist_prefix)
+      persisted = (all(e.prefix_swap_persist for e in engines)
+                   if persist_prefix is None else bool(persist_prefix))
+      self.router.OnThetaSwap(persisted)
+      self.theta_swaps += 1
+
+  # -- introspection ----------------------------------------------------------
+
+  def _ScalarStats(self) -> dict:
+    with self._lock:
+      up = len([lb for lb in self.order if lb in self._up])
+      return {
+          "policy": self.policy,
+          "disaggregated": self.disaggregated,
+          "replicas": len(self.order),
+          "replicas_up": up,
+          "replicas_down": len(self.order) - up,
+          "requests": self.requests,
+          "failovers": self.failovers,
+          "resubmitted_requests": self.resubmitted_requests,
+          "handoffs": self.handoffs,
+          "handoff_pages": self.handoff_pages,
+          "handoff_fallbacks": self.handoff_fallbacks,
+          "theta_swaps": self.theta_swaps,
+      }
+
+  def Stats(self) -> dict:
+    """Fleet-level stats (observe/schema.py FLEET_STATS_KEYS): scalar
+    counters plus the nested `router` section. Per-replica engine stats
+    stay on the replicas' own /statusz — the fleet view is about
+    routing, failover and handoff, not a re-export of N engines."""
+    with self._lock:
+      stats = self._ScalarStats()
+      stats["router"] = self.router.Stats()
+    assert set(stats) == observe_schema.FLEET_STATS_KEYS, sorted(stats)
+    return stats

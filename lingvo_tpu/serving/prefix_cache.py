@@ -29,6 +29,15 @@ prefix cache needs is host-side bookkeeping:
   kv_cache_dtype must never cross-share (`Bind` invalidates on dtype or
   allocator mismatch — an int8 page is bytes-incompatible with a bf16
   probe even if the token chunk matches).
+- **tree persistence across swaps** (`MarkStale()`): a hot theta swap
+  invalidates the cached K/V *values* but not the token-chunk *keys* —
+  the tree shape and LRU ordering describe the live traffic mix, which
+  the new theta will reproduce. MarkStale bumps a generation counter so
+  walks stop at the first stale node (stale pages are never handed out),
+  while `Insert` refreshes a stale node in place with the re-prefilled
+  page: one warm re-prefill per prefix restores hit_tokens without a
+  cold restart of the radix tree. Drop-everything `Invalidate` remains
+  the default swap behavior (engine knob `prefix_swap_persist`).
 
 The one write-into-shared-page case: when a probe covers the WHOLE
 prompt, prefill must still recompute the last prompt token to produce
@@ -51,16 +60,21 @@ from lingvo_tpu.serving import kv_cache
 
 
 class _Node:
-  """One cached full page: `chunk` (page_size token tuple) -> `page`."""
+  """One cached full page: `chunk` (page_size token tuple) -> `page`.
 
-  __slots__ = ("chunk", "page", "parent", "children", "last_used")
+  `gen` is the cache generation the page's K/V was computed under; a
+  node whose gen trails the cache's is stale (theta swapped since) and
+  is skipped by walks until Insert refreshes it in place."""
 
-  def __init__(self, chunk, page, parent):
+  __slots__ = ("chunk", "page", "parent", "children", "last_used", "gen")
+
+  def __init__(self, chunk, page, parent, gen=0):
     self.chunk = chunk
     self.page = page
     self.parent = parent
     self.children: dict = {}
     self.last_used = 0
+    self.gen = gen
 
 
 class PrefixCache:
@@ -81,12 +95,14 @@ class PrefixCache:
     self._root = _Node(None, None, None)
     self._nodes: dict[int, _Node] = {}   # page -> node (eviction walk)
     self._tick = 0                       # monotonic LRU clock
+    self._gen = 0                        # bumped by MarkStale (theta swap)
     # counters surfaced via Stats() -> prefix_cache/* registry section
     self.hits = 0
     self.misses = 0
     self.hit_tokens = 0
     self.evictions = 0
     self.cow_copies = 0
+    self.refreshed_pages = 0
 
   # -- binding / invalidation -------------------------------------------------
 
@@ -114,6 +130,17 @@ class PrefixCache:
     self._nodes = {}
     return n
 
+  def MarkStale(self) -> int:
+    """Theta swapped but the tree should survive: bumps the cache
+    generation so every resident page becomes stale — never offered to a
+    probe, still occupying its node so the next prefill of the same
+    chunk refreshes it in place (Insert). O(1); pages stay retained and
+    remain reclaimable under pressure (EvictLru takes stale leaves like
+    any other unreferenced leaf). Returns pages marked stale."""
+    if self._nodes:
+      self._gen += 1
+    return len(self._nodes)
+
   # -- queries ----------------------------------------------------------------
 
   @property
@@ -129,8 +156,8 @@ class PrefixCache:
     node, pages = self._root, []
     for chunk in self._Chunks(prompt):
       child = node.children.get(chunk)
-      if child is None:
-        break
+      if child is None or child.gen != self._gen:
+        break   # missing, or stale K/V from a pre-swap generation
       if touch:
         self._tick += 1
         child.last_used = self._tick
@@ -175,15 +202,30 @@ class PrefixCache:
     page_size chunk (the scheduler passes the sequence's own pages right
     after prefill completes). Existing nodes win — the first writer's
     page stays canonical and later identical prefixes share it; only
-    chunks not yet present retain new pages. Respects max_pages by
-    evicting LRU unreferenced pages first and stopping (prefix-complete)
-    when room runs out."""
+    chunks not yet present retain new pages. A STALE node (generation
+    behind, post-MarkStale) is refreshed in place: its old page is
+    released, the freshly prefilled one retained, and the node keeps its
+    position and children — how hit_tokens recover after a persisted
+    theta swap. Respects max_pages by evicting LRU unreferenced pages
+    first and stopping (prefix-complete) when room runs out."""
     node = self._root
     for i, chunk in enumerate(self._Chunks(prompt)):
       if i >= len(pages):
         break
       child = node.children.get(chunk)
-      if child is None:
+      if child is not None and child.gen != self._gen:
+        page = pages[i]
+        if page != child.page:
+          if page in self._nodes:
+            break   # page already caches a different chunk (stale insert)
+          self.alloc.Release(child.page)
+          del self._nodes[child.page]
+          self.alloc.Retain(page)
+          child.page = page
+          self._nodes[page] = child
+        child.gen = self._gen
+        self.refreshed_pages += 1
+      elif child is None:
         if self.max_pages is not None and len(self._nodes) >= self.max_pages:
           if self.EvictLru(len(self._nodes) - self.max_pages + 1) == 0:
             break
@@ -191,7 +233,7 @@ class PrefixCache:
         if page in self._nodes:
           break   # page already caches a different chunk (stale insert)
         self.alloc.Retain(page)
-        child = _Node(chunk, page, node)
+        child = _Node(chunk, page, node, gen=self._gen)
         node.children[chunk] = child
         self._nodes[page] = child
       self._tick += 1
@@ -234,6 +276,7 @@ class PrefixCache:
 
   def Stats(self) -> dict:
     ps = self.alloc.page_size if self.alloc is not None else 0
+    stale = sum(1 for nd in self._nodes.values() if nd.gen != self._gen)
     return {
         "enabled": True,
         "hits": self.hits,
@@ -243,4 +286,6 @@ class PrefixCache:
         "cow_copies": self.cow_copies,
         "cached_pages": self.cached_pages,
         "cached_tokens": self.cached_pages * ps,
+        "stale_pages": stale,
+        "refreshed_pages": self.refreshed_pages,
     }

@@ -1,0 +1,274 @@
+"""Prefix-aware request routing for a fleet of serving replicas.
+
+A fleet of N data-parallel `ServingLoop` replicas multiplies throughput
+but DIVIDES the prefix cache: each replica only caches what it has
+served, so a load-oblivious router scatters every popular system prompt
+across all N pools and pays its prefill N times. The router's job is to
+make the fleet's caches behave like one big cache, with two signals:
+
+- a **shadow radix index** (`ShadowPrefixIndex`): a router-side tree
+  over the leading page-size token chunks of every prompt it has routed,
+  each node tagged with the replica labels that received that prefix.
+  It predicts `prefix_cache` hit_tokens per replica WITHOUT a network
+  round-trip per request — the replicas' real caches are the ground
+  truth (scraped via /statusz), the shadow is the router's cheap,
+  slightly-optimistic model of them (it can overestimate after replica
+  eviction; the cost of a wrong guess is one re-prefill, never a wrong
+  stream).
+- **replica load** from the telemetry substrate: each replica's
+  `scheduler/queue_depth` out of its registry snapshot — scraped
+  (`observe/aggregate.Scrape`) for out-of-process replicas or read
+  in-process (`registry.Snapshot()`) for a co-located fleet. Both spell
+  the same keys, so the scoring path is transport-agnostic.
+
+Scoring: `expected_hit_tokens(replica, prompt) - load_weight *
+queue_depth(replica)`, maximized over UP replicas; ties break on the
+fleet's declared replica order (deterministic, never dict order —
+mirror routers scoring the same scrape agree). Chat sessions are PINNED:
+once a session routes somewhere, later turns follow it while the
+replica stays up — its cache holds the whole conversation prefix, which
+the shadow index cannot even represent (it only sees leading chunks).
+
+DOWN handling: a replica whose snapshot is missing (scrape error,
+killed) is routed AROUND — it never scores, pinned sessions on it
+re-route (counted `rerouted_down`) and re-pin to their new home. Only a
+fleet with zero UP replicas raises.
+
+Thread safety: plain host state; the owning fleet serializes calls
+under its submit lock (same discipline as scheduler/prefix_cache under
+the engine lock).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lingvo_tpu.observe import schema as observe_schema
+
+
+class _ShadowNode:
+  """One routed page_size chunk: which replicas have seen this prefix,
+  each tagged with the router tick of its most recent routing."""
+
+  __slots__ = ("chunk", "parent", "children", "labels")
+
+  def __init__(self, chunk, parent):
+    self.chunk = chunk
+    self.parent = parent
+    self.children: dict = {}
+    self.labels: dict = {}   # replica label -> last routed tick
+
+
+class ShadowPrefixIndex:
+  """Router-side radix over the leading page-size chunks it has routed.
+
+  max_nodes bounds memory (LRU leaves evicted first, like the real
+  cache's eviction walk); max_depth bounds per-prompt work — beyond a
+  few pages of shared prefix the routing decision is already made.
+  """
+
+  def __init__(self, page_size: int, max_nodes: int = 4096,
+               max_depth: int = 16):
+    assert page_size >= 1 and max_nodes >= 1 and max_depth >= 1
+    self.page_size = page_size
+    self.max_nodes = max_nodes
+    self.max_depth = max_depth
+    self._root = _ShadowNode(None, None)
+    self._count = 0
+    self._tick = 0
+    self.evictions = 0
+
+  def _Chunks(self, prompt):
+    ps = self.page_size
+    for i in range(min(len(prompt) // ps, self.max_depth)):
+      yield tuple(prompt[i * ps:(i + 1) * ps])
+
+  def NoteRouted(self, label, prompt):
+    """Records that `prompt` was routed to replica `label`: its leading
+    chunks will shortly be in that replica's prefix cache."""
+    self._tick += 1
+    node = self._root
+    for chunk in self._Chunks(prompt):
+      child = node.children.get(chunk)
+      if child is None:
+        if self._count >= self.max_nodes and self._EvictLru() == 0:
+          return
+        child = _ShadowNode(chunk, node)
+        node.children[chunk] = child
+        self._count += 1
+      child.labels[label] = self._tick
+      node = child
+
+  def ExpectedHitTokens(self, label, prompt) -> int:
+    """Predicted prefix_cache hit_tokens were `prompt` routed to
+    `label` — matched full-page tokens along the shadow path that
+    replica has seen, capped at len(prompt)-1 like the real cache (a
+    full-cover hit still recomputes the last token)."""
+    node, matched = self._root, 0
+    for chunk in self._Chunks(prompt):
+      child = node.children.get(chunk)
+      if child is None or label not in child.labels:
+        break
+      matched += self.page_size
+      node = child
+    return min(matched, len(prompt) - 1) if matched else 0
+
+  def _Leaves(self):
+    out, stack = [], [self._root]
+    while stack:
+      node = stack.pop()
+      kids = list(node.children.values())
+      if not kids and node is not self._root:
+        out.append(node)
+      stack.extend(kids)
+    return out
+
+  def _EvictLru(self) -> int:
+    """Drops the least-recently-routed leaf (leaves-first, like the real
+    cache: an inner node outlives its subtree)."""
+    leaves = self._Leaves()
+    if not leaves:
+      return 0
+    victim = min(leaves, key=lambda nd: max(nd.labels.values(), default=0))
+    del victim.parent.children[victim.chunk]
+    self._count -= 1
+    self.evictions += 1
+    return 1
+
+  def DropReplica(self, label):
+    """Forgets everything routed to `label` (replica died, or swapped
+    theta without tree persistence): its tags go, and nodes no replica
+    remembers are pruned bottom-up."""
+    stack, post = [self._root], []
+    while stack:
+      node = stack.pop()
+      post.append(node)
+      stack.extend(node.children.values())
+    for node in reversed(post):   # children before parents
+      node.labels.pop(label, None)
+      if node is not self._root and not node.labels and not node.children:
+        del node.parent.children[node.chunk]
+        self._count -= 1
+
+  def Clear(self):
+    self._root = _ShadowNode(None, None)
+    self._count = 0
+
+  @property
+  def nodes(self) -> int:
+    return self._count
+
+
+class PrefixRouter:
+  """Scores replicas for one request: shadow-predicted prefix hit vs
+  queue depth, with session pinning and deterministic tie-breaks.
+
+  order: the fleet's replica labels in declaration order — the
+  tie-break and iteration order everywhere (never dict order).
+  load_key: the snapshot key read as load — or a sequence of keys whose
+  numeric values SUM (e.g. ("scheduler/queue_depth",
+  "scheduler/slots_live") counts every in-system request, immune to the
+  queued-vs-admitted race during a submit burst).
+  load_weight: tokens of expected prefix hit one unit of queue depth
+  cancels; default page_size (one queued request outweighs one cached
+  page — mild load bias that still lets a multi-page prefix pull its
+  session home).
+  """
+
+  def __init__(self, page_size: int, order, *,
+               load_key: str = "scheduler/queue_depth",
+               load_weight: Optional[float] = None,
+               pin_sessions: bool = True,
+               shadow_max_nodes: int = 4096):
+    self.order = list(order)
+    assert self.order, "a router needs at least one replica label"
+    self.load_keys = ([load_key] if isinstance(load_key, str)
+                      else list(load_key))
+    self.load_weight = float(page_size if load_weight is None else load_weight)
+    self.pin_sessions = pin_sessions
+    self.shadow = ShadowPrefixIndex(page_size, max_nodes=shadow_max_nodes)
+    self._pins: dict = {}          # session -> replica label
+    self.requests_routed = 0
+    self.pinned_routed = 0
+    self.prefix_routed = 0
+    self.balanced_routed = 0
+    self.rerouted_down = 0
+
+  def Route(self, prompt, snapshots: dict, session=None,
+            note: bool = True) -> str:
+    """Picks the replica for `prompt`. snapshots: {label: registry
+    snapshot dict, or None/missing for a DOWN replica} — in-process
+    `registry.Snapshot()` and a scraped /statusz `doc["snapshot"]` both
+    qualify. Raises RuntimeError only when every replica is DOWN.
+
+    note=False skips tagging the shadow index with this routing — for a
+    caller that must first inspect the PRE-routing shadow state (the
+    fleet's disaggregation warm-skip) and will NoteRouted itself."""
+    live = [lb for lb in self.order if snapshots.get(lb) is not None]
+    if not live:
+      raise RuntimeError(
+          f"no UP replica among {self.order}: nothing to route to")
+    self.requests_routed += 1
+    if self.pin_sessions and session is not None:
+      pinned = self._pins.get(session)
+      if pinned is not None:
+        if pinned in live:
+          self.pinned_routed += 1
+          if note:
+            self.shadow.NoteRouted(pinned, prompt)
+          return pinned
+        self.rerouted_down += 1   # pinned home is DOWN: re-route, re-pin
+    best, best_score, best_hit = None, None, 0
+    for lb in live:
+      hit = self.shadow.ExpectedHitTokens(lb, prompt)
+      load = 0
+      for key in self.load_keys:
+        v = snapshots[lb].get(key, 0)
+        if not isinstance(v, bool) and isinstance(v, (int, float)):
+          load += v
+      score = hit - self.load_weight * load
+      if best_score is None or score > best_score:   # strict >: order wins ties
+        best, best_score, best_hit = lb, score, hit
+    if best_hit > 0:
+      self.prefix_routed += 1
+    else:
+      self.balanced_routed += 1
+    if self.pin_sessions and session is not None:
+      self._pins[session] = best
+    if note:
+      self.shadow.NoteRouted(best, prompt)
+    return best
+
+  def OnReplicaDown(self, label):
+    """A replica died: forget its shadow entries so scoring stops
+    crediting it. Sessions pinned to it re-route lazily (Route sees the
+    pin is not live) — their next turn counts `rerouted_down`."""
+    self.shadow.DropReplica(label)
+
+  def OnThetaSwap(self, persisted: bool):
+    """The fleet hot-swapped theta. With tree persistence the replicas
+    keep their (stale, refresh-in-place) trees, so the shadow stays an
+    honest model of WHERE prefixes live; without it every replica
+    dropped its cache and the shadow must drop too."""
+    if not persisted:
+      self.shadow.Clear()
+
+  @property
+  def sessions_pinned(self) -> int:
+    return len(self._pins)
+
+  def Stats(self) -> dict:
+    """The `router/*` registry section (observe/schema.py
+    ROUTER_STATS_KEYS)."""
+    stats = {
+        "requests_routed": self.requests_routed,
+        "pinned_routed": self.pinned_routed,
+        "prefix_routed": self.prefix_routed,
+        "balanced_routed": self.balanced_routed,
+        "rerouted_down": self.rerouted_down,
+        "sessions_pinned": self.sessions_pinned,
+        "shadow_nodes": self.shadow.nodes,
+        "shadow_evictions": self.shadow.evictions,
+    }
+    assert set(stats) == observe_schema.ROUTER_STATS_KEYS, sorted(stats)
+    return stats

@@ -109,6 +109,16 @@ def tiny_lm():
 
 
 @pytest.fixture(scope="session")
+def tiny_lm_swapped(tiny_lm):
+  # the same task with a different checkpoint — the "new theta" of hot
+  # UpdateTheta swap tests. Session-scoped so its id is stable for the
+  # _GreedyRef memo key in test_serving_engine.
+  import jax
+  task, _ = tiny_lm
+  return task, task.InstantiateVariables(jax.random.PRNGKey(7))
+
+
+@pytest.fixture(scope="session")
 def hybrid_lm():
   # flat (non-repeat) stack so a 1-layer early-exit prefix is legal; the
   # repeat-stack prefix path gets its own engine tests

@@ -1,0 +1,491 @@
+"""Disaggregated serving fleet: router scoring, session pinning,
+failover, page handoff, theta-swap persistence (serving/fleet.py,
+serving/router.py).
+
+Fast tests keep fleets to 2-3 tiny engines and a handful of tokens; the
+multi-replica Poisson soak is `slow` (standalone-fast variants cover
+each mechanism individually). Byte-identity is THE contract everywhere:
+whatever the router, failover, or handoff did, every request's greedy
+stream must equal the single-replica dense reference."""
+
+import time
+
+import pytest
+
+from lingvo_tpu.observe import aggregate
+from lingvo_tpu.observe import schema as observe_schema
+from lingvo_tpu.parallel import mesh as mesh_lib
+from lingvo_tpu.serving import engine as engine_lib
+from lingvo_tpu.serving import fleet as fleet_lib
+from lingvo_tpu.serving import router as router_lib
+
+from tests.test_serving_engine import _GreedyRef
+# (the session-scoped `tiny_lm` fixture resolves from tests/conftest.py)
+
+
+# -- shadow radix index (pure host state) -------------------------------------
+
+
+class TestShadowPrefixIndex:
+
+  def _Mk(self, **kw):
+    return router_lib.ShadowPrefixIndex(4, **kw)
+
+  def test_note_then_expected_hit_full_pages_only(self):
+    idx = self._Mk()
+    idx.NoteRouted("r0", [1, 2, 3, 4, 5, 6, 7, 8])
+    assert idx.ExpectedHitTokens("r0", [1, 2, 3, 4, 9, 9, 9, 9]) == 4
+    # full cover caps at len-1 (last token always recomputes)
+    assert idx.ExpectedHitTokens("r0", [1, 2, 3, 4, 5, 6, 7, 8]) == 7
+    # the other replica never saw this prefix
+    assert idx.ExpectedHitTokens("r1", [1, 2, 3, 4, 5, 6, 7, 8]) == 0
+    # partial pages don't count
+    assert idx.ExpectedHitTokens("r0", [1, 2, 3]) == 0
+    assert idx.nodes == 2
+
+  def test_drop_replica_prunes_exclusive_paths(self):
+    idx = self._Mk()
+    idx.NoteRouted("r0", [1, 2, 3, 4, 5, 6, 7, 8])
+    idx.NoteRouted("r1", [1, 2, 3, 4])       # shares the first chunk
+    idx.DropReplica("r0")
+    assert idx.ExpectedHitTokens("r0", [1, 2, 3, 4, 5]) == 0
+    assert idx.ExpectedHitTokens("r1", [1, 2, 3, 4, 9]) == 4
+    assert idx.nodes == 1                    # r0-only deep node pruned
+
+  def test_max_nodes_evicts_lru_leaf(self):
+    idx = self._Mk(max_nodes=2)
+    idx.NoteRouted("r0", [1, 2, 3, 4])
+    idx.NoteRouted("r0", [5, 6, 7, 8])
+    idx.NoteRouted("r0", [1, 2, 3, 4])       # refresh: now most recent
+    idx.NoteRouted("r0", [9, 9, 9, 9])       # evicts the [5,6,7,8] leaf
+    assert idx.ExpectedHitTokens("r0", [5, 6, 7, 8, 0]) == 0
+    assert idx.ExpectedHitTokens("r0", [1, 2, 3, 4, 0]) == 4
+    assert idx.evictions == 1 and idx.nodes == 2
+
+  def test_clear(self):
+    idx = self._Mk()
+    idx.NoteRouted("r0", [1, 2, 3, 4])
+    idx.Clear()
+    assert idx.nodes == 0
+    assert idx.ExpectedHitTokens("r0", [1, 2, 3, 4, 0]) == 0
+
+
+# -- router scoring (fabricated snapshots) ------------------------------------
+
+
+def _Snaps(**depths):
+  return {lb: ({"scheduler/queue_depth": d} if d is not None else None)
+          for lb, d in depths.items()}
+
+
+class TestPrefixRouter:
+
+  def _Mk(self, order=("r0", "r1"), **kw):
+    return router_lib.PrefixRouter(4, order, **kw)
+
+  def test_tie_breaks_on_declared_order_not_dict_order(self):
+    r = self._Mk()
+    # dict literal lists r1 first; declared order must win the tie
+    snaps = {"r1": {"scheduler/queue_depth": 0},
+             "r0": {"scheduler/queue_depth": 0}}
+    assert r.Route([1, 2, 3, 4], snaps) == "r0"
+
+  def test_prefix_holder_beats_mild_load(self):
+    r = self._Mk()
+    p = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    r.shadow.NoteRouted("r1", p)
+    # r1 holds 8 prefix tokens; 1 queued request costs page_size=4
+    assert r.Route(p, _Snaps(r0=0, r1=1)) == "r1"
+    assert r.prefix_routed == 1
+    # drowning load flips it back
+    assert r.Route(p, _Snaps(r0=0, r1=5)) == "r0"
+    assert r.balanced_routed == 1
+
+  def test_down_replica_routes_around_and_all_down_raises(self):
+    r = self._Mk()
+    p = [1, 2, 3, 4]
+    r.shadow.NoteRouted("r0", p)             # best score... but DOWN
+    assert r.Route(p, _Snaps(r0=None, r1=3)) == "r1"
+    with pytest.raises(RuntimeError):
+      r.Route(p, _Snaps(r0=None, r1=None))
+
+  def test_session_pins_and_repins_after_death(self):
+    r = self._Mk()
+    p = [1, 2, 3, 4, 5]
+    home = r.Route(p, _Snaps(r0=0, r1=0), session="s")
+    assert home == "r0"
+    # heavy load elsewhere can't break the pin while the home is UP
+    assert r.Route(p, _Snaps(r0=9, r1=0), session="s") == "r0"
+    assert r.pinned_routed == 1 and r.sessions_pinned == 1
+    r.OnReplicaDown("r0")
+    assert r.Route(p, _Snaps(r0=None, r1=0), session="s") == "r1"
+    assert r.rerouted_down == 1
+    # re-pinned: follows the new home now
+    assert r.Route(p, _Snaps(r0=None, r1=0), session="s") == "r1"
+    assert r.pinned_routed == 2
+
+  def test_load_key_sequence_sums_in_system_load(self):
+    r = self._Mk(load_key=("scheduler/queue_depth", "scheduler/slots_live"))
+    # r0 has nothing queued but 3 admitted; r1 has 1 queued, 0 admitted
+    snaps = {"r0": {"scheduler/queue_depth": 0, "scheduler/slots_live": 3},
+             "r1": {"scheduler/queue_depth": 1}}
+    assert r.Route([1, 2, 3, 4], snaps) == "r1"
+
+  def test_note_false_leaves_shadow_untouched(self):
+    r = self._Mk()
+    p = [1, 2, 3, 4]
+    lb = r.Route(p, _Snaps(r0=0, r1=0), note=False)
+    assert r.shadow.ExpectedHitTokens(lb, p + [9]) == 0
+    assert r.shadow.nodes == 0
+
+  def test_theta_swap_clears_shadow_only_without_persistence(self):
+    r = self._Mk()
+    r.shadow.NoteRouted("r0", [1, 2, 3, 4])
+    r.OnThetaSwap(persisted=True)
+    assert r.shadow.nodes == 1
+    r.OnThetaSwap(persisted=False)
+    assert r.shadow.nodes == 0
+
+  def test_stats_schema_exact(self):
+    r = self._Mk()
+    r.Route([1, 2, 3, 4], _Snaps(r0=0, r1=0), session="s")
+    assert set(r.Stats()) == observe_schema.ROUTER_STATS_KEYS
+
+
+class TestAggregateRouting:
+
+  def test_least_loaded_deterministic_tie_break(self):
+    docs = {"b": {"snapshot": {"q": 1}}, "a": {"snapshot": {"q": 1}}}
+    assert aggregate.LeastLoaded(docs, load_key="q") == "a"   # sorted
+    assert aggregate.LeastLoaded(docs, load_key="q",
+                                 order=["b", "a"]) == "b"     # declared
+
+  def test_least_loaded_skips_down_and_non_numeric(self):
+    docs = {"a": {"error": "dead"},
+            "b": {"snapshot": {"q": True}},    # bool is not a load
+            "c": {"snapshot": {"q": 7}}}
+    assert aggregate.LeastLoaded(docs, load_key="q") == "c"
+    assert aggregate.LeastLoaded({"a": {"error": "x"}}, load_key="q") is None
+
+  def test_live_labels_orders_and_filters(self):
+    docs = {"b": {"snapshot": {}}, "a": {"error": "x"}, "c": {"snapshot": {}}}
+    assert aggregate.LiveLabels(docs) == ["b", "c"]
+    assert aggregate.LiveLabels(docs, order=["c", "a", "b"]) == ["c", "b"]
+
+
+# -- fleet end-to-end (tiny engines) ------------------------------------------
+
+
+_P1 = [5, 9, 2, 33, 17, 4, 11, 3, 22, 6]    # 2 full pages + 2-token tail
+_P2 = [7, 7, 7, 12, 31, 2, 9, 40, 1]        # distinct session prefix
+
+
+def _MkEngine(task, theta, **kw):
+  kw.setdefault("page_size", 4)
+  kw.setdefault("num_pages", 16)
+  kw.setdefault("max_batch", 2)
+  kw.setdefault("max_seq_len", 32)
+  kw.setdefault("prefill_chunk", 4)
+  kw.setdefault("prefix_cache", True)
+  kw.setdefault("trace", False)
+  return engine_lib.ServingLoop(task, theta, **kw)
+
+
+def _WaitTokens(eng, n, timeout=60.0):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if eng.Stats()["tokens_emitted"] >= n:
+      return
+    time.sleep(0.005)
+  raise TimeoutError("engine never emitted enough tokens")
+
+
+class TestFleetRouting:
+
+  def test_sessions_pin_and_streams_match_reference(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet(
+        {"r0": _MkEngine(task, theta), "r1": _MkEngine(task, theta)}).Start()
+    try:
+      handles = []
+      for _ in range(2):                     # two turns per session
+        handles.append((fl.Submit(list(_P1), 5, session="sA"), _P1))
+        handles.append((fl.Submit(list(_P2), 5, session="sB"), _P2))
+      for h, p in handles:
+        assert h.Result(timeout=120) == _GreedyRef(task, theta, p, 5)
+      homes = {h.session: set() for h, _ in handles}
+      for h, _ in handles:
+        homes[h.session].add(h.replica)
+      # a session never migrates while its home is up
+      assert all(len(v) == 1 for v in homes.values()), homes
+      st = fl.Stats()
+      assert set(st) == observe_schema.FLEET_STATS_KEYS
+      assert st["router"]["pinned_routed"] == 2
+      assert st["requests"] == 4 and st["failovers"] == 0
+    finally:
+      fl.Stop()
+
+  def test_streams_identical_across_routing_policies(self, tiny_lm):
+    task, theta = tiny_lm
+    outs = {}
+    for policy in ("prefix", "round_robin", "least_loaded"):
+      fl = fleet_lib.ServingFleet(
+          {"r0": _MkEngine(task, theta), "r1": _MkEngine(task, theta)},
+          policy=policy).Start()
+      try:
+        hs = [fl.Submit(list(p), 5) for p in (_P1, _P2, _P1)]
+        outs[policy] = [h.Result(timeout=120) for h in hs]
+      finally:
+        fl.Stop()
+    ref = [_GreedyRef(task, theta, p, 5) for p in (_P1, _P2, _P1)]
+    for policy, got in outs.items():
+      assert got == ref, policy              # byte-identical across policies
+
+  def test_round_robin_alternates_over_up_replicas(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet(
+        {"r0": _MkEngine(task, theta), "r1": _MkEngine(task, theta)},
+        policy="round_robin").Start()
+    try:
+      hs = [fl.Submit(list(_P1), 2) for _ in range(4)]
+      for h in hs:
+        h.Result(timeout=120)
+      assert [h.replica for h in hs] == ["r0", "r1", "r0", "r1"]
+    finally:
+      fl.Stop()
+
+
+class TestFleetFailover:
+
+  def test_kill_pinned_replica_resubmits_queued_and_inflight(self, tiny_lm):
+    task, theta = tiny_lm
+    # max_batch=1: the 3rd same-session request is queued-but-unadmitted
+    fl = fleet_lib.ServingFleet(
+        {"r0": _MkEngine(task, theta, max_batch=1),
+         "r1": _MkEngine(task, theta, max_batch=1)}).Start()
+    try:
+      hs = [fl.Submit(list(_P1), 12, session="s") for _ in range(3)]
+      home = hs[0].replica
+      _WaitTokens(fl.Engine(home), 2)        # mid-stream, not pre-admission
+      fl.KillReplica(home)
+      ref = _GreedyRef(task, theta, _P1, 12)
+      for h in hs:
+        assert h.Result(timeout=120) == ref  # regenerated byte-identically
+      sibling = ({"r0", "r1"} - {home}).pop()
+      assert all(h.replica == sibling for h in hs)
+      st = fl.Stats()
+      assert st["failovers"] == 1 and st["resubmitted_requests"] == 3
+      assert st["replicas_up"] == 1 and st["replicas_down"] == 1
+      # the session re-pins: its next turn goes straight to the sibling
+      h = fl.Submit(list(_P1), 3, session="s")
+      assert h.Result(timeout=120) == _GreedyRef(task, theta, _P1, 3)
+      assert h.replica == sibling
+      assert st["router"]["rerouted_down"] >= 1
+    finally:
+      fl.Stop()
+
+  def test_all_replicas_down_raises_on_submit(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet({"r0": _MkEngine(task, theta)}).Start()
+    try:
+      fl.KillReplica("r0")
+      with pytest.raises(RuntimeError):
+        fl.Submit(list(_P1), 2)
+    finally:
+      fl.Stop()
+
+
+class TestDisaggregation:
+
+  def test_prefill_worker_absorbs_prompt_decode_gets_tail(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet(
+        {"d0": _MkEngine(task, theta)},
+        prefill={"p0": _MkEngine(task, theta)}).Start()
+    try:
+      hs = [(fl.Submit(list(_P1), 5), _P1), (fl.Submit(list(_P2), 5), _P2)]
+      for h, p in hs:
+        assert h.Result(timeout=120) == _GreedyRef(task, theta, p, 5)
+      d0, p0 = fl.Engine("d0"), fl.Engine("p0")
+      # the decode replica only ever prefilled the uncached tails:
+      # _P1 leaves 10-8=2, _P2 leaves 9-8=1 (min p0 clamp keeps >=1)
+      assert d0.Stats()["prompt_tokens"] <= 4
+      assert p0.Stats()["prompt_tokens"] == len(_P1) + len(_P2)
+      st = fl.Stats()
+      assert st["handoffs"] == 2 and st["handoff_pages"] == 4
+      assert st["handoff_fallbacks"] == 0
+    finally:
+      fl.Stop()
+
+  def test_warm_decode_prefix_skips_the_handoff(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet(
+        {"d0": _MkEngine(task, theta)},
+        prefill={"p0": _MkEngine(task, theta)}).Start()
+    try:
+      ref = _GreedyRef(task, theta, _P1, 5)
+      assert fl.Submit(list(_P1), 5).Result(timeout=120) == ref
+      assert fl.Submit(list(_P1), 5).Result(timeout=120) == ref
+      st = fl.Stats()
+      # the second submit found its prefix already on d0: no second trip
+      assert st["handoffs"] == 1 and st["requests"] == 2
+      assert fl.Engine("d0").Stats()["prefix_cache"]["hits"] >= 1
+    finally:
+      fl.Stop()
+
+  def test_dead_prefill_worker_falls_back_to_cold_decode(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet(
+        {"d0": _MkEngine(task, theta)},
+        prefill={"p0": _MkEngine(task, theta)}).Start()
+    try:
+      fl.KillReplica("p0")
+      h = fl.Submit(list(_P1), 5)
+      assert h.Result(timeout=120) == _GreedyRef(task, theta, _P1, 5)
+      st = fl.Stats()
+      assert st["handoffs"] == 0             # nobody left to prefill
+    finally:
+      fl.Stop()
+
+  def test_adopt_prefix_requires_caches_and_content(self, tiny_lm):
+    task, theta = tiny_lm
+    donor = _MkEngine(task, theta)
+    recv = _MkEngine(task, theta)
+    cacheless = _MkEngine(task, theta, prefix_cache=None)
+    assert cacheless.AdoptPrefix(list(_P1), donor) == 0
+    assert recv.AdoptPrefix(list(_P1), donor) == 0   # donor cold
+    donor.Start()
+    donor.Submit(list(_P1), max_new_tokens=1).Result(timeout=120)
+    assert recv.AdoptPrefix(list(_P1), donor) == 8
+    assert recv.AdoptPrefix(list(_P1), donor) == 0   # already warm: no churn
+    recv.Start()
+    out = recv.Submit(list(_P1), 5).Result(timeout=120)
+    assert out == _GreedyRef(task, theta, _P1, 5)
+    pc = recv.Stats()["prefix_cache"]
+    assert pc["hits"] == 1 and pc["hit_tokens"] == 8
+    assert recv.Stats()["prompt_tokens"] == 2        # tail only
+    donor.Stop()
+    recv.Stop()
+
+
+class TestSendRecvChannel:
+
+  def test_send_pages_moves_blocks_between_shards(self, tiny_lm):
+    import jax
+    import numpy as np
+    if len(jax.devices()) < 2:
+      pytest.skip("needs >= 2 devices for a real ppermute")
+    task, theta = tiny_lm
+    m = mesh_lib.MakeMesh({"fleet": 2}, devices=jax.devices()[:2])
+    ch = fleet_lib.SendRecvChannel(m, "fleet", src=0, dst=1)
+    blocks = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+              np.full((2, 5), 7, np.int32)]   # int sidecar rides along
+    out = ch.Transfer(blocks)
+    for got, want in zip(out, blocks):
+      assert np.array_equal(np.asarray(got), want)
+    # and the end-to-end handoff through the channel stays byte-exact
+    donor, recv = _MkEngine(task, theta), _MkEngine(task, theta)
+    donor.Start()
+    donor.Submit(list(_P1), max_new_tokens=1).Result(timeout=120)
+    assert recv.AdoptPrefix(list(_P1), donor, channel=ch) == 8
+    recv.Start()
+    assert recv.Submit(list(_P1), 5).Result(timeout=120) == _GreedyRef(
+        task, theta, _P1, 5)
+    donor.Stop()
+    recv.Stop()
+
+
+class TestFleetThetaSwap:
+
+  def test_hot_swap_mid_traffic_with_tree_persistence(self, tiny_lm,
+                                                      tiny_lm_swapped):
+    task, theta = tiny_lm
+    _, theta2 = tiny_lm_swapped
+    # the swap must be observable: _P2 decodes differently under theta2
+    assert _GreedyRef(task, theta, _P2, 4) != _GreedyRef(task, theta2, _P2, 4)
+    fl = fleet_lib.ServingFleet(
+        {"r0": _MkEngine(task, theta, prefix_swap_persist=True),
+         "r1": _MkEngine(task, theta, prefix_swap_persist=True)}).Start()
+    try:
+      pre = fl.Submit(list(_P2), 4, session="s")
+      assert pre.Result(timeout=120) == _GreedyRef(task, theta, _P2, 4)
+      home = pre.replica
+      inflight = fl.Submit(list(_P2), 12, session="s")
+      _WaitTokens(fl.Engine(home), 5)
+      fl.UpdateTheta(theta2)                 # swap with traffic in the air
+      # the radix tree survived the swap (stale, not dropped) ...
+      pc = fl.Engine(home).Stats()["prefix_cache"]
+      assert pc["cached_pages"] == 2 and pc["stale_pages"] == 2
+      assert fl.Stats()["router"]["shadow_nodes"] > 0
+      assert fl.Stats()["theta_swaps"] == 1
+      # ... in-flight work completes; post-swap streams are the new
+      # theta's reference, byte-identical
+      assert len(inflight.Result(timeout=120)) == 12
+      post = fl.Submit(list(_P2), 4, session="s")
+      assert post.Result(timeout=120) == _GreedyRef(task, theta2, _P2, 4)
+      pc = fl.Engine(home).Stats()["prefix_cache"]
+      assert pc["refreshed_pages"] == 2 and pc["stale_pages"] == 0
+      # hit_tokens recover without a cold tree restart
+      again = fl.Submit(list(_P2), 4, session="s")
+      assert again.Result(timeout=120) == _GreedyRef(task, theta2, _P2, 4)
+      assert fl.Engine(home).Stats()["prefix_cache"]["hit_tokens"] >= 7
+    finally:
+      fl.Stop()
+
+
+class TestFleetExport:
+
+  def test_fleet_statusz_scrape_carries_router_section(self, tiny_lm):
+    task, theta = tiny_lm
+    fl = fleet_lib.ServingFleet({"r0": _MkEngine(task, theta)},
+                                serve_port=0).Start()
+    try:
+      fl.Submit(list(_P1), 2).Result(timeout=120)
+      url = f"http://{fl.status_server.host}:{fl.status_server.port}"
+      doc = aggregate.Scrape(url)
+      assert set(doc["stats"]) == observe_schema.FLEET_STATS_KEYS
+      assert set(doc["stats"]["router"]) == observe_schema.ROUTER_STATS_KEYS
+      assert doc["snapshot"]["router/requests_routed"] == 1
+    finally:
+      fl.Stop()
+
+
+@pytest.mark.slow
+class TestFleetSoak:
+
+  def test_poisson_soak_with_swap_and_failover(self, tiny_lm,
+                                               tiny_lm_swapped):
+    """The everything-at-once lifecycle: seeded arrivals over 3 replicas,
+    a persisted theta swap and a replica kill mid-stream, every stream
+    byte-identical to its theta's reference at the time of submit."""
+    import numpy as np
+    task, theta = tiny_lm
+    _, theta2 = tiny_lm_swapped
+    fl = fleet_lib.ServingFleet(
+        {f"r{i}": _MkEngine(task, theta, prefix_swap_persist=True)
+         for i in range(3)}).Start()
+    rng = np.random.RandomState(0)
+    prompts = [_P1, _P2, _P1[:4] + _P2[:4]]
+    try:
+      phase1 = []
+      for i in range(9):
+        p = prompts[i % 3]
+        phase1.append((fl.Submit(list(p), 6, session=f"s{i % 3}"), p))
+        time.sleep(float(rng.exponential(0.01)))
+      for h, p in phase1:
+        assert h.Result(timeout=120) == _GreedyRef(task, theta, p, 6)
+      fl.UpdateTheta(theta2)
+      phase2 = []
+      for i in range(9):
+        p = prompts[i % 3]
+        phase2.append((fl.Submit(list(p), 6, session=f"s{i % 3}"), p))
+        if i == 3:
+          fl.KillReplica(phase2[0][0].replica)
+        time.sleep(float(rng.exponential(0.01)))
+      for h, p in phase2:
+        assert h.Result(timeout=120) == _GreedyRef(task, theta2, p, 6)
+      st = fl.Stats()
+      assert st["failovers"] == 1 and st["theta_swaps"] == 1
+      assert st["requests"] == 18
+    finally:
+      fl.Stop()

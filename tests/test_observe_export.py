@@ -441,6 +441,30 @@ class TestAggregate:
     assert "serving/tokens_emitted" in report and "12" in report
     assert "least-loaded replica" in report and "a" in report
     assert "DOWN c" in report
+    assert "jain fairness" in report
+
+  def test_fleet_report_fairness_and_utilization(self):
+    from tools import fleet_report
+    assert fleet_report.JainFairness([]) == 1.0
+    assert fleet_report.JainFairness([0, 0]) == 1.0      # idle fleet: fair
+    assert fleet_report.JainFairness([5, 5, 5]) == 1.0
+    assert abs(fleet_report.JainFairness([9, 0, 0]) - 1 / 3) < 1e-9
+    docs = {
+        "a": {"snapshot": {"serving/tokens_emitted": 30,
+                           "serving/prompt_tokens": 90,
+                           "scheduler/queue_depth": 2}},
+        "b": {"snapshot": {"serving/tokens_emitted": 10,
+                           "serving/prompt_tokens": 10}},
+        "dead": {"error": "URLError: refused"},          # never a row
+    }
+    util = fleet_report.Utilization(docs)
+    assert set(util["per_replica"]) == {"a", "b"}
+    assert util["per_replica"]["a"]["decode_share"] == 0.75
+    assert util["per_replica"]["b"]["prefill_share"] == 0.1
+    assert util["per_replica"]["b"]["queue_depth"] == 0  # missing -> 0
+    assert abs(util["decode_fairness"]
+               - fleet_report.JainFairness([30, 10])) < 1e-9
+    assert util["prefill_fairness"] < util["decode_fairness"]  # 90/10 skew
 
   def test_scrape_validates_against_live_server(self):
     reg = observe.MetricsRegistry("t")

@@ -26,8 +26,8 @@ from lingvo_tpu.serving import kv_cache
 from lingvo_tpu.serving import prefix_cache as prefix_cache_lib
 from lingvo_tpu.serving import spec_decode
 
-from tests.test_serving_engine import (_GreedyRef, _TinyLmParams,
-                                       tiny_lm)  # noqa: F401
+from tests.test_serving_engine import _GreedyRef, _TinyLmParams
+# (the session-scoped `tiny_lm` fixture resolves from tests/conftest.py)
 
 
 # -- allocator refcounts ------------------------------------------------------
@@ -216,6 +216,50 @@ class TestPrefixTree:
     assert set(disabled) == observe_schema.PREFIX_CACHE_STATS_KEYS
     assert disabled["enabled"] is False
 
+  def test_mark_stale_hides_pages_until_insert_refreshes(self):
+    alloc, cache = self._Fixture()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    _Cached(alloc, cache, prompt)
+    assert cache.MarkStale() == 2
+    # stale pages are NEVER served: probe and peek see nothing
+    assert cache.Probe(prompt) == ([], 0)
+    assert cache.PeekHitTokens(prompt) == 0
+    # ... but the tree structure (and its pages) survive
+    assert cache.cached_pages == 2
+    assert cache.Stats()["stale_pages"] == 2
+    free_before = alloc.num_free
+    # re-prefilling the same prompt refreshes the nodes IN PLACE: new
+    # pages take over, old pages return to the pool, no tree growth
+    new = _Cached(alloc, cache, prompt)
+    got, matched = cache.Probe(prompt)
+    assert got == new and matched == 8
+    st = cache.Stats()
+    assert st["stale_pages"] == 0 and st["refreshed_pages"] == 2
+    assert cache.cached_pages == 2 and cache.evictions == 0
+    assert alloc.num_free == free_before     # swap, not leak
+
+  def test_mark_stale_partial_refresh_serves_fresh_prefix_only(self):
+    alloc, cache = self._Fixture()
+    _Cached(alloc, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+    cache.MarkStale()
+    _Cached(alloc, cache, [1, 2, 3, 4])      # refresh only the first page
+    got, matched = cache.Probe([1, 2, 3, 4, 5, 6, 7, 8])
+    assert len(got) == 1 and matched == 4    # walk stops at the stale child
+    st = cache.Stats()
+    assert st["stale_pages"] == 1 and st["refreshed_pages"] == 1
+
+  def test_mark_stale_twice_and_eviction_still_collects(self):
+    alloc, cache = self._Fixture()
+    _Cached(alloc, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+    assert cache.MarkStale() == 2
+    assert cache.MarkStale() == 2            # idempotent-ish: still stale
+    assert cache.Stats()["stale_pages"] == 2
+    # stale entries remain ordinary LRU citizens for memory pressure
+    assert cache.EvictLru(5) == 2
+    assert cache.cached_pages == 0
+    assert alloc.num_free == alloc.num_pages
+    assert cache.MarkStale() == 0            # empty tree: nothing to mark
+
 
 # -- serving engine -----------------------------------------------------------
 
@@ -362,6 +406,68 @@ class TestPrefixEngine:
     # next identical request is a miss, and (same theta) byte-identical
     assert _Run(eng, _PROMPT, 6) == cold
     assert eng.Stats()["prefix_cache"]["misses"] == 2
+
+  def test_update_theta_persists_tree_and_recovers_hits(self, tiny_lm,
+                                                        tiny_lm_swapped):
+    task, theta = tiny_lm
+    _, theta2 = tiny_lm_swapped
+    eng = _MakeEngine(task, theta, prefix_swap_persist=True)
+    _Run(eng, _PROMPT, 6)
+    eng.UpdateTheta(theta2)                  # swap: tree kept, pages stale
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["cached_pages"] == 2 and pc["evictions"] == 0
+    assert pc["stale_pages"] == 2
+    # post-swap stream is the NEW theta's reference (stale KV never
+    # served): a miss that re-prefills and refreshes the tree in place
+    ref2 = _GreedyRef(task, theta2, _PROMPT, 6)
+    assert _Run(eng, _PROMPT, 6) == ref2
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["stale_pages"] == 0 and pc["refreshed_pages"] == 2
+    assert pc["cached_pages"] == 2
+    # ... and the NEXT request hits warm again: no cold tree restart
+    assert _Run(eng, _PROMPT, 6) == ref2
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["hit_tokens"] == 7 and pc["hits"] == 1
+
+  def test_update_theta_persist_flag_overrides_per_call(self, tiny_lm):
+    task, theta = tiny_lm
+    # engine default persists; the per-call knob can force a hard drop
+    eng = _MakeEngine(task, theta, prefix_swap_persist=True)
+    _Run(eng, _PROMPT, 6)
+    eng.UpdateTheta(theta, persist_prefix=False)
+    assert eng.Stats()["prefix_cache"]["cached_pages"] == 0
+    # and the reverse: a default-Invalidate engine can persist on demand
+    eng2 = _MakeEngine(task, theta)
+    _Run(eng2, _PROMPT, 6)
+    eng2.UpdateTheta(theta, persist_prefix=True)
+    pc = eng2.Stats()["prefix_cache"]
+    assert pc["cached_pages"] == 2 and pc["stale_pages"] == 2
+
+  def test_swap_under_load_post_swap_streams_byte_identical(
+      self, tiny_lm, tiny_lm_swapped):
+    """UpdateTheta with admitted AND queued work in flight: everything
+    completes, and every request admitted after the swap decodes the new
+    theta's exact greedy stream off the persisted (refreshed) tree."""
+    task, theta = tiny_lm
+    _, theta2 = tiny_lm_swapped
+    eng = _MakeEngine(task, theta, prefix_swap_persist=True)
+    _Run(eng, _PROMPT, 6)                    # warm the tree pre-swap
+    inflight = eng.Submit(list(_PROMPT), 6)
+    queued = eng.Submit(list(_PROMPT), 6)
+    eng.StepOnce()                           # admit `inflight` (batch=2
+    eng.StepOnce()                           # holds both), decode a bit
+    eng.UpdateTheta(theta2)
+    assert eng.Stats()["prefix_cache"]["stale_pages"] == 2
+    while not (inflight.done and queued.done):
+      eng.StepOnce()
+    # in-flight work finished (mixed-theta streams: only length holds)
+    assert len(inflight.Result(timeout=0)) == 6
+    assert len(queued.Result(timeout=0)) == 6
+    ref2 = _GreedyRef(task, theta2, _PROMPT, 6)
+    assert _Run(eng, _PROMPT, 6) == ref2     # re-prefill, refresh
+    assert _Run(eng, _PROMPT, 6) == ref2     # warm hit on the new pages
+    pc = eng.Stats()["prefix_cache"]
+    assert pc["refreshed_pages"] >= 2 and pc["stale_pages"] == 0
 
   def test_stats_schema_and_midflight_sharing(self, tiny_lm):
     task, theta = tiny_lm

@@ -53,7 +53,7 @@ _REF_CACHE_LEN = 32
 def _GreedyRef(task, theta, prompt, max_new):
   """Per-row dense greedy rollout (per-token ExtendStep argmax): the
   batch-free reference every engine output must match token-for-token."""
-  key = (id(task), tuple(int(t) for t in prompt), max_new)
+  key = (id(task), id(theta), tuple(int(t) for t in prompt), max_new)
   if key in _REF_TOKENS:
     return _REF_TOKENS[key]
   ext = _REF_EXT.get(id(task))

@@ -9,6 +9,11 @@ prints:
 
 - a fleet totals table (summed counters, merged-histogram p50/p99);
 - a per-replica gauge table (queue depth, active slots, config facts);
+- router fairness + per-replica utilization: each replica's share of
+  the fleet's emitted/prefilled tokens and the Jain fairness index over
+  both (1.0 = perfectly even; 1/N = one replica does all the work — a
+  prefix-aware router intentionally trades some fairness for cache
+  affinity, so read this column against `router/prefix_routed`);
 - the least-loaded replica (the router's admission choice);
 - any unreachable replicas, each with its error.
 
@@ -24,6 +29,57 @@ import sys
 
 from lingvo_tpu.observe import aggregate
 from lingvo_tpu.observe import metrics as metrics_lib
+
+
+def JainFairness(values) -> float:
+  """Jain's fairness index over per-replica work counts: (sum x)^2 /
+  (n * sum x^2). 1.0 when perfectly even, 1/n when one replica does
+  everything; an idle fleet (all zero) reads as fair."""
+  xs = [float(v) for v in values]
+  if not xs:
+    return 1.0
+  sq = sum(x * x for x in xs)
+  if sq == 0.0:
+    return 1.0
+  return (sum(xs) ** 2) / (len(xs) * sq)
+
+
+def Utilization(docs: dict) -> dict:
+  """Per-replica utilization + fairness over {label: statusz doc}.
+
+  Reads each live replica's `serving/tokens_emitted` (decode work) and
+  `serving/prompt_tokens` (prefill work actually computed — prefix-cache
+  hits don't count, which is exactly why a prefix router skews this
+  column on purpose) plus `scheduler/queue_depth`, and computes the
+  Jain index over both work distributions."""
+  per = {}
+  for label in sorted(docs):
+    doc = docs[label]
+    if not isinstance(doc, dict) or "snapshot" not in doc:
+      continue
+    snap = doc["snapshot"]
+
+    def _Num(key):
+      v = snap.get(key, 0)
+      return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+          else 0
+    per[label] = {
+        "tokens_emitted": _Num("serving/tokens_emitted"),
+        "prompt_tokens": _Num("serving/prompt_tokens"),
+        "queue_depth": _Num("scheduler/queue_depth"),
+    }
+  tot_e = sum(r["tokens_emitted"] for r in per.values())
+  tot_p = sum(r["prompt_tokens"] for r in per.values())
+  for r in per.values():
+    r["decode_share"] = r["tokens_emitted"] / tot_e if tot_e else 0.0
+    r["prefill_share"] = r["prompt_tokens"] / tot_p if tot_p else 0.0
+  return {
+      "per_replica": per,
+      "decode_fairness": JainFairness(
+          r["tokens_emitted"] for r in per.values()),
+      "prefill_fairness": JainFairness(
+          r["prompt_tokens"] for r in per.values()),
+  }
 
 
 def FleetReport(docs: dict) -> str:
@@ -55,6 +111,19 @@ def FleetReport(docs: dict) -> str:
       if isinstance(v, (dict, list)):
         continue   # structured values belong to the raw /statusz
       lines.append(f"    {name:<42} {v}")
+  util = Utilization(live)
+  if util["per_replica"]:
+    lines.append("")
+    lines.append("router fairness / per-replica utilization:")
+    lines.append(f"  {'replica':<20} {'decode_tok':>10} {'share':>7} "
+                 f"{'prefill_tok':>11} {'share':>7} {'queue':>6}")
+    for label, r in util["per_replica"].items():
+      lines.append(
+          f"  {label:<20} {r['tokens_emitted']:>10} "
+          f"{r['decode_share']:>7.2%} {r['prompt_tokens']:>11} "
+          f"{r['prefill_share']:>7.2%} {r['queue_depth']:>6}")
+    lines.append(f"  jain fairness: decode={util['decode_fairness']:.3f} "
+                 f"prefill={util['prefill_fairness']:.3f}")
   target = aggregate.LeastLoaded(live)
   if target is not None:
     lines.append("")
@@ -72,6 +141,7 @@ def main(argv=None) -> int:
   docs = aggregate.ScrapeAll(urls)
   if as_json:
     out = {"merged": aggregate.MergeStatusz(docs),
+           "utilization": Utilization(docs),
            "least_loaded": aggregate.LeastLoaded(docs),
            "errors": {k: v["error"] for k, v in docs.items()
                       if "error" in v}}
