@@ -87,6 +87,9 @@ GSHARD_TELEMETRY_KEYS = (
     "draft_tokens", "accepted_tokens", "accepted_len_hist",
     "spec_branches", "spec_width_clamps", "accepted_depth_hist",
     "prefix_hit_tokens", "prefix_cache", "step_programs",
+    # SLO scheduling counters (engine scheduler section mirror) — the
+    # batch-synchronous driver never preempts, so it zero-fills these
+    "preemptions", "spilled_pages", "restored_pages", "host_bytes",
 )
 
 # Keys both serving surfaces advertise (values must mean the same thing).
@@ -135,11 +138,17 @@ COMPILE_CENSUS_KEY = "step_programs"
 
 # -- sub-surface key sets ----------------------------------------------------
 
-# serving/scheduler.py Scheduler.Stats()
+# serving/scheduler.py Scheduler.Stats(). The SLO block (scheduler_mode
+# onward) is all-zeros/'fifo' on legacy schedulers; queue_depth_high is
+# the router's class-aware load signal ("scheduler/queue_depth_high" in
+# registry snapshots: parked work ABOVE the default priority class).
 SCHEDULER_STATS_KEYS = frozenset({
     "slots", "slots_live", "slots_prefill", "slots_live_peak", "queue_depth",
     "admitted", "finished", "cancelled", "rejected_overlong",
     "needs_kv_pages", "prefix_ordered_admissions", "width_clamps",
+    "scheduler_mode", "preemptions", "restores", "preempted_queued",
+    "quota_rejections", "spilled_pages", "restored_pages", "host_bytes",
+    "queue_depth_high",
 })
 
 # serving/kv_cache.py PageAllocator.Stats() (page_bytes/pool_bytes only
@@ -174,6 +183,7 @@ def DisabledPrefixCacheStats() -> dict:
 ROUTER_STATS_KEYS = frozenset({
     "requests_routed", "pinned_routed", "prefix_routed", "balanced_routed",
     "rerouted_down", "sessions_pinned", "shadow_nodes", "shadow_evictions",
+    "priority_routed",
 })
 
 # serving/fleet.py ServingFleet.Stats() — fleet-level view over N replica
@@ -182,6 +192,7 @@ FLEET_STATS_KEYS = frozenset({
     "policy", "disaggregated", "replicas", "replicas_up", "replicas_down",
     "requests", "failovers", "resubmitted_requests",
     "handoffs", "handoff_pages", "handoff_fallbacks", "theta_swaps",
+    "priority_requests", "quota_rejections",
     "router",
 })
 

@@ -310,6 +310,13 @@ class GShardDecode:
         # Stats()["compile"]["step_programs"]: this driver compiles a
         # (prefill, sample) program pair per (p_len, t_max) bucket
         step_programs=2 * len(self._decode_fns),
+        # SLO scheduling counters, same mirroring contract: the batch-
+        # synchronous driver admits everything up front and never
+        # preempts, so no host tier exists
+        preemptions=0,
+        spilled_pages=0,
+        restored_pages=0,
+        host_bytes=0,
     ))
     self._decodes.Inc()
     # the dict every result record carries is rebuilt FROM the registry —

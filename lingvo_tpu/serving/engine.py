@@ -154,7 +154,9 @@ class ServingLoop:
                serve_port: Optional[int] = None, watchdog=None,
                step_mode: str = "ragged",
                prefill_token_budget: Optional[int] = None,
-               prefix_swap_persist: bool = False):
+               prefix_swap_persist: bool = False,
+               scheduler_mode: str = "fifo",
+               tenant_quotas=None, tenant_weights=None):
     """task: a TransformerLm-style task exposing InitPagedDecodeState /
     PagedStep. num_pages: allocator-owned pages (the device pool gets one
     extra trash page). max_seq_len: static per-sequence capacity bound
@@ -208,6 +210,17 @@ class ServingLoop:
     never served, but one warm re-prefill per live prefix refreshes its
     nodes in place, so hit_tokens recover without a cold tree restart.
     Per-swap override via UpdateTheta(persist_prefix=...).
+    scheduler_mode: 'fifo' (default, the bit-exact legacy admission
+    path) or 'priority' — SLO classes, per-tenant quotas, weighted-fair
+    admission, and preemption by KV page spill to a host tier
+    (serving/scheduler.py module docstring). The engine supplies the
+    device halves: jitted whole-page gather/scatter over every paged
+    leaf (spilled KV round-trips bitwise, int8 scale sidecars ride
+    along) and slot-row gather/scatter over every O(1)-mixer state
+    leaf. tenant_quotas: {tenant: (rate, burst) | TokenBucket} token-
+    rate quotas enforced at Submit (QuotaExceeded before a handle is
+    created). tenant_weights: {tenant: weight} for weighted-fair
+    admission within a priority class.
     """
     assert page_size >= 1 and num_pages >= 1 and max_batch >= 1
     assert max_seq_len >= page_size
@@ -260,7 +273,23 @@ class ServingLoop:
     self.sched = scheduler_lib.Scheduler(
         max_batch, self.alloc, table_pages, prefill_chunk,
         needs_kv_pages=self.mixers["num_attention"] > 0,
-        state_pool=self.state_pool, prefix_cache=self.prefix_cache)
+        state_pool=self.state_pool, prefix_cache=self.prefix_cache,
+        scheduler_mode=scheduler_mode, tenant_quotas=tenant_quotas,
+        tenant_weights=tenant_weights)
+    self.scheduler_mode = scheduler_mode
+    # device halves of preemption spill/restore (priority mode): whole-
+    # page gather/scatter across the paged leaves, slot-row gather/
+    # scatter across the O(1)-mixer state leaves. All four run under the
+    # engine lock on the loop thread (Admit is only called from
+    # _AdmitPhase), so mutating self._states here is safe.
+    if scheduler_mode == "priority":
+      if self.mixers["num_attention"] > 0:
+        self.sched.spill_fn = self._SpillPages
+        self.sched.restore_fn = self._RestorePages
+      if self.state_pool is not None:
+        self.sched.state_spill_fn = self._SpillStateRow
+        self.sched.state_restore_fn = self._RestoreStateRow
+    self._slot_io_fns = None   # lazy (gather, scatter) jits over slot leaves
     # pool page num_pages (the +1) is the trash page padding writes hit;
     # num_slots sizes the per-slot O(1) mixer states (attention ignores it);
     # the kv dtype override is a static string arg (hashable)
@@ -374,6 +403,7 @@ class ServingLoop:
     self._h_queue_wait = self.metrics.Histogram("serving/queue_wait_s")
     self._h_ttft = self.metrics.Histogram("serving/ttft_s")
     self._h_tpot = self.metrics.Histogram("serving/tpot_s")
+    self._h_queue_wait_cls: dict = {}   # SLO class -> queue-wait Histogram
     self._pages_of: dict = {}   # req_id -> pages granted at admission
     self._profile_window = None
     self._lock = threading.RLock()
@@ -738,6 +768,92 @@ class ServingLoop:
                            jax.jit(_Scatter, donate_argnums=donate))
     return self._page_io_fns
 
+  # -- preemption spill/restore (scheduler_mode='priority') ------------------
+
+  def _SlotLeafAxes(self):
+    """Slot axis per decode-state leaf, None for slot-independent leaves.
+
+    The same structural trick as _PagedLeafAxes, diffed along num_slots
+    instead of the pool geometry: abstract-eval InitPagedDecodeState at
+    max_batch and max_batch + 1 — the leaf axis that grew is the slot
+    axis. Exactly the O(1)-mixer state leaves move (paged KV leaves are
+    slot-independent; block tables route them), so this is the complete
+    per-slot recurrent state a preemption must carry to the host."""
+    def _Shapes(num_slots):
+      return jax.eval_shape(
+          lambda th: self._task.InitPagedDecodeState(
+              th, self.num_pages + 1, self.page_size, num_slots,
+              self._kv_override), self._theta)
+
+    a = jax.tree_util.tree_leaves(_Shapes(self.max_batch))
+    b = jax.tree_util.tree_leaves(_Shapes(self.max_batch + 1))
+    axes = []
+    for la, lb in zip(a, b):
+      diff = [i for i, (x, y) in enumerate(zip(la.shape, lb.shape))
+              if x != y]
+      assert len(diff) <= 1, (la.shape, lb.shape)
+      axes.append(diff[0] if diff else None)
+    return axes
+
+  def _SlotIoFns(self):
+    """Jitted (gather, scatter) of ONE slot's row across every slot-axis
+    leaf — the state half of preemption spill/restore."""
+    if self._slot_io_fns is None:
+      axes = self._SlotLeafAxes()
+
+      def _Gather(states, slot):
+        leaves = jax.tree_util.tree_leaves(states)
+        assert len(leaves) == len(axes), (len(leaves), len(axes))
+        return [jnp.take(leaf, slot, axis=ax)
+                for leaf, ax in zip(leaves, axes) if ax is not None]
+
+      def _Scatter(states, slot, rows):
+        leaves, treedef = jax.tree_util.tree_flatten(states)
+        assert len(leaves) == len(axes), (len(leaves), len(axes))
+        out, j = [], 0
+        for leaf, ax in zip(leaves, axes):
+          if ax is None:
+            out.append(leaf)
+          else:
+            out.append(leaf.at[(slice(None),) * ax + (slot,)].set(rows[j]))
+            j += 1
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+      donate = (0,) if jax.default_backend() != "cpu" else ()
+      self._slot_io_fns = (jax.jit(_Gather),
+                           jax.jit(_Scatter, donate_argnums=donate))
+    return self._slot_io_fns
+
+  def _SpillPages(self, pages):
+    """Scheduler spill callback: device→host copies of whole pages
+    across every paged leaf. Copies to host memory are FORCED before
+    returning — the scheduler frees the device pages right after, so a
+    lazy device view would read reallocated garbage."""
+    gather, _ = self._PageIoFns()
+    blocks = gather(self._states, jnp.asarray(pages, jnp.int32))
+    return [np.asarray(b) for b in jax.block_until_ready(blocks)]
+
+  def _RestorePages(self, pages, blocks):
+    """Scheduler restore callback: scatters spilled host blocks into the
+    freshly allocated device pages (same logical slots, new physical)."""
+    _, scatter = self._PageIoFns()
+    self._states = scatter(self._states, jnp.asarray(pages, jnp.int32),
+                           [jnp.asarray(b) for b in blocks])
+
+  def _SpillStateRow(self, slot: int):
+    """Scheduler state-spill callback: one slot's O(1)-mixer state rows
+    (every slot-axis leaf), forced to host."""
+    gather, _ = self._SlotIoFns()
+    rows = gather(self._states, jnp.int32(slot))
+    return [np.asarray(r) for r in jax.block_until_ready(rows)]
+
+  def _RestoreStateRow(self, slot: int, rows):
+    """Scheduler state-restore callback: lands a spilled state row in
+    the (possibly different) slot the sequence resumes in."""
+    _, scatter = self._SlotIoFns()
+    self._states = scatter(self._states, jnp.int32(slot),
+                           [jnp.asarray(r) for r in rows])
+
   def ExportPrefixBlocks(self, prompt):
     """Donor half of the fleet page handoff: gathers this engine's
     cached full-page KV prefix of `prompt` out of its pool. Returns
@@ -872,7 +988,8 @@ class ServingLoop:
   def Submit(self, prompt, max_new_tokens: Optional[int] = None,
              eos_id=_END, seed: Optional[int] = None,
              spec_k: Optional[int] = None,
-             spec_w: Optional[int] = None) -> StreamHandle:
+             spec_w: Optional[int] = None,
+             priority: int = 0, tenant=None) -> StreamHandle:
     """Queues a request; returns its streaming handle immediately.
 
     seed: per-request sampling seed (defaults to the request id) — only
@@ -883,14 +1000,20 @@ class ServingLoop:
     at min(n, engine k).
     spec_w: per-request tree-speculation WIDTH knob — None defers to the
     engine's draft width, 1 forces a linear chain (exact chain-spec
-    behavior), n > 1 caps the branch count at min(n, engine w)."""
+    behavior), n > 1 caps the branch count at min(n, engine w).
+    priority: SLO class, higher = more urgent — consulted only under
+    scheduler_mode='priority' (admission order + preemption rights);
+    FIFO engines ignore it. tenant: quota/fairness label; a tenant over
+    its token-rate quota gets QuotaExceeded here, before any handle or
+    scheduler state is created."""
     max_new = max_new_tokens or self.default_max_new
     eos = self.eos_id if eos_id is _END else eos_id
     with self._lock:
       self._seq_counter += 1
       req_id = self._seq_counter
       req = scheduler_lib.Request(req_id, prompt, max_new, eos, seed=seed,
-                                  spec_k=spec_k, spec_w=spec_w)
+                                  spec_k=spec_k, spec_w=spec_w,
+                                  priority=priority, tenant=tenant)
       total = len(req.prompt) + req.max_new
       if self.sched.needs_kv_pages and (
           self.alloc.PagesFor(total) > self.alloc.num_pages):
@@ -956,6 +1079,10 @@ class ServingLoop:
     admitted = self.sched.Admit()
     for seq in admitted:
       h = self._handles.get(seq.id)
+      # a restored PREEMPTED sequence comes back through Admit too:
+      # admit_time (and the prefix-hit count) belong to its FIRST
+      # admission only
+      first = h is None or h.admit_time is None
       if h is not None and h.admit_time is None:
         h.admit_time = time.perf_counter()
       pages = 0
@@ -965,7 +1092,7 @@ class ServingLoop:
         except KeyError:
           pages = 0
       self._pages_of[seq.id] = pages
-      if seq.reused_tokens > 0:
+      if seq.reused_tokens > 0 and first:
         self._counters["prefix_hit_tokens"].Inc(seq.reused_tokens)
         if self.trace is not None:
           self.trace.PrefixHit(seq.id, seq.reused_tokens)
@@ -1181,6 +1308,16 @@ class ServingLoop:
     independent of whether tracing is on (caller holds the lock)."""
     if h.admit_time is not None:
       self._h_queue_wait.Observe(h.admit_time - h.submit_time)
+      # per-SLO-class queue-wait histograms (priority mode): lazily
+      # created per class actually seen, so fifo engines publish none
+      if self.scheduler_mode == "priority":
+        seq = self.sched._by_id.get(h.id)
+        cls = seq.req.priority if seq is not None else 0
+        hist = self._h_queue_wait_cls.get(cls)
+        if hist is None:
+          hist = self.metrics.Histogram(f"serving/queue_wait_s_c{cls}")
+          self._h_queue_wait_cls[cls] = hist
+        hist.Observe(h.admit_time - h.submit_time)
     if h.first_token_time is not None:
       self._h_ttft.Observe(h.first_token_time - h.submit_time)
       ntok = len(h._tokens)

@@ -64,6 +64,7 @@ from lingvo_tpu.observe import schema as observe_schema
 from lingvo_tpu.parallel import mesh as mesh_lib
 from lingvo_tpu.parallel import sendrecv
 from lingvo_tpu.serving import router as router_lib
+from lingvo_tpu.serving import scheduler as scheduler_lib
 
 _UNSET = object()
 
@@ -79,13 +80,16 @@ class FleetHandle:
   live-stream, since a mid-stream rebind would have to retract tokens.
   """
 
-  def __init__(self, fleet, prompt, max_new, session, seed, eos_id):
+  def __init__(self, fleet, prompt, max_new, session, seed, eos_id,
+               priority=0, tenant=None):
     self._fleet = fleet
     self.prompt = list(prompt)
     self.max_new = max_new
     self.session = session
     self.seed = seed
     self.eos_id = eos_id
+    self.priority = priority
+    self.tenant = tenant
     self.replica: Optional[str] = None   # current home's label
     self.finish_reason: Optional[str] = None
     self._cond = threading.Condition()
@@ -266,6 +270,8 @@ class ServingFleet:
     self.handoff_pages = 0
     self.handoff_fallbacks = 0
     self.theta_swaps = 0
+    self.priority_requests = 0
+    self.quota_rejections = 0
     self.metrics = observe.MetricsRegistry("fleet")
     self.metrics.SectionFn("router", self.router.Stats)
     self.metrics.SectionFn("fleet", self._ScalarStats)
@@ -337,10 +343,11 @@ class ServingFleet:
                  if lb in self._up else None)
     return out
 
-  def _Pick(self, prompt, session) -> str:
+  def _Pick(self, prompt, session, priority: int = 0) -> str:
     snapshots = self._Snapshots()
     if self.policy == "prefix":
-      return self.router.Route(prompt, snapshots, session=session)
+      return self.router.Route(prompt, snapshots, session=session,
+                               priority=priority)
     live = [lb for lb in self.order if snapshots.get(lb) is not None]
     if not live:
       raise RuntimeError(f"no UP replica among {self.order}")
@@ -363,31 +370,42 @@ class ServingFleet:
 
   def Submit(self, prompt, max_new_tokens: Optional[int] = None,
              session=None, seed: Optional[int] = None,
-             eos_id=_UNSET) -> FleetHandle:
+             eos_id=_UNSET, priority: int = 0, tenant=None) -> FleetHandle:
     """Routes and queues one request; returns its fleet handle.
 
     session: opaque chat-session key — requests sharing it pin to one
     replica (its cache holds the conversation prefix). seed: per-request
     sampling seed, defaulted to a FLEET-global counter so a request
     resubmitted (failover) or replayed on another replica draws the
-    same stream at temperature > 0."""
+    same stream at temperature > 0.
+    priority/tenant: SLO class + quota label, forwarded to the replica
+    engine (meaningful only when replicas run scheduler_mode='priority').
+    A priority > 0 request routes on class-aware load ("scheduler/
+    queue_depth_high") rather than raw queue depth. Quotas are enforced
+    PER REPLICA by the engine's scheduler (a fleet of N replicas admits
+    ~N x the per-replica rate; scheduler.QuotaExceeded propagates from
+    here when the routed replica's bucket is dry)."""
     with self._lock:
       assert self._running, "Submit before Start()"
       self._req_counter += 1
       self.requests += 1
+      if priority > 0:
+        self.priority_requests += 1
       if seed is None:
         seed = self._req_counter
-      fh = FleetHandle(self, prompt, max_new_tokens, session, seed, eos_id)
+      fh = FleetHandle(self, prompt, max_new_tokens, session, seed, eos_id,
+                       priority=priority, tenant=tenant)
       if self.disaggregated and len(prompt) >= self.page_size:
         if self.policy == "prefix":
           # route WITHOUT tagging the shadow: "warm" must read whether
           # some EARLIER request already put the full prefix there
           label = self.router.Route(prompt, self._Snapshots(),
-                                    session=session, note=False)
+                                    session=session, note=False,
+                                    priority=priority)
           warm = self.router.shadow.ExpectedHitTokens(label, prompt)
           self.router.shadow.NoteRouted(label, prompt)
         else:
-          label = self._Pick(prompt, session)
+          label = self._Pick(prompt, session, priority=priority)
           warm = 0
         full = (len(prompt) // self.page_size) * self.page_size
         if warm < min(full, len(prompt) - 1):
@@ -398,16 +416,30 @@ class ServingFleet:
             self._pending.append(_Handoff(fh, worker, ph, label))
             return fh
       else:
-        label = self._Pick(prompt, session)
+        label = self._Pick(prompt, session, priority=priority)
       self._Dispatch(fh, label)
     return fh
 
   def _Dispatch(self, fh: FleetHandle, label: str):
-    """Submits to a decode replica and binds (caller holds the lock)."""
+    """Submits to a decode replica and binds (caller holds the lock).
+
+    A dry per-replica quota bucket raises scheduler.QuotaExceeded out of
+    the user's Submit; on RE-dispatch (failover, handoff landing) the
+    original admission already paid, so the retry goes quota-exempt —
+    a replica death must never turn into a quota rejection."""
     eng = self._engines[label]
     kwargs = {} if fh.eos_id is _UNSET else {"eos_id": fh.eos_id}
-    h = eng.Submit(list(fh.prompt), max_new_tokens=fh.max_new,
-                   seed=fh.seed, **kwargs)
+    try:
+      h = eng.Submit(list(fh.prompt), max_new_tokens=fh.max_new,
+                     seed=fh.seed, priority=fh.priority, tenant=fh.tenant,
+                     **kwargs)
+    except scheduler_lib.QuotaExceeded:
+      if fh._inner is not None:   # re-dispatch: quota was already paid
+        h = eng.Submit(list(fh.prompt), max_new_tokens=fh.max_new,
+                       seed=fh.seed, priority=fh.priority, **kwargs)
+      else:
+        self.quota_rejections += 1
+        raise
     self._outstanding[label][id(fh)] = fh
     fh._Rebind(h, label)
 
@@ -528,6 +560,8 @@ class ServingFleet:
           "handoff_pages": self.handoff_pages,
           "handoff_fallbacks": self.handoff_fallbacks,
           "theta_swaps": self.theta_swaps,
+          "priority_requests": self.priority_requests,
+          "quota_rejections": self.quota_rejections,
       }
 
   def Stats(self) -> dict:

@@ -47,6 +47,12 @@ class OutOfPages(Exception):
   """Raised by Allocate when the pool cannot satisfy the request."""
 
 
+# Logical-slot sentinel for a page spilled to the host tier: the owner
+# keeps its position in the logical order (so restore scatters the saved
+# bytes back to the SAME logical slot) but holds no device page there.
+HOLE = -1
+
+
 class PageAllocator:
   """Owns [0, num_pages) of the device pool; sequences hold disjoint sets.
 
@@ -95,8 +101,30 @@ class PageAllocator:
     return n <= len(self._free)
 
   def PagesOf(self, seq_id) -> list[int]:
-    """The sequence's pages in logical order (index i = logical page i)."""
+    """The sequence's pages in logical order (index i = logical page i).
+    Spilled logical slots read HOLE until FillHoles re-backs them."""
     return list(self._owned[seq_id])
+
+  def HoleCount(self, seq_id) -> int:
+    """Logical slots seq_id holds that were spilled (no device page)."""
+    return sum(1 for pg in self._owned.get(seq_id, ()) if pg == HOLE)
+
+  def PrivatePages(self, seq_id, num_tokens: int) -> list[tuple[int, int]]:
+    """(logical_idx, page) pairs seq_id exclusively owns among the pages
+    covering its first num_tokens logical slots — the pages whose BYTES a
+    preemption must save to the host tier. Shared pages (a borrowed or
+    inserted prefix) stay device-resident across a spill: the sequence's
+    reference pins them, so they restore by simply still being there.
+    Trailing private pages past the written cursor hold no data and are
+    freed without saving."""
+    data = self.PagesFor(num_tokens)
+    out = []
+    for idx, pg in enumerate(self._owned.get(seq_id, ())):
+      if idx >= data:
+        break
+      if pg != HOLE and self._ref.get(pg, 0) == 1:
+        out.append((idx, pg))
+    return out
 
   def RefCount(self, page: int) -> int:
     """References on `page` (0 = free)."""
@@ -121,6 +149,10 @@ class PageAllocator:
     hi = (start_token + num_tokens - 1) // self.page_size
     for idx in range(lo, min(hi, len(pages) - 1) + 1):
       pg = pages[idx]
+      assert pg != HOLE, (
+          f"seq {seq_id!r} writing tokens [{start_token}, "
+          f"{start_token + num_tokens}) through spilled logical page {idx} "
+          "— FillHoles must re-back a restored sequence before any step")
       assert self._ref.get(pg, 0) == 1, (
           f"seq {seq_id!r} writing tokens [{start_token}, "
           f"{start_token + num_tokens}) would touch page {pg} (logical "
@@ -198,6 +230,46 @@ class PageAllocator:
     self._DecRef(old)
     return (old, new)
 
+  def SpillPrivate(self, seq_id) -> int:
+    """Preemption, device half: releases every page seq_id exclusively
+    owns, leaving HOLE sentinels at their logical slots; returns the
+    count released. Shared pages (refcount >= 2 — a borrowed prefix, or
+    pages the prefix cache retained) KEEP their reference: they stay
+    device-resident and un-evictable, which is what makes restore of a
+    prefix-sharing sequence correct without re-spilling shared bytes.
+    The caller must have gathered the private DATA pages' bytes
+    (PrivatePages) to the host tier first — this only drops ownership."""
+    pages = self._owned.get(seq_id)
+    assert pages is not None, f"spill of unknown sequence {seq_id!r}"
+    freed = 0
+    for idx, pg in enumerate(pages):
+      if pg != HOLE and self._ref.get(pg, 0) == 1:
+        self._DecRef(pg)
+        pages[idx] = HOLE
+        freed += 1
+    return freed
+
+  def FillHoles(self, seq_id) -> list[tuple[int, int]]:
+    """Restore, device half: re-backs every HOLE with a fresh exclusive
+    page, all-or-nothing (raises OutOfPages with no side effects when
+    the pool cannot cover them — the scheduler keeps the sequence
+    parked). Returns (logical_idx, page) pairs so the engine can scatter
+    the host-tier bytes back into exactly the logical slots they left."""
+    pages = self._owned.get(seq_id)
+    assert pages is not None, f"restore of unknown sequence {seq_id!r}"
+    holes = [idx for idx, pg in enumerate(pages) if pg == HOLE]
+    if len(holes) > len(self._free):
+      raise OutOfPages(
+          f"restore needs {len(holes)} pages, {len(self._free)} free")
+    got = [heapq.heappop(self._free) for _ in range(len(holes))]
+    out = []
+    for idx, pg in zip(holes, got):
+      self._ref[pg] = 1
+      pages[idx] = pg
+      out.append((idx, pg))
+    self.peak_in_use = max(self.peak_in_use, self.num_in_use)
+    return out
+
   def NoteRollback(self, num_tokens: int):
     """Records num_tokens rejected verify-step writes (cursor rollback)."""
     assert num_tokens >= 0, num_tokens
@@ -218,11 +290,15 @@ class PageAllocator:
     return to the pool when the LAST reference drops).
 
     Idempotent: freeing an unknown/already-freed id is a no-op (eviction
-    and cancellation can race to the same sequence at a step boundary)."""
+    and cancellation can race to the same sequence at a step boundary).
+    HOLE slots (spilled pages) hold no device reference to drop."""
     pages = self._owned.pop(seq_id, [])
+    n = 0
     for pg in pages:
-      self._DecRef(pg)
-    return len(pages)
+      if pg != HOLE:
+        self._DecRef(pg)
+        n += 1
+    return n
 
 
 class StateSlotPool:
@@ -285,4 +361,94 @@ class StateSlotPool:
         "free": self.num_free,
         "peak_in_use": self.peak_in_use,
         "state_bytes_in_use": self.num_in_use * self.bytes_per_slot,
+    }
+
+
+class SpillEntry:
+  """One preempted sequence's host-tier state.
+
+  logical_idxs: which logical pages the saved blocks re-occupy at
+  restore (only the PRIVATE pages that held written data — shared
+  prefix pages never leave the device, and trailing reserved pages
+  hold no data worth moving). blocks: per-paged-leaf host arrays, each
+  [len(logical_idxs), ...] in logical_idxs order — int8 K/V pools and
+  their f32 scale sidecars are separate leaves and ride along
+  unchanged; None on device-free schedulers (unit tests). state_row:
+  per-slot-leaf host arrays of the sequence's O(1) mixer state row
+  (None for attention-only stacks).
+  """
+
+  __slots__ = ("logical_idxs", "blocks", "state_row", "nbytes")
+
+  def __init__(self, logical_idxs, blocks, state_row):
+    self.logical_idxs = list(logical_idxs)
+    self.blocks = blocks
+    self.state_row = state_row
+    n = 0
+    for arr in (blocks or []):
+      n += getattr(arr, "nbytes", 0)
+    for arr in (state_row or []):
+      n += getattr(arr, "nbytes", 0)
+    self.nbytes = int(n)
+
+
+class HostPageStore:
+  """The host memory tier preempted KV pages and SSM state spill to.
+
+  Pure host bookkeeping (numpy blocks in a dict), serialized by the
+  engine lock like the allocator. The contract that makes preemption
+  invisible to the stream: Put saves the exact device bytes (the engine
+  gathers pages through the same jitted page IO the fleet handoff
+  uses, so the round trip is a bitwise memcpy), Pop returns them once
+  for the restore scatter, Drop discards a cancelled sequence's entry.
+  Counters feed scheduler Stats(): host_bytes is the live tier size,
+  spilled/restored pages are monotonic totals.
+  """
+
+  def __init__(self):
+    self._entries: dict = {}
+    self.spilled_pages = 0
+    self.restored_pages = 0
+    self.host_bytes = 0
+    self.peak_host_bytes = 0
+
+  def __len__(self) -> int:
+    return len(self._entries)
+
+  def __contains__(self, seq_id) -> bool:
+    return seq_id in self._entries
+
+  def Put(self, seq_id, logical_idxs, blocks=None, state_row=None):
+    assert seq_id not in self._entries, f"double spill of {seq_id!r}"
+    entry = SpillEntry(logical_idxs, blocks, state_row)
+    self._entries[seq_id] = entry
+    self.spilled_pages += len(entry.logical_idxs)
+    self.host_bytes += entry.nbytes
+    self.peak_host_bytes = max(self.peak_host_bytes, self.host_bytes)
+    return entry
+
+  def Peek(self, seq_id) -> SpillEntry:
+    return self._entries[seq_id]
+
+  def Pop(self, seq_id) -> SpillEntry:
+    entry = self._entries.pop(seq_id)
+    self.restored_pages += len(entry.logical_idxs)
+    self.host_bytes -= entry.nbytes
+    return entry
+
+  def Drop(self, seq_id) -> bool:
+    """Discards a cancelled sequence's entry (not counted as restored)."""
+    entry = self._entries.pop(seq_id, None)
+    if entry is None:
+      return False
+    self.host_bytes -= entry.nbytes
+    return True
+
+  def Stats(self) -> dict:
+    return {
+        "entries": len(self._entries),
+        "spilled_pages": self.spilled_pages,
+        "restored_pages": self.restored_pages,
+        "host_bytes": self.host_bytes,
+        "peak_host_bytes": self.peak_host_bytes,
     }

@@ -193,13 +193,22 @@ class PrefixRouter:
     self.prefix_routed = 0
     self.balanced_routed = 0
     self.rerouted_down = 0
+    self.priority_routed = 0
 
   def Route(self, prompt, snapshots: dict, session=None,
-            note: bool = True) -> str:
+            note: bool = True, priority: int = 0) -> str:
     """Picks the replica for `prompt`. snapshots: {label: registry
     snapshot dict, or None/missing for a DOWN replica} — in-process
     `registry.Snapshot()` and a scraped /statusz `doc["snapshot"]` both
     qualify. Raises RuntimeError only when every replica is DOWN.
+
+    priority > 0 routes on load WITHIN the request's class: the load
+    term reads "scheduler/queue_depth_high" (work parked above the
+    default class) instead of the configured load keys — a replica
+    drowning in default-class traffic preempts its way clear, so only
+    same-or-higher-class congestion should repel a priority request.
+    Snapshots without the key (pre-SLO replicas) fall back to the
+    configured keys for that replica.
 
     note=False skips tagging the shadow index with this routing — for a
     caller that must first inspect the PRE-routing shadow state (the
@@ -218,11 +227,16 @@ class PrefixRouter:
             self.shadow.NoteRouted(pinned, prompt)
           return pinned
         self.rerouted_down += 1   # pinned home is DOWN: re-route, re-pin
+    if priority > 0:
+      self.priority_routed += 1
     best, best_score, best_hit = None, None, 0
     for lb in live:
       hit = self.shadow.ExpectedHitTokens(lb, prompt)
+      load_keys = self.load_keys
+      if priority > 0 and "scheduler/queue_depth_high" in snapshots[lb]:
+        load_keys = ["scheduler/queue_depth_high"]
       load = 0
-      for key in self.load_keys:
+      for key in load_keys:
         v = snapshots[lb].get(key, 0)
         if not isinstance(v, bool) and isinstance(v, (int, float)):
           load += v
@@ -269,6 +283,7 @@ class PrefixRouter:
         "sessions_pinned": self.sessions_pinned,
         "shadow_nodes": self.shadow.nodes,
         "shadow_evictions": self.shadow.evictions,
+        "priority_routed": self.priority_routed,
     }
     assert set(stats) == observe_schema.ROUTER_STATS_KEYS, sorted(stats)
     return stats

@@ -30,6 +30,23 @@ iteration is admit → build → (device step) → commit:
   tokens, retires sequences on max_new/EOS, and frees their slot + pages
   immediately so `Admit` can refill the slot on the very next iteration.
 
+SLO-aware scheduling (`scheduler_mode='priority'`, opt-in; 'fifo' is the
+bit-exact legacy default): requests carry a `priority` class and a
+`tenant` label. Admission serves the highest priority class first;
+within a class, preempted work resumes before fresh work, and fresh
+admissions are weighted-fair across tenants (least admitted-token
+service per unit weight goes first). Under pool pressure a strictly
+higher-priority arrival PREEMPTS a victim — lowest priority first,
+fewest generated tokens first — by spilling its private KV pages and
+O(1)-mixer state row to a host tier (`kv_cache.HostPageStore`) and
+parking it in a PREEMPTED queue; re-admission restores the saved bytes
+into fresh pages at the same logical slots and resumes from the spilled
+cursor, no recompute. The device halves (page gather/scatter, state row
+gather/scatter) are injected by the engine as `spill_fn`/`restore_fn` /
+`state_spill_fn`/`state_restore_fn` callbacks, so the scheduler itself
+stays device-free. Per-tenant token-rate quotas (`TokenBucket`) gate
+`Submit`, raising `QuotaExceeded` before any state is created.
+
 Sequences/requests are identified by the user-visible request id. The
 scheduler is deliberately device-free (pure Python + numpy) so its
 lifecycle is unit-testable with fabricated sample arrays.
@@ -39,6 +56,7 @@ from __future__ import annotations
 
 import collections
 import enum
+import time
 from typing import Optional
 
 import numpy as np
@@ -53,6 +71,47 @@ class SeqState(enum.Enum):
   DECODE = "decode"
   FINISHED = "finished"
   CANCELLED = "cancelled"
+  PREEMPTED = "preempted"
+
+
+class QuotaExceeded(Exception):
+  """Raised by Submit when the tenant's token-rate bucket is empty."""
+
+
+class TokenBucket:
+  """Per-tenant token-rate quota: `rate` tokens/sec up to `burst` deep.
+
+  A request is charged its whole worst-case footprint (prompt + max_new)
+  at Submit — the same unit admission reserves pages for — so a tenant
+  cannot laundromat quota by submitting long generations cheaply.
+  clock: injectable monotonic-seconds source (tests)."""
+
+  def __init__(self, rate: float, burst: float, clock=None):
+    assert rate >= 0 and burst > 0, (rate, burst)
+    self.rate = float(rate)
+    self.burst = float(burst)
+    self._clock = clock if clock is not None else time.monotonic
+    self._level = float(burst)
+    self._last = self._clock()
+
+  def _Refill(self):
+    now = self._clock()
+    self._level = min(self.burst,
+                      self._level + (now - self._last) * self.rate)
+    self._last = now
+
+  def TryTake(self, n: float) -> bool:
+    """Charges n tokens if the bucket covers them; False otherwise."""
+    self._Refill()
+    if n <= self._level:
+      self._level -= n
+      return True
+    return False
+
+  @property
+  def level(self) -> float:
+    self._Refill()
+    return self._level
 
 
 class Request:
@@ -76,11 +135,21 @@ class Request:
   a linear chain (the exact PR-11 behavior), n > 1 caps the width at
   min(n, engine w). Only consulted when the engine's draft source has
   width > 1.
+
+  priority: SLO class, higher = more urgent (default 0). Consulted only
+  by `scheduler_mode='priority'` schedulers: admission serves higher
+  classes first, and a strictly higher-priority arrival may preempt a
+  lower one under pool pressure. FIFO schedulers ignore it.
+
+  tenant: opaque tenant label for quota + fairness accounting (None =
+  the anonymous tenant). Weighted-fair admission within a priority
+  class and per-tenant token-rate quotas key on it.
   """
 
   def __init__(self, req_id, prompt, max_new_tokens: int,
                eos_id: Optional[int] = None, seed: Optional[int] = None,
-               spec_k: Optional[int] = None, spec_w: Optional[int] = None):
+               spec_k: Optional[int] = None, spec_w: Optional[int] = None,
+               priority: int = 0, tenant=None):
     prompt = [int(t) for t in prompt]
     assert len(prompt) >= 1, "empty prompt"
     assert max_new_tokens >= 1, max_new_tokens
@@ -92,6 +161,8 @@ class Request:
     self.eos_id = eos_id
     self.spec_k = spec_k
     self.spec_w = spec_w
+    self.priority = int(priority)
+    self.tenant = tenant
     if seed is None:
       seed = req_id if isinstance(req_id, int) else abs(hash(req_id))
     self.seed = int(seed) % (2**31)
@@ -115,6 +186,8 @@ class Sequence:
     # the engine must copy device-side before this sequence's first step
     self.reused_tokens = 0
     self.cow_pairs: list[tuple[int, int]] = []
+    # submission order within the scheduler (priority-mode tie-break)
+    self.arrival = 0
 
   @property
   def id(self):
@@ -200,7 +273,9 @@ class Scheduler:
                table_pages: int, prefill_chunk: int,
                needs_kv_pages: bool = True,
                state_pool: Optional[kv_cache.StateSlotPool] = None,
-               prefix_cache=None):
+               prefix_cache=None, scheduler_mode: str = "fifo",
+               host_store: Optional[kv_cache.HostPageStore] = None,
+               tenant_quotas=None, tenant_weights=None, clock=None):
     """table_pages: block-table width (pages per sequence) — the static
     max_seq_len / page_size bound every compiled program carries.
     prefill_chunk: prompt tokens a prefilling row consumes per mixed step.
@@ -211,8 +286,17 @@ class Scheduler:
     prefix_cache: optional serving/prefix_cache.PrefixCache bound to
     `allocator` — admission probes/borrows cached prefix pages and
     completed prefills insert theirs; None keeps the exact legacy path.
+    scheduler_mode: 'fifo' (default, the bit-exact legacy admission
+    path) or 'priority' (SLO classes + weighted-fair tenants +
+    preemption by page spill — module docstring). host_store: the host
+    tier preempted pages spill to (priority mode builds one when None).
+    tenant_quotas: {tenant: TokenBucket | (rate, burst)} token-rate
+    quotas enforced at Submit. tenant_weights: {tenant: weight} for
+    weighted-fair admission within a priority class (default 1.0).
+    clock: injectable monotonic-seconds source for quota refill (tests).
     """
     assert max_slots >= 1 and table_pages >= 1 and prefill_chunk >= 1
+    assert scheduler_mode in ("fifo", "priority"), scheduler_mode
     self.max_slots = max_slots
     self.alloc = allocator
     self.table_pages = table_pages
@@ -220,7 +304,25 @@ class Scheduler:
     self.needs_kv_pages = needs_kv_pages
     self.state_pool = state_pool
     self.prefix_cache = prefix_cache
+    self.scheduler_mode = scheduler_mode
+    self.host_store = host_store
+    if self.host_store is None and scheduler_mode == "priority":
+      self.host_store = kv_cache.HostPageStore()
+    # device halves of spill/restore, injected by the owning engine
+    # (None on device-free schedulers: spills then move no bytes, which
+    # is exactly right for unit tests and pageless stacks)
+    self.spill_fn = None          # pages -> host blocks (per paged leaf)
+    self.restore_fn = None        # (pages, blocks) -> scatters them back
+    self.state_spill_fn = None    # slot -> host rows (per slot leaf)
+    self.state_restore_fn = None  # (slot, rows) -> scatters them back
+    self.allow_preempt = True     # priority WITHOUT spill: sweep arm knob
+    self.tenant_weights = dict(tenant_weights or {})
+    self.quotas = {}
+    for tenant, q in (tenant_quotas or {}).items():
+      self.quotas[tenant] = (q if isinstance(q, TokenBucket)
+                             else TokenBucket(q[0], q[1], clock=clock))
     self.waiting = collections.deque()        # of Sequence (QUEUED)
+    self.preempted = collections.deque()      # of Sequence (PREEMPTED)
     self.slots: list[Optional[Sequence]] = [None] * max_slots
     self._by_id: dict[object, Sequence] = {}
     # block tables as one stable [B, table_pages] array, rewritten on
@@ -236,6 +338,12 @@ class Scheduler:
     self.prefix_ordered_admissions = 0
     # tree-speculation rows whose branch count the packed-row cap shrank
     self.width_clamps = 0
+    # SLO accounting (priority mode; zeros under fifo)
+    self.preemptions = 0
+    self.restores = 0
+    self.quota_rejections = 0
+    self._arrival = 0
+    self._tenant_service: dict = {}   # tenant -> admitted token footprint
 
   # -- submission ------------------------------------------------------------
 
@@ -250,7 +358,16 @@ class Scheduler:
           f"request {request.id!r} needs {self.alloc.PagesFor(total)} pages "
           f"(prompt {len(request.prompt)} + max_new {request.max_new}) but "
           f"block tables hold {self.table_pages}")
+    bucket = self.quotas.get(request.tenant)
+    if bucket is not None and not bucket.TryTake(total):
+      self.quota_rejections += 1
+      raise QuotaExceeded(
+          f"tenant {request.tenant!r} over token-rate quota: request "
+          f"footprint {total} exceeds bucket level {bucket.level:.0f} "
+          f"(rate {bucket.rate}/s, burst {bucket.burst:.0f})")
     seq = Sequence(request)
+    self._arrival += 1
+    seq.arrival = self._arrival
     self._by_id[request.id] = seq
     self.waiting.append(seq)
     return seq
@@ -265,6 +382,18 @@ class Scheduler:
         self.waiting.remove(seq)
       except ValueError:
         pass
+      self._Retire(seq, SeqState.CANCELLED, "cancelled")
+      self.cancelled += 1
+      return True
+    if seq.state is SeqState.PREEMPTED:
+      # parked off-device: drop the host-tier entry, then release the
+      # refs it still holds on shared prefix pages (Free skips HOLEs)
+      try:
+        self.preempted.remove(seq)
+      except ValueError:
+        pass
+      if self.host_store is not None:
+        self.host_store.Drop(seq.id)
       self._Retire(seq, SeqState.CANCELLED, "cancelled")
       self.cancelled += 1
       return True
@@ -360,6 +489,18 @@ class Scheduler:
     return best
 
   def Admit(self) -> list:
+    """Admits queued (and, in priority mode, preempted) requests.
+
+    'fifo': the bit-exact legacy path (_AdmitFifo) — FIFO with
+    head-window prefix-cache reordering and intentional head-of-line
+    blocking. 'priority': highest SLO class first, preempted-before-
+    fresh and weighted-fair tenants within a class, preemption by page
+    spill under pressure (_AdmitPriority)."""
+    if self.scheduler_mode == "priority":
+      return self._AdmitPriority()
+    return self._AdmitFifo()
+
+  def _AdmitFifo(self) -> list:
     """Admits waiting requests into free slots while pages last.
 
     FIFO, except that within the head window the largest cached-prefix
@@ -402,8 +543,165 @@ class Scheduler:
       admitted.append(seq)
     return admitted
 
+  # -- priority admission + preemption (scheduler_mode='priority') -----------
+
+  def _CandidateKey(self, seq: Sequence):
+    """Admission order: highest class, then resume-before-fresh, then
+    weighted-fair across tenants (least admitted-token service per unit
+    weight), then arrival order."""
+    service = self._tenant_service.get(seq.req.tenant, 0)
+    weight = self.tenant_weights.get(seq.req.tenant, 1.0)
+    return (-seq.req.priority,
+            0 if seq.state is SeqState.PREEMPTED else 1,
+            service / weight, seq.arrival)
+
+  def _NextCandidate(self) -> Optional[Sequence]:
+    candidates = list(self.preempted) + list(self.waiting)
+    if not candidates:
+      return None
+    return min(candidates, key=self._CandidateKey)
+
+  def _PickVictim(self, min_priority: int) -> Optional[Sequence]:
+    """The live sequence a class-`min_priority` arrival may preempt:
+    strictly lower priority only (no same-class thrash), lowest class
+    first, least generated tokens first (cheapest progress to park)."""
+    live = [s for s in self.slots
+            if s is not None and s.req.priority < min_priority
+            and s.state in (SeqState.PREFILL, SeqState.DECODE)]
+    if not live:
+      return None
+    return min(live, key=lambda s: (s.req.priority, len(s.out), s.arrival))
+
+  def _Preempt(self, victim: Sequence):
+    """Spills `victim` to the host tier and parks it PREEMPTED.
+
+    Only its PRIVATE pages move: the data pages' bytes are gathered
+    device→host (spill_fn) BEFORE SpillPrivate returns them to the
+    pool; trailing reserved pages hold no data and are just freed.
+    Shared prefix pages keep the victim's refcount — they stay device-
+    resident and pinned, so the prefix cache's nodes stay valid. The
+    O(1)-mixer state row rides along (state_spill_fn); the draft-model
+    cursor resets so a restored row replays its committed stream into
+    whatever slot it lands in, exactly like a fresh admission."""
+    i = victim.slot
+    logical_idxs, blocks = [], None
+    if self.needs_kv_pages:
+      private = self.alloc.PrivatePages(victim.id, victim.pos)
+      if private and self.spill_fn is not None:
+        blocks = self.spill_fn([pg for _, pg in private])
+      logical_idxs = [li for li, _ in private]
+      self.alloc.SpillPrivate(victim.id)
+    state_row = None
+    if self.state_pool is not None:
+      if self.state_spill_fn is not None and victim.pos > 0:
+        state_row = self.state_spill_fn(i)
+      self.state_pool.Release(victim.id)
+    self.host_store.Put(victim.id, logical_idxs, blocks, state_row)
+    self.slots[i] = None
+    self.block_tables[i, :] = 0
+    victim.slot = None
+    victim.state = SeqState.PREEMPTED
+    victim.draft_pos = 0
+    self.preempted.append(victim)
+    self.preemptions += 1
+
+  def _ReAdmit(self, seq: Sequence, i: int) -> bool:
+    """Restores a PREEMPTED sequence into slot i from its host-tier
+    entry: re-backs every spilled logical page with a fresh exclusive
+    page (FillHoles, all-or-nothing), scatters the saved bytes into
+    exactly the logical slots they left, re-binds a state slot and
+    scatters the saved mixer-state row, and resumes from the spilled
+    cursor (PREFILL if prompt remains, DECODE otherwise). Returns False
+    with no side effects when the pool cannot cover the holes."""
+    if self.needs_kv_pages:
+      holes = self.alloc.HoleCount(seq.id)
+      if not self.alloc.CanAllocate(holes):
+        if self.prefix_cache is not None:
+          self.prefix_cache.EvictForPressure(holes - self.alloc.num_free)
+        if not self.alloc.CanAllocate(holes):
+          return False
+    entry = self.host_store.Pop(seq.id)
+    pages = []
+    if self.needs_kv_pages:
+      filled = dict(self.alloc.FillHoles(seq.id))
+      if entry.blocks is not None and entry.logical_idxs:
+        self.restore_fn([filled[li] for li in entry.logical_idxs],
+                        entry.blocks)
+      pages = self.alloc.PagesOf(seq.id)
+    self.slots[i] = seq
+    seq.slot = i
+    seq.state = (SeqState.PREFILL if seq.prompt_remaining > 0
+                 else SeqState.DECODE)
+    self.block_tables[i, :] = 0
+    self.block_tables[i, :len(pages)] = pages
+    if self.state_pool is not None:
+      self.state_pool.Acquire(seq.id, i)
+      if entry.state_row is not None and self.state_restore_fn is not None:
+        self.state_restore_fn(i, entry.state_row)
+    self.restores += 1
+    return True
+
+  def _TryAdmitInto(self, seq: Sequence, i: int) -> bool:
+    """One admission attempt into free slot i — restore for PREEMPTED
+    candidates, the normal reserve-whole-footprint path for fresh ones.
+    False (no side effects) when pages don't cover it."""
+    if seq.state is SeqState.PREEMPTED:
+      if not self._ReAdmit(seq, i):
+        return False
+      self.preempted.remove(seq)
+    else:
+      if self.needs_kv_pages:
+        if not self._AdmitPages(seq):
+          return False
+        pages = self.alloc.PagesOf(seq.id)
+      else:
+        pages = []
+      self.waiting.remove(seq)
+      self.slots[i] = seq
+      seq.state = SeqState.PREFILL
+      seq.slot = i
+      self.block_tables[i, :] = 0
+      self.block_tables[i, :len(pages)] = pages
+      if self.state_pool is not None:
+        self.state_pool.Acquire(seq.id, i)
+      tenant = seq.req.tenant
+      self._tenant_service[tenant] = (
+          self._tenant_service.get(tenant, 0)
+          + len(seq.req.prompt) + seq.req.max_new)
+      self.admitted += 1
+    self.slots_live_peak = max(
+        self.slots_live_peak, sum(s is not None for s in self.slots))
+    return True
+
+  def _AdmitPriority(self) -> list:
+    """Priority admission: repeatedly place the best candidate
+    (_CandidateKey) into a free slot; when slots or pages run out and
+    the candidate outranks a running sequence, preempt the cheapest
+    strictly-lower-priority victim and retry. Admission stops when the
+    best candidate neither fits nor outranks anyone — lower-class
+    candidates behind it would steal its resources, so head-of-line
+    blocking WITHIN a class is kept (starvation-safe), while higher
+    classes always jump the line."""
+    admitted = []
+    while True:
+      cand = self._NextCandidate()
+      if cand is None:
+        break
+      free_i = next((i for i, s in enumerate(self.slots) if s is None),
+                    None)
+      if free_i is not None and self._TryAdmitInto(cand, free_i):
+        admitted.append(cand)
+        continue
+      victim = (self._PickVictim(cand.req.priority)
+                if self.allow_preempt else None)
+      if victim is None:
+        break
+      self._Preempt(victim)
+    return admitted
+
   def HasWork(self) -> bool:
-    return any(s is not None for s in self.slots) or bool(self.waiting)
+    return (any(s is not None for s in self.slots) or bool(self.waiting)
+            or bool(self.preempted))
 
   def BuildStep(self) -> Optional[StepBatch]:
     """Flattens live slots into one [B, C] device step (None if idle)."""
@@ -808,6 +1106,8 @@ class Scheduler:
 
   def Stats(self) -> dict:
     live = [s for s in self.slots if s is not None]
+    host = self.host_store.Stats() if self.host_store is not None else {}
+    parked = list(self.preempted) + list(self.waiting)
     return {
         "slots": self.max_slots,
         "slots_live": len(live),
@@ -821,4 +1121,16 @@ class Scheduler:
         "slots_live_peak": self.slots_live_peak,
         "prefix_ordered_admissions": self.prefix_ordered_admissions,
         "width_clamps": self.width_clamps,
+        "scheduler_mode": self.scheduler_mode,
+        "preemptions": self.preemptions,
+        "restores": self.restores,
+        "preempted_queued": len(self.preempted),
+        "quota_rejections": self.quota_rejections,
+        "spilled_pages": host.get("spilled_pages", 0),
+        "restored_pages": host.get("restored_pages", 0),
+        "host_bytes": host.get("host_bytes", 0),
+        # class-aware load signal for the router: work parked ABOVE the
+        # default class (a replica drowning in priority traffic should
+        # repel more of it even when its plain queue_depth looks fine)
+        "queue_depth_high": sum(s.req.priority > 0 for s in parked),
     }
